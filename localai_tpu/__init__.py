@@ -1,0 +1,29 @@
+"""localai-tpu: a TPU-native, OpenAI-compatible model serving framework.
+
+A ground-up re-design of the capabilities of LocalAI (reference:
+/root/reference, an OpenAI-compatible REST server routing every AI
+capability over a gRPC contract to per-model backend processes) for TPU
+hardware: the compute path is JAX/XLA/Pallas with continuous batching and
+mesh-sharded (tp/dp/sp) inference; the serving shape — HTTP core that never
+links an inference engine, per-model backend processes behind a gRPC
+contract — is preserved because it is a good shape, but every layer below
+the contract is TPU-first rather than a port.
+
+Layer map (mirrors reference SURVEY.md section 1, re-imagined):
+  api/        OpenAI-compatible HTTP server (aiohttp)       [ref: core/http]
+  config/     app + per-model YAML configuration            [ref: core/config]
+  backend/    the gRPC backend contract + client            [ref: backend/backend.proto, pkg/grpc]
+  modelmgr/   model lifecycle: spawn/health/watchdog        [ref: pkg/model]
+  engine/     TPU serving engine: continuous batching,
+              paged KV, sampling, streaming detok           [ref: backend/cpp/llama/grpc-server.cpp]
+  models/     JAX model definitions (llama, bert, ...)      [ref: llama.cpp / python backends]
+  ops/        pallas kernels + jnp fallbacks
+  parallel/   mesh, shardings, ring attention, multi-host   [ref: core/p2p -- replaced by XLA collectives]
+  functions/  tools -> grammar-constrained decoding         [ref: pkg/functions]
+  templates/  chat prompt templating                        [ref: pkg/templates]
+  gallery/    model acquisition                             [ref: core/gallery, pkg/downloader]
+  stores/     vector store                                  [ref: backend/go/stores]
+  services/   metrics, monitor, job queues                  [ref: core/services]
+"""
+
+__version__ = "0.1.0"

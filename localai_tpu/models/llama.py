@@ -1,0 +1,278 @@
+"""Llama-family decoder (Llama 2/3/3.1, Mistral, Qwen2-style) in functional JAX.
+
+TPU-first design notes:
+  * Parameters are a plain pytree with all transformer layers STACKED on a
+    leading axis so the forward pass is a single ``lax.scan`` — one trace,
+    one compile, O(1) HLO size in depth.
+  * All shapes are static; prefill uses bucketed sequence lengths and decode
+    is a fixed [num_slots] batch so XLA compiles each bucket exactly once.
+  * Sharding is expressed with ``jax.sharding.PartitionSpec`` per leaf (see
+    localai_tpu/parallel/sharding.py); attention heads and MLP intermediate
+    are split on the "tp" mesh axis, batch/slots on "dp".
+  * GQA (num_kv_heads < num_heads) native; KV cache layout is
+    [layers, slots, max_len, kv_heads, head_dim] which keeps the decode
+    attention contraction MXU-friendly and the per-slot cache rows
+    contiguous in HBM.
+
+Capability parity target: the reference's main LLM engine is llama.cpp
+behind a gRPC server (reference: backend/cpp/llama/grpc-server.cpp); this
+module plays the role of llama.cpp's forward pass (llama_decode) for the
+TPU engine in localai_tpu/engine/.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.attention import (
+    causal_attention,
+    decode_attention,
+    mixed_prefill_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rope_scaling_type: str = "none"  # none | linear | yarn | llama3
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @staticmethod
+    def from_hf_config(cfg: dict, dtype=jnp.bfloat16) -> "LlamaConfig":
+        """Build from a HuggingFace ``config.json`` dict (llama/mistral/qwen2)."""
+        rope_scaling = cfg.get("rope_scaling") or {}
+        rs_type = rope_scaling.get("rope_type", rope_scaling.get("type", "none")) or "none"
+        return LlamaConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling_type=rs_type,
+            rope_scaling_factor=rope_scaling.get("factor", 1.0),
+            rope_low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+            rope_high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            rope_original_max_position=rope_scaling.get(
+                "original_max_position_embeddings", cfg.get("max_position_embeddings", 8192)
+            ),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            attention_bias=cfg.get("attention_bias", False),
+            dtype=dtype,
+        )
+
+    @staticmethod
+    def from_json(path: str, dtype=jnp.bfloat16) -> "LlamaConfig":
+        with open(path) as f:
+            return LlamaConfig.from_hf_config(json.load(f), dtype=dtype)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, dtype=None) -> dict:
+    """Random-init parameter pytree (layers stacked on axis 0)."""
+    dtype = dtype or cfg.dtype
+    hd = cfg.head_dim_
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    H, KV = cfg.num_heads, cfg.num_kv_heads
+    keys = jax.random.split(key, 10)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(dtype)
+
+    params = {
+        "embed": init(keys[0], (cfg.vocab_size, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype),
+            "wq": init(keys[1], (L, D, H * hd), D),
+            "wk": init(keys[2], (L, D, KV * hd), D),
+            "wv": init(keys[3], (L, D, KV * hd), D),
+            "wo": init(keys[4], (L, H * hd, D), H * hd),
+            "mlp_norm": jnp.ones((L, D), dtype),
+            "w_gate": init(keys[5], (L, D, F), D),
+            "w_up": init(keys[6], (L, D, F), D),
+            "w_down": init(keys[7], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = init(keys[8], (D, cfg.vocab_size), D)
+    return params
+
+
+def _project_qkv(x, layer, cfg: LlamaConfig):
+    """x: [B, T, D] -> q [B,T,H,hd], k/v [B,T,KV,hd]."""
+    B, T, _ = x.shape
+    hd = cfg.head_dim_
+    q = jnp.einsum("btd,dh->bth", x, layer["wq"]).reshape(B, T, cfg.num_heads, hd)
+    k = jnp.einsum("btd,dh->bth", x, layer["wk"]).reshape(B, T, cfg.num_kv_heads, hd)
+    v = jnp.einsum("btd,dh->bth", x, layer["wv"]).reshape(B, T, cfg.num_kv_heads, hd)
+    return q, k, v
+
+
+def _mlp(x, layer):
+    gate = jnp.einsum("btd,df->btf", x, layer["w_gate"])
+    up = jnp.einsum("btd,df->btf", x, layer["w_up"])
+    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up, layer["w_down"])
+
+
+def _unembed(x, params, cfg: LlamaConfig):
+    w = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum("btd,dv->btv", x, w).astype(jnp.float32)
+
+
+def prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,      # [B, T] int32, right-padded
+    seq_lens: jax.Array,    # [B] int32 true lengths
+    cache_k: jax.Array,     # [L, S, C, KV, hd]
+    cache_v: jax.Array,
+    slot_ids: jax.Array,    # [B] int32 cache slots to fill
+    start_pos: jax.Array,   # [B] int32 position offset (nonzero = continued prefix)
+    continued: bool = False,  # STATIC: True when any start_pos may be nonzero
+):
+    """Process full prompts, write KV into the cache slots, return last-token logits.
+
+    ``continued`` selects the attention path at trace time: fresh prompts
+    attend chunk-locally (cheap); continued chunks attend through the cache
+    rows with absolute-position masking. Returns (logits [B, V] at position
+    seq_lens-1, cache_k, cache_v).
+
+    INVARIANT (enforced by the engine scheduler, not checkable in-jit):
+    start_pos + T <= cache capacity C. dynamic_update_slice clamps
+    out-of-range starts, which would silently overwrite the prefix tail.
+    """
+    B, T = tokens.shape
+    positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, T]
+
+    def layer_fn(carry, layer):
+        x, ck, cv = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # write this layer's K/V for all B prompts into their slots:
+        # ck[li, slot_ids[b], start_pos[b]:start_pos[b]+T] = k[b]
+        def write_one(c, kv_b, slot, sp):
+            return jax.lax.dynamic_update_slice(c, kv_b[None], (slot, sp, 0, 0))
+        for b in range(B):
+            ck = ck.at[li].set(write_one(ck[li], k[b].astype(ck.dtype), slot_ids[b], start_pos[b]))
+            cv = cv.at[li].set(write_one(cv[li], v[b].astype(cv.dtype), slot_ids[b], start_pos[b]))
+        if continued:
+            # continued prefix: keys live in the cache; attend over the full
+            # slot rows with absolute-position causal masking.
+            k_rows = ck[li][slot_ids].astype(cfg.dtype)  # [B, C, KV, hd]
+            v_rows = cv[li][slot_ids].astype(cfg.dtype)
+            attn = mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, cfg.q_per_kv)
+        else:
+            attn = causal_attention(q, k, v, valid, cfg.q_per_kv)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), layer["wo"])
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, layer)
+        return (x, ck, cv), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, cache_k, cache_v), _ = jax.lax.scan(layer_fn, (x, cache_k, cache_v), layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # gather hidden state at the last valid position of each prompt
+    last = jnp.take_along_axis(x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
+    logits = _unembed(last, params, cfg)[:, 0, :]
+    return logits, cache_k, cache_v
+
+
+def decode_step(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,     # [S] int32 — one token per slot
+    lengths: jax.Array,    # [S] int32 — current context length per slot (position of new token)
+    cache_k: jax.Array,    # [L, S, C, KV, hd]
+    cache_v: jax.Array,
+):
+    """One decode step for ALL slots (inactive slots are masked by caller).
+
+    Returns (logits [S, V], cache_k, cache_v). The new token for slot s is
+    written at cache position lengths[s]; attention spans [0, lengths[s]].
+
+    INVARIANT (enforced by the engine scheduler): lengths[s] < C for active
+    slots. At lengths[s] == C the one_hot write row is all-zero and the new
+    token's K/V would be silently dropped — the scheduler must context-shift
+    or finish the request before the cache fills.
+    """
+    S = tokens.shape[0]
+    positions = lengths[:, None]  # [S, 1]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)[:, None, :]  # [S,1,D]
+    C = cache_k.shape[2]
+
+    def layer_fn(carry, layer):
+        x, ck, cv = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(h, layer, cfg)  # q [S,1,H,hd], k/v [S,1,KV,hd]
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # scatter new k/v at [slot, lengths[slot]]
+        onehot = jax.nn.one_hot(lengths, C, dtype=ck.dtype)  # [S, C]
+        lk = ck[li] * (1 - onehot[:, :, None, None]) + onehot[:, :, None, None] * k.astype(ck.dtype)
+        lv = cv[li] * (1 - onehot[:, :, None, None]) + onehot[:, :, None, None] * v.astype(cv.dtype)
+        ck = ck.at[li].set(lk)
+        cv = cv.at[li].set(lv)
+        attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)  # [S,H,hd]
+        x = x + jnp.einsum("sh,hd->sd", attn.reshape(S, -1), layer["wo"])[:, None, :]
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, layer)
+        return (x, ck, cv), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, cache_k, cache_v), _ = jax.lax.scan(layer_fn, (x, cache_k, cache_v), layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _unembed(x, params, cfg)[:, 0, :]
+    return logits, cache_k, cache_v
+
+
+def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int, dtype=None):
+    """KV cache: ([L, S, C, KV, hd], [L, S, C, KV, hd])."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,85 @@
+"""Attention ops: jnp reference implementations.
+
+These are the semantic reference; Pallas TPU kernels (flash prefill,
+paged decode) in localai_tpu/ops/pallas/ replace them on TPU via the
+dispatch switch in localai_tpu/ops/__init__.py. Keeping a pure-jnp path
+means every test runs hermetically on the 8-device CPU mesh.
+
+Role parity: this is the attention inside the reference's hot loop
+(llama.cpp's llama_decode, driven from grpc-server.cpp:1941).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, q_per_kv: int) -> jax.Array:
+    """[.., KV, hd] -> [.., KV*q_per_kv, hd] for GQA."""
+    if q_per_kv == 1:
+        return k
+    return jnp.repeat(k, q_per_kv, axis=-2)
+
+
+def causal_attention(q, k, v, valid, q_per_kv: int):
+    """Prefill attention.
+
+    q: [B, T, H, hd]; k, v: [B, T, KV, hd]; valid: [B, T] bool.
+    Returns [B, T, H, hd].
+    """
+    dtype = q.dtype
+    hd = q.shape[-1]
+    k = _repeat_kv(k, q_per_kv)
+    v = _repeat_kv(v, q_per_kv)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
+    T = q.shape[1]
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    mask = causal[None, None, :, :] & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, q_per_kv: int):
+    """Continued-prefill attention: queries for a chunk at absolute positions
+    start_pos..start_pos+T attend over full cache rows (prefix + chunk).
+
+    q: [B, T, H, hd]; k_rows/v_rows: [B, C, KV, hd]; start_pos, seq_lens: [B].
+    Key position kp is visible to query qi iff kp <= start_pos + qi AND
+    kp < start_pos + seq_lens (excludes garbage keys written by chunk padding).
+    """
+    dtype = q.dtype
+    hd = q.shape[-1]
+    k = _repeat_kv(k_rows, q_per_kv)
+    v = _repeat_kv(v_rows, q_per_kv)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
+    B, T = q.shape[:2]
+    C = k_rows.shape[1]
+    abs_q = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]      # [B, T]
+    kp = jnp.arange(C, dtype=jnp.int32)                                        # [C]
+    mask = kp[None, None, :] <= abs_q[:, :, None]                              # [B, T, C]
+    mask &= kp[None, None, :] < (start_pos + seq_lens)[:, None, None]
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def decode_attention(q, cache_k, cache_v, lengths, q_per_kv: int):
+    """Single-token decode attention over the cache for all slots.
+
+    q: [S, H, hd]; cache_k/v: [S, C, KV, hd]; lengths: [S] (valid cache
+    positions are [0, lengths[s])). Returns [S, H, hd].
+    """
+    dtype = q.dtype
+    hd = q.shape[-1]
+    k = _repeat_kv(cache_k, q_per_kv)  # [S, C, H, hd]
+    v = _repeat_kv(cache_v, q_per_kv)
+    scores = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
+    C = cache_k.shape[1]
+    mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]  # [S, C]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("shc,schd->shd", probs, v)

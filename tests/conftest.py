@@ -1,0 +1,34 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's test stance (SURVEY.md section 4) but adds what it
+lacks: hermetic multi-device sharding tests without real hardware.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_llama():
+    """A tiny randomly-initialized llama for engine/API tests."""
+    import jax
+    from localai_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        max_position_embeddings=128,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params

@@ -1,0 +1,91 @@
+"""Model correctness: prefill+decode must agree with a naive full forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+
+
+def test_prefill_decode_consistency(tiny_llama):
+    """Decoding token-by-token must match prefilling the whole prompt."""
+    cfg, params = tiny_llama
+    key = jax.random.PRNGKey(1)
+    T = 12
+    tokens = jax.random.randint(key, (1, T), 0, cfg.vocab_size, jnp.int32)
+
+    # path A: prefill all T tokens
+    ck, cv = llama.init_cache(cfg, 2, 32)
+    logits_full, _, _ = llama.prefill(
+        params, cfg, tokens, jnp.array([T], jnp.int32), ck, cv,
+        jnp.array([0], jnp.int32), jnp.array([0], jnp.int32),
+    )
+
+    # path B: prefill T-1 then decode the last token
+    ck, cv = llama.init_cache(cfg, 2, 32)
+    _, ck, cv = llama.prefill(
+        params, cfg, tokens[:, : T - 1], jnp.array([T - 1], jnp.int32), ck, cv,
+        jnp.array([0], jnp.int32), jnp.array([0], jnp.int32),
+    )
+    # decode runs over ALL slots; slot 1 is inactive padding
+    step_tokens = jnp.array([tokens[0, T - 1], 0], jnp.int32)
+    lengths = jnp.array([T - 1, 0], jnp.int32)
+    logits_step, _, _ = llama.decode_step(params, cfg, step_tokens, lengths, ck, cv)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_full[0]), np.asarray(logits_step[0]), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_prefill_padding_invariance(tiny_llama):
+    """Right-padding must not change the last-token logits."""
+    cfg, params = tiny_llama
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size, jnp.int32)
+    padded = jnp.pad(tokens, ((0, 0), (0, 8)))
+
+    ck, cv = llama.init_cache(cfg, 1, 32)
+    a, _, _ = llama.prefill(params, cfg, tokens, jnp.array([8], jnp.int32), ck, cv,
+                            jnp.array([0], jnp.int32), jnp.array([0], jnp.int32))
+    ck, cv = llama.init_cache(cfg, 1, 32)
+    b, _, _ = llama.prefill(params, cfg, padded, jnp.array([8], jnp.int32), ck, cv,
+                            jnp.array([0], jnp.int32), jnp.array([0], jnp.int32))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2)
+
+
+def test_chunked_prefill_matches(tiny_llama):
+    """Prefilling in two chunks (prefix continuation) must match one shot."""
+    cfg, params = tiny_llama
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab_size, jnp.int32)
+
+    ck, cv = llama.init_cache(cfg, 1, 32)
+    one, _, _ = llama.prefill(params, cfg, tokens, jnp.array([16], jnp.int32), ck, cv,
+                              jnp.array([0], jnp.int32), jnp.array([0], jnp.int32))
+
+    ck, cv = llama.init_cache(cfg, 1, 32)
+    _, ck, cv = llama.prefill(params, cfg, tokens[:, :8], jnp.array([8], jnp.int32), ck, cv,
+                              jnp.array([0], jnp.int32), jnp.array([0], jnp.int32))
+    two, _, _ = llama.prefill(params, cfg, tokens[:, 8:], jnp.array([8], jnp.int32), ck, cv,
+                              jnp.array([0], jnp.int32), jnp.array([8], jnp.int32),
+                              continued=True)
+    np.testing.assert_allclose(np.asarray(one), np.asarray(two), rtol=2e-2, atol=2e-2)
+
+
+def test_gqa_heads_shapes(tiny_llama):
+    cfg, params = tiny_llama
+    assert cfg.q_per_kv == 2
+    ck, cv = llama.init_cache(cfg, 4, 16)
+    assert ck.shape == (cfg.num_layers, 4, 16, cfg.num_kv_heads, cfg.head_dim_)
+
+
+def test_hf_config_parsing():
+    hf = {
+        "vocab_size": 128256, "hidden_size": 4096, "intermediate_size": 14336,
+        "num_hidden_layers": 32, "num_attention_heads": 32, "num_key_value_heads": 8,
+        "rope_theta": 500000.0, "rms_norm_eps": 1e-5, "max_position_embeddings": 131072,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                          "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
+    }
+    cfg = llama.LlamaConfig.from_hf_config(hf)
+    assert cfg.num_kv_heads == 8
+    assert cfg.rope_scaling_type == "llama3"
+    assert cfg.rope_scaling_factor == 8.0

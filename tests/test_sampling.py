@@ -1,0 +1,96 @@
+"""Sampling suite unit tests (hermetic, CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.engine import sampling
+
+
+def _mk(S=2, V=64):
+    sp = sampling.make_slot_params(S)
+    counts = jnp.zeros((S, V), jnp.int32)
+    bias = jnp.zeros((S, V), jnp.float32)
+    keys = jax.vmap(jax.random.key_data)(
+        jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
+    )
+    return sp, counts, bias, keys
+
+
+def test_greedy_picks_argmax():
+    sp, counts, bias, keys = _mk()
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[1, 13].set(5.0)
+    ids, logprobs, _ = sampling.sample(logits, sp, counts, bias, keys)
+    assert list(np.asarray(ids)) == [7, 13]
+    assert np.all(np.asarray(logprobs) <= 0)
+
+
+def test_top_k_restricts_support():
+    sp, counts, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.0, top_k=2, top_p=1.0))
+    sp = sampling.set_slot(sp, 1, sampling.SamplingParamsHost(temperature=1.0, top_k=2, top_p=1.0))
+    logits = jnp.zeros((2, 64), jnp.float32).at[:, 3].set(10.0).at[:, 9].set(9.0)
+    seen = set()
+    for trial in range(20):
+        keys2 = jax.vmap(jax.random.key_data)(
+            jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial * 100)
+        )
+        ids, _, _ = sampling.sample(logits, sp, counts, bias, keys2)
+        seen.update(np.asarray(ids).tolist())
+    assert seen <= {3, 9}
+
+
+def test_top_p_keeps_head():
+    sp, counts, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.0, top_k=0, top_p=0.5))
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 5].set(20.0)  # ~all mass on 5
+    for trial in range(10):
+        keys2 = jax.vmap(jax.random.key_data)(
+            jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial)
+        )
+        ids, _, _ = sampling.sample(logits, sp, counts, bias, keys2)
+        assert int(np.asarray(ids)[0]) == 5
+
+
+def test_repeat_penalty_suppresses_seen_tokens():
+    sp, counts, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, repeat_penalty=100.0))
+    counts = counts.at[0, 7].set(3)
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
+    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    assert int(np.asarray(ids)[0]) == 8  # 7 heavily penalized
+
+
+def test_frequency_penalty():
+    sp, counts, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, frequency_penalty=2.0))
+    counts = counts.at[0, 7].set(3)  # 5.0 - 6.0 < 4.0
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
+    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    assert int(np.asarray(ids)[0]) == 8
+
+
+def test_logit_bias():
+    sp, counts, bias, keys = _mk()
+    bias = bias.at[0, 42].set(100.0)
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0)
+    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    assert int(np.asarray(ids)[0]) == 42
+
+
+def test_deterministic_seed():
+    sp, counts, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.5, top_k=0, top_p=1.0))
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 64)) * 3
+    a, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    b, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_update_token_counts():
+    counts = jnp.zeros((2, 16), jnp.int32)
+    ids = jnp.array([3, 5], jnp.int32)
+    active = jnp.array([True, False])
+    out = sampling.update_token_counts(counts, ids, active)
+    assert int(out[0, 3]) == 1
+    assert int(out[1, 5]) == 0
