@@ -1,0 +1,86 @@
+"""Benchmark: decode throughput of the TPU engine on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the driver north-star is >2000 tok/s aggregate for Llama-3.1-8B
+on a v5e-8 (BASELINE.json). Until multi-chip hardware is available this
+bench runs a TinyLlama-1.1B-shaped model (the largest llama-family config
+that fits one v5e chip in bf16 with a serving-sized KV cache) and reports
+aggregate decode tokens/sec/chip; vs_baseline is value / 2000.
+
+Method: random-init weights (no network egress in this environment), the
+engine's own jitted decode+sample step over all slots, timed after warmup —
+i.e. the真 serving hot loop, not a synthetic matmul.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from localai_tpu.engine import sampling
+    from localai_tpu.models import llama
+
+    preset = os.environ.get("LOCALAI_BENCH_PRESET", "1b")
+    presets = {
+        # TinyLlama-1.1B shape
+        "1b": dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                   num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64),
+        # small smoke config (CPU-safe)
+        "smoke": dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                      num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16),
+    }
+    cfg = llama.LlamaConfig(max_position_embeddings=2048, **presets[preset])
+
+    S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "32"))
+    C = int(os.environ.get("LOCALAI_BENCH_CTX", "1024"))
+    steps = int(os.environ.get("LOCALAI_BENCH_STEPS", "64"))
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ck, cv = llama.init_cache(cfg, S, C)
+    slot_params = sampling.make_slot_params(S)
+    counts = jnp.zeros((S, cfg.vocab_size), jnp.int32)
+    bias = jnp.zeros((S, cfg.vocab_size), jnp.float32)
+    keys = jax.vmap(jax.random.key_data)(
+        jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
+    )
+    active = jnp.ones((S,), jnp.bool_)
+
+    @jax.jit
+    def step(tokens, lengths, ck, cv, counts, keys):
+        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
+        ids, _, keys = sampling.sample(logits, slot_params, counts, bias, keys)
+        counts = sampling.update_token_counts(counts, ids, active)
+        return ids, lengths + 1, ck, cv, counts, keys
+
+    tokens = jnp.zeros((S,), jnp.int32)
+    lengths = jnp.full((S,), C // 2, jnp.int32)  # mid-context, realistic load
+
+    # warmup / compile
+    tokens, lengths, ck, cv, counts, keys = step(tokens, lengths, ck, cv, counts, keys)
+    jax.block_until_ready(tokens)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tokens, lengths, ck, cv, counts, keys = step(tokens, lengths, ck, cv, counts, keys)
+    jax.block_until_ready(tokens)
+    dt = time.perf_counter() - t0
+
+    tok_s = S * steps / dt
+    out = {
+        "metric": f"aggregate_decode_tok_s_per_chip_llama_{preset}_bf16_slots{S}",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / 2000.0, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Fake echo backend — hermetic test double for the backend contract.
+
+The reference has NO fake backend (its API tests run real models;
+SURVEY.md section 4 takeaway). This fills that gap: a fully in-memory
+servicer usable in-process (embedded) or spawned
+(python -m localai_tpu.backend.fake --addr ...), so HTTP-layer tests are
+fast and deterministic.
+
+Behavior: PredictStream emits the prompt's whitespace tokens back one by
+one (prefixed configurably); Embedding returns a hash-derived unit vector;
+TTS/Image write tiny valid files; Stores is a real in-memory store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+import struct
+import time
+
+import grpc
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+
+class FakeServicer(BackendServicer):
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.loaded = None
+        self.store: dict = {}
+
+    def LoadModel(self, request, context):
+        if "fail" in request.model:
+            return pb.Result(success=False, message="fake load failure")
+        self.loaded = request
+        return pb.Result(success=True, message="loaded")
+
+    def _chunks(self, opts):
+        words = opts.prompt.split() or ["echo"]
+        n = opts.max_tokens or len(words)
+        return words[:n]
+
+    def Predict(self, request, context):
+        chunks = self._chunks(request)
+        text = " ".join(chunks)
+        if request.echo:
+            text = request.prompt + text
+        return pb.Reply(
+            message=text.encode(), tokens=len(chunks),
+            prompt_tokens=len(request.prompt.split()), finish_reason="stop",
+        )
+
+    def PredictStream(self, request, context):
+        chunks = self._chunks(request)
+        stops = list(request.stop_sequences)
+        for i, w in enumerate(chunks):
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            text = (" " if i else "") + w
+            if any(s in w for s in stops):
+                yield pb.Reply(message=b"", tokens=i + 1, finish_reason="stop")
+                return
+            yield pb.Reply(
+                message=text.encode(), token_id=i, tokens=i + 1,
+                prompt_tokens=len(request.prompt.split()),
+                finish_reason="stop" if i == len(chunks) - 1 else "",
+            )
+
+    def Embedding(self, request, context):
+        h = hashlib.sha256(request.prompt.encode()).digest()
+        vals = [b / 255.0 for b in h[:16]]
+        norm = math.sqrt(sum(v * v for v in vals)) or 1.0
+        return pb.EmbeddingResult(embeddings=[v / norm for v in vals])
+
+    def TokenizeString(self, request, context):
+        toks = [abs(hash(w)) % 50000 for w in request.prompt.split()]
+        return pb.TokenizationResponse(length=len(toks), tokens=toks)
+
+    def TTS(self, request, context):
+        _write_wav(request.dst, b"\x00\x00" * 1600)
+        return pb.Result(success=True, message="ok")
+
+    def SoundGeneration(self, request, context):
+        _write_wav(request.dst, b"\x00\x01" * 1600)
+        return pb.Result(success=True, message="ok")
+
+    def AudioTranscription(self, request, context):
+        return pb.TranscriptResult(
+            segments=[pb.TranscriptSegment(id=0, start=0, end=int(1e9), text="fake transcript")],
+            text="fake transcript",
+        )
+
+    def GenerateImage(self, request, context):
+        # 1x1 black PNG
+        png = bytes.fromhex(
+            "89504e470d0a1a0a0000000d49484452000000010000000108060000001f15c489"
+            "0000000d4944415478da636400000000060003660d23380000000049454e44ae426082"
+        )
+        os.makedirs(os.path.dirname(request.dst) or ".", exist_ok=True)
+        with open(request.dst, "wb") as f:
+            f.write(png)
+        return pb.Result(success=True, message="ok")
+
+    def Rerank(self, request, context):
+        scored = sorted(
+            (
+                (sum(1 for w in request.query.split() if w.lower() in d.lower()), i, d)
+                for i, d in enumerate(request.documents)
+            ),
+            reverse=True,
+        )
+        top = scored[: request.top_n or len(scored)]
+        return pb.RerankResult(
+            usage=pb.Usage(total_tokens=len(request.query.split()), prompt_tokens=0),
+            results=[
+                pb.DocumentResult(index=i, text=d, relevance_score=float(s))
+                for s, i, d in top
+            ],
+        )
+
+    def Status(self, request, context):
+        return pb.StatusResponse(
+            state=pb.StatusResponse.READY if self.loaded else pb.StatusResponse.UNINITIALIZED,
+            memory=pb.MemoryUsageData(total=0),
+        )
+
+    def GetMetrics(self, request, context):
+        return pb.MetricsResponse(slots_total=1, slots_active=0)
+
+    # --- stores: real in-memory implementation ---
+    def StoresSet(self, request, context):
+        for k, v in zip(request.keys, request.values):
+            self.store[tuple(k.floats)] = bytes(v.bytes)
+        return pb.Result(success=True)
+
+    def StoresDelete(self, request, context):
+        for k in request.keys:
+            self.store.pop(tuple(k.floats), None)
+        return pb.Result(success=True)
+
+    def StoresGet(self, request, context):
+        keys, values = [], []
+        for k in request.keys:
+            t = tuple(k.floats)
+            if t in self.store:
+                keys.append(pb.StoresKey(floats=list(t)))
+                values.append(pb.StoresValue(bytes=self.store[t]))
+        return pb.StoresGetResult(keys=keys, values=values)
+
+    def StoresFind(self, request, context):
+        q = list(request.key.floats)
+        qn = math.sqrt(sum(x * x for x in q)) or 1.0
+        sims = []
+        for t, v in self.store.items():
+            dot = sum(a * b for a, b in zip(q, t))
+            tn = math.sqrt(sum(x * x for x in t)) or 1.0
+            sims.append((dot / (qn * tn), t, v))
+        sims.sort(reverse=True)
+        top = sims[: request.top_k or len(sims)]
+        return pb.StoresFindResult(
+            keys=[pb.StoresKey(floats=list(t)) for _, t, _ in top],
+            values=[pb.StoresValue(bytes=v) for _, _, v in top],
+            similarities=[s for s, _, _ in top],
+        )
+
+
+def _write_wav(dst: str, pcm: bytes, rate: int = 16000):
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    hdr = b"RIFF" + struct.pack("<I", 36 + len(pcm)) + b"WAVEfmt " + struct.pack(
+        "<IHHIIHH", 16, 1, 1, rate, rate * 2, 2, 16
+    ) + b"data" + struct.pack("<I", len(pcm))
+    with open(dst, "wb") as f:
+        f.write(hdr + pcm)
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--delay", type=float, default=0.0)
+    args = parser.parse_args(argv)
+    server = make_server(FakeServicer(args.delay), args.addr)
+    server.start()
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,240 @@
+"""The TPU engine served over the backend contract.
+
+This is the process spawned per model by the model manager — the analogue
+of the reference's llama.cpp gRPC server binary (reference:
+backend/cpp/llama/grpc-server.cpp:2503-2541 main, --addr flag), with the
+slot machinery replaced by localai_tpu.engine.
+
+Run: python -m localai_tpu.backend.runner --addr 127.0.0.1:PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.runner")
+
+
+def _sampling_from_predict(opts: pb.PredictOptions):
+    from localai_tpu.engine.sampling import SamplingParamsHost
+
+    return SamplingParamsHost(
+        temperature=opts.temperature,
+        top_k=opts.top_k,
+        top_p=opts.top_p if opts.top_p > 0 else 1.0,
+        min_p=opts.min_p,
+        typical_p=opts.typical_p if opts.typical_p > 0 else 1.0,
+        repeat_penalty=opts.repeat_penalty if opts.repeat_penalty > 0 else 1.0,
+        presence_penalty=opts.presence_penalty,
+        frequency_penalty=opts.frequency_penalty,
+        seed=opts.seed if opts.seed != 0 else -1,
+        logit_bias={int(k): float(v) for k, v in opts.logit_bias.items()},
+    )
+
+
+class EngineServicer(BackendServicer):
+    """LLM serving: LoadModel/Predict/PredictStream/Embedding/Tokenize/
+    Status/GetMetrics on top of the continuous-batching engine."""
+
+    def __init__(self):
+        self.engine = None
+        self.tokenizer = None
+        self.model_cfg = None
+        self._state = pb.StatusResponse.UNINITIALIZED
+        self._load_lock = threading.Lock()
+        self._embed = False
+
+    # ---- lifecycle ----
+
+    def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
+        with self._load_lock:
+            try:
+                self._load(request)
+                self._state = pb.StatusResponse.READY
+                return pb.Result(success=True, message="loaded")
+            except Exception as e:  # surface the error to the core
+                self._state = pb.StatusResponse.ERROR
+                log.exception("LoadModel failed")
+                return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _load(self, request: pb.ModelOptions):
+        import jax
+        import jax.numpy as jnp
+
+        from localai_tpu.engine import engine as eng
+        from localai_tpu.engine import weights
+        from localai_tpu.models import llama
+        from localai_tpu.parallel import mesh as meshlib
+        from localai_tpu.parallel import sharding as shardlib
+
+        model_dir = request.model
+        if request.model_path and not os.path.isabs(model_dir):
+            model_dir = os.path.join(request.model_path, model_dir)
+        dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}.get(
+            request.dtype or "bfloat16", jnp.bfloat16
+        )
+        cfg = llama.LlamaConfig.from_json(os.path.join(model_dir, "config.json"), dtype=dtype)
+
+        n_dev = len(jax.devices())
+        tp = request.mesh_tp or n_dev
+        dp = request.mesh_dp or 1
+        mesh = None
+        if tp * dp > 1:
+            mesh = meshlib.make_mesh(meshlib.MeshPlan(dp=dp, tp=tp),
+                                     devices=jax.devices()[: tp * dp])
+        params = weights.load_llama_params(model_dir, cfg, mesh=mesh, dtype=dtype)
+
+        from transformers import AutoTokenizer
+
+        tok_dir = request.tokenizer or model_dir
+        self.tokenizer = AutoTokenizer.from_pretrained(tok_dir)
+
+        ecfg = eng.EngineConfig(
+            num_slots=request.num_slots or 8,
+            max_context=request.context_size or min(cfg.max_position_embeddings, 4096),
+            prefill_buckets=tuple(request.prefill_buckets) or (32, 128, 512, 2048),
+        )
+        self.model_cfg = cfg
+        self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh)
+        self.engine.start()
+        self._embed = request.embeddings
+
+    # ---- inference ----
+
+    def _build_request(self, opts: pb.PredictOptions):
+        from localai_tpu.engine.engine import GenRequest
+
+        if opts.prompt_ids:
+            ids = list(opts.prompt_ids)
+        else:
+            ids = self.tokenizer.encode(opts.prompt)
+        return GenRequest(
+            prompt_ids=ids,
+            params=_sampling_from_predict(opts),
+            max_new_tokens=opts.max_tokens or 256,
+            stop_sequences=list(opts.stop_sequences),
+            ignore_eos=opts.ignore_eos,
+            request_id=opts.correlation_id or "",
+        )
+
+    def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
+        self._require_ready(context)
+        req = self._build_request(request)
+        text, events = self.engine.generate_text(req)
+        last = events[-1] if events else None
+        if last is not None and last.error:
+            context.abort(grpc.StatusCode.INTERNAL, last.error)
+        if request.echo:
+            text = request.prompt + text
+        return pb.Reply(
+            message=text.encode("utf-8"),
+            tokens=last.completion_tokens if last else 0,
+            prompt_tokens=last.prompt_tokens if last else 0,
+            finish_reason=(last.finish_reason or "") if last else "",
+            timing_prompt_processing=(last.timings or {}).get("prefill_ms", 0.0) if last else 0.0,
+            timing_token_generation=(last.timings or {}).get("decode_tokens_per_s", 0.0) if last else 0.0,
+        )
+
+    def PredictStream(self, request: pb.PredictOptions, context):
+        self._require_ready(context)
+        req = self._build_request(request)
+        out = self.engine.submit(req)
+        while True:
+            ev = out.get()
+            if ev is None:
+                return
+            if not context.is_active():
+                # client cancelled: reference parity is TASK_TYPE_CANCEL
+                # (utils.hpp:53-56); here -> cancel the slot
+                self.engine.cancel(req.request_id)
+                return
+            if ev.error:
+                context.abort(grpc.StatusCode.INTERNAL, ev.error)
+            yield pb.Reply(
+                message=ev.text.encode("utf-8"),
+                token_id=ev.token_id,
+                logprob=ev.logprob,
+                tokens=ev.completion_tokens,
+                prompt_tokens=ev.prompt_tokens,
+                finish_reason=ev.finish_reason or "",
+            )
+
+    def Embedding(self, request: pb.PredictOptions, context) -> pb.EmbeddingResult:
+        self._require_ready(context)
+        if not hasattr(self.engine, "embed"):
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "model not loaded for embeddings")
+        vec = self.engine.embed(request.prompt)
+        return pb.EmbeddingResult(embeddings=[float(x) for x in vec])
+
+    def TokenizeString(self, request: pb.PredictOptions, context) -> pb.TokenizationResponse:
+        if self.tokenizer is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        ids = self.tokenizer.encode(request.prompt)
+        return pb.TokenizationResponse(length=len(ids), tokens=ids)
+
+    # ---- observability ----
+
+    def Status(self, request, context) -> pb.StatusResponse:
+        breakdown = {}
+        total = 0
+        try:
+            import resource
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            breakdown["rss"] = rss
+            total = rss
+        except Exception:
+            pass
+        state = self._state
+        if state == pb.StatusResponse.READY and self.engine and self.engine.num_active > 0:
+            state = pb.StatusResponse.BUSY
+        return pb.StatusResponse(
+            state=state, memory=pb.MemoryUsageData(total=total, breakdown=breakdown)
+        )
+
+    def GetMetrics(self, request, context) -> pb.MetricsResponse:
+        if not self.engine:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        m = self.engine.metrics()
+        return pb.MetricsResponse(
+            tokens_per_second=m["tokens_per_second_active"],
+            tokens_generated=m["total_tokens_generated"],
+            slots_active=m["slots_active"],
+            slots_total=m["slots_total"],
+            queued=m["queued"],
+            uptime_s=m["uptime_s"],
+        )
+
+    def _require_ready(self, context):
+        if self.engine is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    servicer = EngineServicer()
+    server = make_server(servicer, args.addr)
+    server.start()
+    log.info("backend listening on %s", args.addr)
+    print(f"gRPC Server listening at {args.addr}", flush=True)  # readiness marker
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""gRPC plumbing for the backend contract — hand-rolled stubs.
+
+The environment has grpcio + protoc but not grpcio-tools, so instead of
+generated service stubs this module builds client/server bindings from a
+method table using grpc's generic API. Same wire format, less magic.
+
+Parity: reference pkg/grpc/client.go (Go client, one method per RPC) and
+pkg/grpc/server.go (shim letting in-tree backends serve the proto). The
+reference dials a new connection per call (client.go:60 — noted as a wart
+in SURVEY.md); here one channel is created per backend and reused.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from localai_tpu.backend import contract_pb2 as pb
+
+SERVICE = "localai_tpu.Backend"
+
+# name -> (request message, response message, server_streaming)
+METHODS = {
+    "Health": (pb.HealthMessage, pb.Reply, False),
+    "LoadModel": (pb.ModelOptions, pb.Result, False),
+    "Predict": (pb.PredictOptions, pb.Reply, False),
+    "PredictStream": (pb.PredictOptions, pb.Reply, True),
+    "Embedding": (pb.PredictOptions, pb.EmbeddingResult, False),
+    "TokenizeString": (pb.PredictOptions, pb.TokenizationResponse, False),
+    "GenerateImage": (pb.GenerateImageRequest, pb.Result, False),
+    "TTS": (pb.TTSRequest, pb.Result, False),
+    "SoundGeneration": (pb.SoundGenerationRequest, pb.Result, False),
+    "AudioTranscription": (pb.TranscriptRequest, pb.TranscriptResult, False),
+    "Rerank": (pb.RerankRequest, pb.RerankResult, False),
+    "Status": (pb.HealthMessage, pb.StatusResponse, False),
+    "GetMetrics": (pb.MetricsRequest, pb.MetricsResponse, False),
+    "StoresSet": (pb.StoresSetOptions, pb.Result, False),
+    "StoresDelete": (pb.StoresDeleteOptions, pb.Result, False),
+    "StoresGet": (pb.StoresGetOptions, pb.StoresGetResult, False),
+    "StoresFind": (pb.StoresFindOptions, pb.StoresFindResult, False),
+}
+
+
+class BackendServicer:
+    """Base servicer: every RPC answers UNIMPLEMENTED unless overridden.
+
+    Concrete backends (engine runner, fake echo, store backend) override
+    the subset they support — mirrors the reference's base backend
+    (pkg/grpc/base/base.go:16 'Unimplemented' pattern).
+    """
+
+    def Health(self, request, context) -> pb.Reply:
+        return pb.Reply(message=b"OK")
+
+    def __getattr__(self, name):
+        if name in METHODS:
+            def _unimplemented(request, context):
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, f"{name} not implemented")
+            return _unimplemented
+        raise AttributeError(name)
+
+
+def make_server(servicer: BackendServicer, addr: str, max_workers: int = 16,
+                options: Optional[list] = None) -> grpc.Server:
+    """Build (not start) a grpc server for the contract bound to addr."""
+    handlers = {}
+    for name, (req_cls, resp_cls, streaming) in METHODS.items():
+        fn = getattr(servicer, name)
+        if streaming:
+            h = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+        else:
+            h = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+        handlers[name] = h
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=options or [
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ],
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    server.add_insecure_port(addr)
+    return server
+
+
+class BackendClient:
+    """Typed client over one reusable channel.
+
+    `parallel=False` serializes Predict* calls with a lock, matching the
+    reference's opMutex behavior for backends that cannot batch
+    (pkg/grpc/client.go:15-22).
+    """
+
+    def __init__(self, addr: str, parallel: bool = True):
+        self.addr = addr
+        self.parallel = parallel
+        self._lock = threading.Lock()
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ],
+        )
+        self._stubs = {}
+        for name, (req_cls, resp_cls, streaming) in METHODS.items():
+            path = f"/{SERVICE}/{name}"
+            if streaming:
+                self._stubs[name] = self._channel.unary_stream(
+                    path, request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString)
+            else:
+                self._stubs[name] = self._channel.unary_unary(
+                    path, request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString)
+
+    def close(self):
+        self._channel.close()
+
+    def _maybe_locked(self):
+        class _NullCtx:
+            def __enter__(self): return None
+            def __exit__(self, *a): return False
+        return self._lock if not self.parallel else _NullCtx()
+
+    # --- typed wrappers ---
+    def health(self, timeout: float = 5.0) -> bool:
+        # wait_for_ready rides out gRPC's reconnect backoff while a spawned
+        # backend is still importing — without it, fail-fast probes and the
+        # backoff schedule can interleave so health never observes readiness.
+        try:
+            r = self._stubs["Health"](pb.HealthMessage(), timeout=timeout,
+                                      wait_for_ready=True)
+            return r.message == b"OK"
+        except grpc.RpcError:
+            return False
+
+    def load_model(self, opts: pb.ModelOptions, timeout: float = 900.0) -> pb.Result:
+        return self._stubs["LoadModel"](opts, timeout=timeout)
+
+    def predict(self, opts: pb.PredictOptions, timeout: float = 600.0) -> pb.Reply:
+        with self._maybe_locked():
+            return self._stubs["Predict"](opts, timeout=timeout)
+
+    def predict_stream(self, opts: pb.PredictOptions, timeout: float = 600.0) -> Iterator[pb.Reply]:
+        with self._maybe_locked():
+            yield from self._stubs["PredictStream"](opts, timeout=timeout)
+
+    def embedding(self, opts: pb.PredictOptions, timeout: float = 120.0) -> pb.EmbeddingResult:
+        return self._stubs["Embedding"](opts, timeout=timeout)
+
+    def tokenize(self, opts: pb.PredictOptions, timeout: float = 60.0) -> pb.TokenizationResponse:
+        return self._stubs["TokenizeString"](opts, timeout=timeout)
+
+    def generate_image(self, req: pb.GenerateImageRequest, timeout: float = 600.0) -> pb.Result:
+        return self._stubs["GenerateImage"](req, timeout=timeout)
+
+    def tts(self, req: pb.TTSRequest, timeout: float = 600.0) -> pb.Result:
+        return self._stubs["TTS"](req, timeout=timeout)
+
+    def sound_generation(self, req: pb.SoundGenerationRequest, timeout: float = 600.0) -> pb.Result:
+        return self._stubs["SoundGeneration"](req, timeout=timeout)
+
+    def transcribe(self, req: pb.TranscriptRequest, timeout: float = 600.0) -> pb.TranscriptResult:
+        return self._stubs["AudioTranscription"](req, timeout=timeout)
+
+    def rerank(self, req: pb.RerankRequest, timeout: float = 120.0) -> pb.RerankResult:
+        return self._stubs["Rerank"](req, timeout=timeout)
+
+    def status(self, timeout: float = 10.0) -> pb.StatusResponse:
+        return self._stubs["Status"](pb.HealthMessage(), timeout=timeout)
+
+    def get_metrics(self, timeout: float = 10.0) -> pb.MetricsResponse:
+        return self._stubs["GetMetrics"](pb.MetricsRequest(), timeout=timeout)
+
+    def stores_set(self, req: pb.StoresSetOptions, timeout: float = 60.0) -> pb.Result:
+        return self._stubs["StoresSet"](req, timeout=timeout)
+
+    def stores_delete(self, req: pb.StoresDeleteOptions, timeout: float = 60.0) -> pb.Result:
+        return self._stubs["StoresDelete"](req, timeout=timeout)
+
+    def stores_get(self, req: pb.StoresGetOptions, timeout: float = 60.0) -> pb.StoresGetResult:
+        return self._stubs["StoresGet"](req, timeout=timeout)
+
+    def stores_find(self, req: pb.StoresFindOptions, timeout: float = 60.0) -> pb.StoresFindResult:
+        return self._stubs["StoresFind"](req, timeout=timeout)

@@ -1,0 +1,85 @@
+"""Application configuration.
+
+Parity with the reference's ApplicationConfig + env/flag tiers (reference:
+core/config/application_config.go, core/cli/run.go:19-74 — every flag has
+env aliases, old LOCALAI_* and new names both accepted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(*names, default=None, cast=str):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            if cast is bool:
+                return v.lower() in ("1", "true", "yes", "on")
+            return cast(v)
+    return default
+
+
+@dataclasses.dataclass
+class AppConfig:
+    models_path: str = "models"
+    backend_assets_path: str = ""
+    address: str = "127.0.0.1:8080"
+    api_keys: list = dataclasses.field(default_factory=list)
+    cors: bool = True
+    cors_allow_origins: str = "*"
+    threads: int = 4
+    context_size: int = 2048
+    upload_limit_mb: int = 15
+    single_active_backend: bool = False
+    parallel_requests: bool = True
+    preload_models: list = dataclasses.field(default_factory=list)
+    galleries: list = dataclasses.field(default_factory=list)
+    autoload_galleries: bool = True
+    enable_watchdog_idle: bool = False
+    enable_watchdog_busy: bool = False
+    watchdog_idle_timeout_s: int = 900
+    watchdog_busy_timeout_s: int = 300
+    disable_metrics_endpoint: bool = False
+    disable_webui: bool = False
+    log_level: str = "info"
+    dynamic_config_dir: str = ""
+    uploads_path: str = "uploads"
+    config_path: str = "configuration"
+    # TPU-native
+    mesh_tp: int = 0                  # 0 => all devices
+    mesh_dp: int = 1
+    load_to_memory: list = dataclasses.field(default_factory=list)  # warmup models
+
+    @staticmethod
+    def from_env(**overrides) -> "AppConfig":
+        c = AppConfig(
+            models_path=_env("LOCALAI_MODELS_PATH", "MODELS_PATH", default="models"),
+            address=_env("LOCALAI_ADDRESS", "ADDRESS", default="127.0.0.1:8080"),
+            threads=_env("LOCALAI_THREADS", "THREADS", default=4, cast=int),
+            context_size=_env("LOCALAI_CONTEXT_SIZE", "CONTEXT_SIZE", default=2048, cast=int),
+            upload_limit_mb=_env("LOCALAI_UPLOAD_LIMIT", "UPLOAD_LIMIT", default=15, cast=int),
+            single_active_backend=_env("LOCALAI_SINGLE_ACTIVE_BACKEND", "SINGLE_ACTIVE_BACKEND",
+                                       default=False, cast=bool),
+            parallel_requests=_env("LOCALAI_PARALLEL_REQUESTS", "PARALLEL_REQUESTS",
+                                   default=True, cast=bool),
+            enable_watchdog_idle=_env("LOCALAI_WATCHDOG_IDLE", "WATCHDOG_IDLE",
+                                      default=False, cast=bool),
+            enable_watchdog_busy=_env("LOCALAI_WATCHDOG_BUSY", "WATCHDOG_BUSY",
+                                      default=False, cast=bool),
+            disable_metrics_endpoint=_env("LOCALAI_DISABLE_METRICS", default=False, cast=bool),
+            disable_webui=_env("LOCALAI_DISABLE_WEBUI", "DISABLE_WEBUI", default=False, cast=bool),
+            log_level=_env("LOCALAI_LOG_LEVEL", default="info"),
+            dynamic_config_dir=_env("LOCALAI_CONFIG_DIR", default=""),
+            mesh_tp=_env("LOCALAI_MESH_TP", default=0, cast=int),
+            mesh_dp=_env("LOCALAI_MESH_DP", default=1, cast=int),
+        )
+        keys = _env("LOCALAI_API_KEY", "API_KEY", default="")
+        if keys:
+            c.api_keys = [k.strip() for k in keys.split(",") if k.strip()]
+        for k, v in overrides.items():
+            if v is not None and hasattr(c, k):
+                setattr(c, k, v)
+        return c

@@ -1,0 +1,237 @@
+"""Per-model YAML configuration.
+
+Parity with the reference's BackendConfig (reference:
+core/config/backend_config.go:28-548): model name, backend selection,
+sampling parameter defaults, prompt templates, context/cache knobs,
+function-calling config, and usecase flags used for routing. Knobs that
+only make sense for CUDA llama.cpp (NUMA, mmap, tensor_split fractions,
+gpu layers) are intentionally absent — the TPU equivalents (mesh plan,
+dtype, cache size) replace them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from typing import Any, Optional
+
+import yaml
+
+
+class Usecase(enum.Flag):
+    """Routing flags (reference: backend_config.go:432-548)."""
+    NONE = 0
+    CHAT = enum.auto()
+    COMPLETION = enum.auto()
+    EDIT = enum.auto()
+    EMBEDDINGS = enum.auto()
+    IMAGE = enum.auto()
+    TTS = enum.auto()
+    TRANSCRIPT = enum.auto()
+    RERANK = enum.auto()
+    SOUND_GENERATION = enum.auto()
+    TOKENIZE = enum.auto()
+    VISION = enum.auto()
+    ANY = (CHAT | COMPLETION | EDIT | EMBEDDINGS | IMAGE | TTS | TRANSCRIPT
+           | RERANK | SOUND_GENERATION | TOKENIZE | VISION)
+
+
+@dataclasses.dataclass
+class PredictionParams:
+    """Sampling defaults (reference: core/schema/prediction.go)."""
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    min_p: Optional[float] = None
+    typical_p: Optional[float] = None
+    max_tokens: Optional[int] = None
+    repeat_penalty: Optional[float] = None
+    repeat_last_n: Optional[int] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    echo: bool = False
+    n: int = 1
+    logit_bias: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TemplateConfig:
+    """Prompt templates (reference: backend_config.go TemplateConfig)."""
+    chat: str = ""
+    chat_message: str = ""
+    completion: str = ""
+    edit: str = ""
+    function: str = ""
+    use_tokenizer_template: bool = False
+    join_chat_messages_by_character: Optional[str] = None
+    multimodal: str = ""
+
+
+@dataclasses.dataclass
+class FunctionsConfig:
+    """Tool-calling behavior (reference: pkg/functions/parse.go:54-90)."""
+    disable_no_action: bool = False
+    no_action_function_name: str = "answer"
+    no_action_description_name: str = ""
+    function_name_key: str = "name"
+    function_arguments_key: str = "arguments"
+    response_regex: list = dataclasses.field(default_factory=list)
+    json_regex_match: list = dataclasses.field(default_factory=list)
+    replace_function_results: list = dataclasses.field(default_factory=list)
+    replace_llm_results: list = dataclasses.field(default_factory=list)
+    capture_llm_results: list = dataclasses.field(default_factory=list)
+    grammar: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = ""
+    backend: str = ""                 # "" => greedy autodetect
+    description: str = ""
+    usage: str = ""
+    parameters: PredictionParams = dataclasses.field(default_factory=PredictionParams)
+    model: str = ""                   # weights path / HF repo / URL
+    tokenizer: str = ""               # defaults to model dir
+    context_size: Optional[int] = None
+    embeddings: bool = False
+    stopwords: list = dataclasses.field(default_factory=list)
+    template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)
+    function: FunctionsConfig = dataclasses.field(default_factory=FunctionsConfig)
+    system_prompt: str = ""
+    # TPU-native knobs (replace gpu_layers/tensor_split/low_vram/...)
+    dtype: str = "bfloat16"
+    kv_cache_dtype: str = "bfloat16"
+    num_slots: int = 8                # reference: LLAMACPP_PARALLEL slots
+    mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
+    prefill_buckets: list = dataclasses.field(default_factory=list)
+    max_batch_prefill: int = 1
+    # capability routing
+    known_usecases: Optional[list] = None
+    # download source for `model` when it is a URL/hf repo
+    download_files: list = dataclasses.field(default_factory=list)
+    # multimodal
+    mmproj: str = ""
+    # speculative decoding (future)
+    draft_model: str = ""
+
+    def validate(self) -> list:
+        problems = []
+        if not self.name:
+            problems.append("model config missing 'name'")
+        if self.context_size is not None and self.context_size <= 0:
+            problems.append(f"context_size must be positive, got {self.context_size}")
+        if self.num_slots <= 0:
+            problems.append(f"num_slots must be positive, got {self.num_slots}")
+        return problems
+
+    def usecases(self) -> Usecase:
+        if self.known_usecases:
+            u = Usecase.NONE
+            for name in self.known_usecases:
+                u |= Usecase[name.upper()]
+            return u
+        # heuristics mirroring reference GuessUsecases (backend_config.go:432)
+        u = Usecase.CHAT | Usecase.COMPLETION | Usecase.EDIT | Usecase.TOKENIZE
+        if self.embeddings:
+            u |= Usecase.EMBEDDINGS
+        if self.mmproj:
+            u |= Usecase.VISION
+        name = (self.backend or "").lower()
+        if "diffus" in name or "image" in name:
+            u = Usecase.IMAGE
+        if "tts" in name or "bark" in name or "coqui" in name:
+            u = Usecase.TTS
+        if "whisper" in name:
+            u = Usecase.TRANSCRIPT
+        if "rerank" in name:
+            u = Usecase.RERANK
+        if self.embeddings and "bert" in name:
+            u = Usecase.EMBEDDINGS | Usecase.TOKENIZE
+        return u
+
+    def sampling_host(self, request_overrides: Optional[dict] = None):
+        """Merge config defaults + request overrides into engine params."""
+        from localai_tpu.engine.sampling import SamplingParamsHost
+
+        p = self.parameters
+        merged = {
+            "temperature": p.temperature if p.temperature is not None else 0.8,
+            "top_k": p.top_k if p.top_k is not None else 40,
+            "top_p": p.top_p if p.top_p is not None else 0.95,
+            "min_p": p.min_p if p.min_p is not None else 0.0,
+            "typical_p": p.typical_p if p.typical_p is not None else 1.0,
+            "repeat_penalty": p.repeat_penalty if p.repeat_penalty is not None else 1.0,
+            "presence_penalty": p.presence_penalty or 0.0,
+            "frequency_penalty": p.frequency_penalty or 0.0,
+            "seed": p.seed if p.seed is not None else -1,
+            "logit_bias": dict(p.logit_bias or {}),
+        }
+        for k, v in (request_overrides or {}).items():
+            if v is not None and k in merged:
+                merged[k] = v
+        return SamplingParamsHost(**merged)
+
+
+def _build(data: dict) -> ModelConfig:
+    data = dict(data)
+    params = data.pop("parameters", {}) or {}
+    # reference keeps model under parameters.model
+    model = params.pop("model", "") or data.pop("model", "")
+    tmpl = data.pop("template", {}) or {}
+    func = data.pop("function", {}) or {}
+    known_params = {f.name for f in dataclasses.fields(PredictionParams)}
+    known_tmpl = {f.name for f in dataclasses.fields(TemplateConfig)}
+    known_func = {f.name for f in dataclasses.fields(FunctionsConfig)}
+    known_top = {f.name for f in dataclasses.fields(ModelConfig)}
+    mc = ModelConfig(
+        parameters=PredictionParams(**{k: v for k, v in params.items() if k in known_params}),
+        template=TemplateConfig(**{k: v for k, v in tmpl.items() if k in known_tmpl}),
+        function=FunctionsConfig(**{k: v for k, v in func.items() if k in known_func}),
+        **{k: v for k, v in data.items() if k in known_top
+           and k not in ("parameters", "template", "function")},
+    )
+    mc.model = model
+    return mc
+
+
+def load_model_config(path: str) -> ModelConfig:
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a mapping")
+    mc = _build(data)
+    if not mc.name:
+        mc.name = os.path.splitext(os.path.basename(path))[0]
+    return mc
+
+
+def load_multi_config(path: str) -> list:
+    """Single file with a list of model configs (reference:
+    LoadMultipleBackendConfigsSingleFile)."""
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: expected a list of model configs")
+    return [_build(d) for d in data]
+
+
+def scan_models_dir(models_path: str) -> dict:
+    """Scan for per-model .yaml files (reference: LoadBackendConfigsFromPath)."""
+    configs = {}
+    if not os.path.isdir(models_path):
+        return configs
+    for fn in sorted(os.listdir(models_path)):
+        if not fn.endswith((".yaml", ".yml")) or fn.startswith("."):
+            continue
+        try:
+            mc = load_model_config(os.path.join(models_path, fn))
+            problems = mc.validate()
+            if problems:
+                raise ValueError("; ".join(problems))
+            configs[mc.name] = mc
+        except Exception as e:  # mirror reference: log and skip broken configs
+            import logging
+            logging.getLogger(__name__).warning("skipping %s: %s", fn, e)
+    return configs

@@ -1,0 +1,58 @@
+"""Incremental, UTF-8-safe detokenization for streaming.
+
+The reference reassembles UTF-8 runes across stream chunks on the Go side
+(reference: core/backend/llm.go:133-149). Here the same concern is solved at
+the source: tokens decode incrementally with a two-offset scheme and text is
+only released at UTF-8-complete boundaries, so every SSE chunk is valid text.
+"""
+
+from __future__ import annotations
+
+
+class IncrementalDetokenizer:
+    """Decode a growing token-id sequence, emitting only finalized deltas.
+
+    Two-offset algorithm: ``prefix_offset`` marks the start of the decode
+    window (kept a few tokens behind so byte-merging tokenizers see their
+    context), ``read_offset`` marks how far text has been emitted. Text
+    ending in U+FFFD (incomplete multibyte) is withheld until completed.
+    """
+
+    def __init__(self, tokenizer, skip_special_tokens: bool = True):
+        self.tokenizer = tokenizer
+        self.skip_special = skip_special_tokens
+        self.ids: list[int] = []
+        self.prefix_offset = 0
+        self.read_offset = 0
+        self._text = ""  # total emitted text
+
+    def _decode(self, ids) -> str:
+        if not ids:
+            return ""
+        return self.tokenizer.decode(ids, skip_special_tokens=self.skip_special)
+
+    def push(self, token_id: int) -> str:
+        """Add one token; return the newly finalized text delta (maybe "")."""
+        self.ids.append(token_id)
+        prefix_text = self._decode(self.ids[self.prefix_offset : self.read_offset])
+        full_text = self._decode(self.ids[self.prefix_offset :])
+        if full_text.endswith("�"):
+            return ""
+        delta = full_text[len(prefix_text) :]
+        self.prefix_offset = self.read_offset
+        self.read_offset = len(self.ids)
+        self._text += delta
+        return delta
+
+    def flush(self) -> str:
+        """Emit any withheld tail (drops a trailing incomplete sequence)."""
+        prefix_text = self._decode(self.ids[self.prefix_offset : self.read_offset])
+        full_text = self._decode(self.ids[self.prefix_offset :])
+        delta = full_text[len(prefix_text) :].rstrip("�")
+        self.read_offset = len(self.ids)
+        self._text += delta
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._text

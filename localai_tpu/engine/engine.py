@@ -1,0 +1,499 @@
+"""The TPU serving engine: continuous batching over a compiled decode step.
+
+Re-design of the reference's slot-based continuous-batching server
+(reference: backend/cpp/llama/grpc-server.cpp — llama_client_slot :162-301,
+task queue utils.hpp:192,336, update_slots hot loop :1578-2013) for XLA's
+compilation model:
+
+  * The decode step is ONE jitted function over ALL slots, compiled once —
+    inactive slots ride along masked (static shapes, no recompiles).
+  * Prefill is jitted per padded length bucket (powers of two), so any
+    prompt length hits a warm compile after the first request of its size.
+  * Sampling (full per-slot parameter suite) and the penalty-histogram
+    update are fused INTO the compiled steps — no per-token host round-trip
+    for anything but the sampled ids themselves.
+  * Admission/stop logic runs host-side on a dedicated engine thread,
+    mirroring the reference's queue thread (grpc-server.cpp:2083-2096).
+
+Invariants enforced here for the model layer (see models/llama.py):
+prompts are truncated to fit the cache; a slot finishes with reason
+"length" before lengths[s] can reach cache capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.engine import sampling
+from localai_tpu.engine.detok import IncrementalDetokenizer
+from localai_tpu.models import llama
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    num_slots: int = 8
+    max_context: int = 2048
+    prefill_buckets: tuple = (32, 128, 512, 2048)
+    cache_dtype: Any = jnp.bfloat16
+
+
+@dataclasses.dataclass
+class GenRequest:
+    prompt_ids: list
+    params: sampling.SamplingParamsHost = dataclasses.field(
+        default_factory=sampling.SamplingParamsHost
+    )
+    max_new_tokens: int = 256
+    stop_sequences: list = dataclasses.field(default_factory=list)
+    ignore_eos: bool = False
+    request_id: str = ""
+    # filled by engine:
+    out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
+
+    def __post_init__(self):
+        if not self.request_id:
+            self.request_id = uuid.uuid4().hex[:16]
+        if self.out is None:
+            self.out = queue.Queue()
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    token_id: int
+    text: str               # finalized delta (may be "")
+    logprob: float
+    finish_reason: Optional[str] = None  # "stop" | "length" | None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    timings: Optional[dict] = None
+    error: Optional[str] = None
+
+
+class _Slot:
+    __slots__ = (
+        "req", "detok", "generated", "held_text", "prompt_len",
+        "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
+    )
+
+    def __init__(self, req: GenRequest, detok, prompt_len: int):
+        self.req = req
+        self.detok = detok
+        self.generated: list[int] = []
+        self.held_text = ""   # text withheld due to partial stop-seq match
+        self.prompt_len = prompt_len
+        self.t_start = time.monotonic()
+        self.t_first_token = 0.0
+        self.n_decoded = 0
+        self.t_prefill_ms = 0.0
+
+
+class Engine:
+    """Owns the model state and a background step-loop thread."""
+
+    def __init__(
+        self,
+        model_cfg: llama.LlamaConfig,
+        params,
+        tokenizer,
+        engine_cfg: EngineConfig = None,
+        eos_token_ids: Optional[set] = None,
+        mesh=None,
+        param_shardings=None,
+    ):
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg or EngineConfig()
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        S = self.ecfg.num_slots
+        C = self.ecfg.max_context
+        V = model_cfg.vocab_size
+
+        self.params = params
+        self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
+        self.slot_params = sampling.make_slot_params(S)
+        self.counts = jnp.zeros((S, V), jnp.int32)
+        self.bias = jnp.zeros((S, V), jnp.float32)
+        self.rng_keys = jax.vmap(jax.random.key_data)(
+            jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
+        )
+        self.lengths = jnp.zeros((S,), jnp.int32)
+        self.cur_tokens = jnp.zeros((S,), jnp.int32)
+        self.active_dev = jnp.zeros((S,), jnp.bool_)
+
+        if eos_token_ids:
+            self.eos_ids = set(eos_token_ids)
+        else:
+            self.eos_ids = set()
+            eid = getattr(tokenizer, "eos_token_id", None)
+            if eid is not None:
+                self.eos_ids.add(int(eid))
+
+        # host mirrors
+        self.slots: list[Optional[_Slot]] = [None] * S
+        self._cancelled: set = set()
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._load_time = time.monotonic()
+        self._total_tokens = 0
+
+        self._decode_fn = jax.jit(self._decode_and_sample, donate_argnums=(2, 3, 5, 7))
+        self._prefill_fns: dict[int, Callable] = {}
+
+    # ---------- jitted step bodies ----------
+
+    def _decode_and_sample(self, params, tokens, ck, cv, lengths, counts, bias, keys,
+                           slot_params, active):
+        logits, ck, cv = llama.decode_step(params, self.cfg, tokens, lengths, ck, cv)
+        ids, logprobs, keys = sampling.sample(logits, slot_params, counts, bias, keys)
+        counts = sampling.update_token_counts(counts, ids, active)
+        lengths = lengths + active.astype(jnp.int32)
+        return ids, logprobs, ck, cv, lengths, counts, keys
+
+    def _prefill_and_sample(self, params, tokens, seq_len, ck, cv, slot, counts, bias,
+                            keys, slot_params):
+        """tokens [1, T]; slot [1] int32. Samples the first token for the slot."""
+        logits, ck, cv = llama.prefill(
+            params, self.cfg, tokens, seq_len, ck, cv, slot,
+            jnp.zeros_like(slot),
+        )
+        # record prompt tokens into the penalty histogram for this slot
+        T = tokens.shape[1]
+        valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_len[:, None]
+        row = jnp.zeros((self.cfg.vocab_size,), jnp.int32).at[tokens[0]].add(
+            valid[0].astype(jnp.int32)
+        )
+        counts = counts.at[slot[0]].set(row)
+        # gather this slot's sampling state, sample one token, scatter back
+        sp_row = jax.tree.map(lambda a: jnp.take(a, slot, axis=0), slot_params)
+        bias_row = jnp.take(bias, slot, axis=0)
+        key_row = jnp.take(keys, slot, axis=0)
+        counts_row = jnp.take(counts, slot, axis=0)
+        ids, logprobs, new_key = sampling.sample(logits, sp_row, counts_row, bias_row, key_row)
+        counts = counts.at[slot[0], ids[0]].add(1)
+        keys = keys.at[slot[0]].set(new_key[0])
+        return ids, logprobs, ck, cv, counts, keys
+
+    def _get_prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_and_sample, donate_argnums=(3, 4, 6, 8))
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    # ---------- public API ----------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._stop = True
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        # close every consumer: queued requests and still-active slots
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put(StreamEvent(token_id=-1, text="", logprob=0.0,
+                                    finish_reason="stop", error="engine shut down"))
+            req.out.put(None)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                self.slots[i] = None
+                s.req.out.put(StreamEvent(token_id=-1, text="", logprob=0.0,
+                                          finish_reason="stop", error="engine shut down"))
+                s.req.out.put(None)
+
+    def _reset_device_state(self):
+        S = self.ecfg.num_slots
+        V = self.cfg.vocab_size
+        self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
+                                            self.ecfg.cache_dtype)
+        self.counts = jnp.zeros((S, V), jnp.int32)
+        self.bias = jnp.zeros((S, V), jnp.float32)
+        self.rng_keys = jax.vmap(jax.random.key_data)(
+            jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
+        )
+        self.lengths = jnp.zeros((S,), jnp.int32)
+        self.cur_tokens = jnp.zeros((S,), jnp.int32)
+        self.active_dev = jnp.zeros((S,), jnp.bool_)
+        self.slot_params = sampling.make_slot_params(S)
+
+    def submit(self, req: GenRequest) -> "queue.Queue":
+        self._queue.put(req)
+        self._wake.set()
+        return req.out
+
+    def cancel(self, request_id: str):
+        """Cancel a queued or running request (reference parity:
+        TASK_TYPE_CANCEL, utils.hpp:53-56). The slot is released at the
+        next step boundary; a None sentinel closes the output queue."""
+        self._cancelled.add(request_id)
+        self._wake.set()
+
+    def generate(self, req: GenRequest) -> Iterator[StreamEvent]:
+        """Synchronous streaming helper."""
+        out = self.submit(req)
+        while True:
+            ev = out.get()
+            if ev is None:
+                return
+            yield ev
+
+    def generate_text(self, req: GenRequest) -> tuple[str, list[StreamEvent]]:
+        events = list(self.generate(req))
+        return "".join(e.text for e in events), events
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def metrics(self) -> dict:
+        """Parity with the reference's GetMetrics RPC (grpc-server.cpp:2465)."""
+        active = [s for s in self.slots if s is not None]
+        tok_s = 0.0
+        for s in active:
+            dt = time.monotonic() - (s.t_first_token or s.t_start)
+            if s.n_decoded and dt > 0:
+                tok_s += s.n_decoded / dt
+        return {
+            "slots_total": self.ecfg.num_slots,
+            "slots_active": len(active),
+            "queued": self._queue.qsize(),
+            "total_tokens_generated": self._total_tokens,
+            "tokens_per_second_active": tok_s,
+            "uptime_s": time.monotonic() - self._load_time,
+        }
+
+    # ---------- engine loop ----------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.ecfg.prefill_buckets[-1]
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _run(self):
+        import logging
+
+        log = logging.getLogger(__name__)
+        while not self._stop:
+            try:
+                admitted = self._admit()
+                if self.num_active == 0:
+                    if not admitted:
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
+                    continue
+                self._decode_once()
+            except Exception as e:  # never let the loop die: fail active requests
+                log.exception("engine step failed")
+                for i, s in enumerate(self.slots):
+                    if s is not None:
+                        s.req.out.put(StreamEvent(
+                            token_id=-1, text="", logprob=0.0,
+                            finish_reason="stop", error=f"{type(e).__name__}: {e}",
+                        ))
+                        s.req.out.put(None)
+                        self._release_slot(i)
+                # a failure inside a donated jitted call leaves ck/cv/counts/
+                # keys pointing at deleted buffers — reinitialize device state
+                # so the engine survives instead of erroring forever
+                try:
+                    self._reset_device_state()
+                except Exception:
+                    log.exception("device state reset failed; engine unusable")
+                    self._stop = True
+
+    def _admit(self) -> bool:
+        self._reap_cancelled()
+        admitted = False
+        while not self._queue.empty():
+            slot = self._free_slot()
+            if slot is None:
+                break
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req.request_id in self._cancelled:
+                self._cancelled.discard(req.request_id)
+                req.out.put(None)
+                continue
+            try:
+                self._start_request(slot, req)
+                admitted = True
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).exception("prefill failed")
+                if self.slots[slot] is not None:
+                    self._release_slot(slot)
+                req.out.put(StreamEvent(
+                    token_id=-1, text="", logprob=0.0, finish_reason="stop",
+                    error=f"{type(e).__name__}: {e}",
+                ))
+                req.out.put(None)
+        return admitted
+
+    def _reap_cancelled(self):
+        if not self._cancelled:
+            return
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req.request_id in self._cancelled:
+                self._cancelled.discard(s.req.request_id)
+                self._release_slot(i)
+                s.req.out.put(None)
+
+    def _start_request(self, slot: int, req: GenRequest):
+        C = self.ecfg.max_context
+        ids = list(req.prompt_ids)
+        # truncate the prompt head, keeping the tail (reference semantics:
+        # grpc-server.cpp prompt truncation keeps the last part of the prompt);
+        # also bounded by the largest prefill bucket until chunked prefill lands
+        max_prompt = min(
+            C - 1 - min(req.max_new_tokens, C // 4),
+            max(self.ecfg.prefill_buckets),
+        )
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        if not ids:
+            ids = [self.tokenizer.eos_token_id or 0]
+        T = len(ids)
+        bucket = self._bucket_for(T)
+        t0 = time.monotonic()
+
+        # install sampling state for the slot
+        self.slot_params = sampling.set_slot(self.slot_params, slot, req.params)
+        self.rng_keys = sampling.seed_slot_key(
+            self.rng_keys, slot, req.params, fallback_seed=hash(req.request_id) & 0x7FFFFFFF
+        )
+        self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
+
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :T] = ids
+        fn = self._get_prefill_fn(bucket)
+        out_ids, logprobs, self.ck, self.cv, self.counts, self.rng_keys = fn(
+            self.params, jnp.asarray(tokens), jnp.array([T], jnp.int32),
+            self.ck, self.cv, jnp.array([slot], jnp.int32),
+            self.counts, self.bias, self.rng_keys, self.slot_params,
+        )
+        first_id = int(np.asarray(out_ids)[0])
+        first_lp = float(np.asarray(logprobs)[0])
+        t1 = time.monotonic()
+
+        self.lengths = self.lengths.at[slot].set(T)
+        self.cur_tokens = self.cur_tokens.at[slot].set(first_id)
+        self.active_dev = self.active_dev.at[slot].set(True)
+
+        s = _Slot(req, IncrementalDetokenizer(self.tokenizer), T)
+        s.t_prefill_ms = (t1 - t0) * 1e3
+        s.t_first_token = t1
+        self.slots[slot] = s
+        self._emit_token(slot, first_id, first_lp)
+
+    def _decode_once(self):
+        (ids, logprobs, self.ck, self.cv, self.lengths, self.counts,
+         self.rng_keys) = self._decode_fn(
+            self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
+            self.counts, self.bias, self.rng_keys, self.slot_params, self.active_dev,
+        )
+        self.cur_tokens = ids
+        ids_np = np.asarray(ids)
+        lps_np = np.asarray(logprobs)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                self._emit_token(i, int(ids_np[i]), float(lps_np[i]))
+
+    def _emit_token(self, slot: int, token_id: int, logprob: float):
+        s = self.slots[slot]
+        s.generated.append(token_id)
+        s.n_decoded += 1
+        self._total_tokens += 1
+        finish = None
+
+        if token_id in self.eos_ids and not s.req.ignore_eos:
+            finish = "stop"
+            delta = s.held_text + s.detok.flush()
+        elif s.n_decoded >= s.req.max_new_tokens:
+            finish = "length"
+            delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
+        elif s.prompt_len + s.n_decoded >= self.ecfg.max_context - 1:
+            finish = "length"
+            delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
+        else:
+            delta = s.held_text + s.detok.push(token_id)
+            s.held_text = ""
+            # stop-sequence handling with partial-match holdback
+            if s.req.stop_sequences:
+                cut = self._check_stops(s, delta)
+                if cut is not None:
+                    delta, finish = cut, "stop"
+                elif delta:
+                    delta, s.held_text = self._holdback(s, delta)
+
+        ev = StreamEvent(
+            token_id=token_id, text=delta, logprob=logprob,
+            finish_reason=finish,
+            prompt_tokens=s.prompt_len, completion_tokens=s.n_decoded,
+        )
+        if finish:
+            dt = time.monotonic() - s.t_first_token
+            ev.timings = {
+                "prefill_ms": s.t_prefill_ms,
+                "decode_tokens_per_s": (s.n_decoded - 1) / dt if dt > 0 and s.n_decoded > 1 else 0.0,
+            }
+            self._release_slot(slot)
+            s.req.out.put(ev)
+            s.req.out.put(None)
+        else:
+            s.req.out.put(ev)
+
+    def _check_stops(self, s: _Slot, delta: str) -> Optional[str]:
+        """If a stop sequence completes in emitted+delta text, return the
+        delta truncated before the stop; else None."""
+        total = s.detok.text  # includes delta already
+        for stop in s.req.stop_sequences:
+            idx = total.find(stop, max(0, len(total) - len(delta) - len(stop)))
+            if idx != -1:
+                emitted_before = len(total) - len(delta)
+                return delta[: max(0, idx - emitted_before)]
+        return None
+
+    def _holdback(self, s: _Slot, delta: str) -> tuple[str, str]:
+        """Withhold a suffix of delta that is a prefix of any stop sequence."""
+        total = s.detok.text
+        hold = 0
+        for stop in s.req.stop_sequences:
+            for k in range(min(len(stop) - 1, len(total)), 0, -1):
+                if total.endswith(stop[:k]):
+                    hold = max(hold, min(k, len(delta)))
+                    break
+        if hold:
+            return delta[:-hold], delta[-hold:]
+        return delta, ""
+
+    def _release_slot(self, slot: int):
+        self.slots[slot] = None
+        self.active_dev = self.active_dev.at[slot].set(False)
+        self.lengths = self.lengths.at[slot].set(0)

@@ -1,0 +1,131 @@
+"""HF safetensors checkpoint -> stacked JAX param pytree.
+
+Replaces the reference's GGUF weight pipeline (llama.cpp model loading +
+core/config/guesser.go GGUF header parsing) with the TPU-native flow:
+HF safetensors shards are memory-mapped, per-layer tensors are stacked on
+a leading layer axis (for the scan-over-layers forward), cast to bf16, and
+placed shard-by-shard onto the device mesh so peak host memory stays at
+one tensor, not one model.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from safetensors import safe_open
+except ImportError:  # pragma: no cover
+    safe_open = None
+
+
+def _open_shards(model_dir: str):
+    """Yield (name -> shard accessor) across all safetensors files."""
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+    handles = {f: safe_open(f, framework="np") for f in files}
+    name_to_file = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        for name, fname in index["weight_map"].items():
+            name_to_file[name] = handles[os.path.join(model_dir, fname)]
+    else:
+        for f, h in handles.items():
+            for name in h.keys():
+                name_to_file[name] = h
+    return name_to_file
+
+
+def load_llama_params(
+    model_dir: str,
+    cfg,
+    mesh=None,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Load HF llama/mistral/qwen2-style weights into the stacked pytree.
+
+    When ``mesh`` is given, each leaf is placed with the tensor-parallel
+    sharding from parallel/sharding.py as it is assembled.
+    """
+    tensors = _open_shards(model_dir)
+
+    def get(name: str) -> np.ndarray:
+        h = tensors[name]
+        return h.get_tensor(name)
+
+    def put(arr: np.ndarray, spec_path: Optional[tuple] = None):
+        arr = jnp.asarray(arr, dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from localai_tpu.parallel import sharding as shardlib
+
+            specs = shardlib.llama_param_specs(cfg.tie_word_embeddings)
+            node = specs
+            for k in spec_path:
+                node = node[k]
+            return jax.device_put(arr, NamedSharding(mesh, node))
+        return arr
+
+    L = cfg.num_layers
+
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
+        mats = []
+        for i in range(L):
+            m = get(fmt.format(i=i))
+            mats.append(m.T if transpose else m)
+        return np.stack(mats)
+
+    p = "model.layers.{i}."
+    params = {
+        "embed": put(get("model.embed_tokens.weight"), ("embed",)),
+        "layers": {
+            "attn_norm": put(stack(p + "input_layernorm.weight"), ("layers", "attn_norm")),
+            "wq": put(stack(p + "self_attn.q_proj.weight", transpose=True), ("layers", "wq")),
+            "wk": put(stack(p + "self_attn.k_proj.weight", transpose=True), ("layers", "wk")),
+            "wv": put(stack(p + "self_attn.v_proj.weight", transpose=True), ("layers", "wv")),
+            "wo": put(stack(p + "self_attn.o_proj.weight", transpose=True), ("layers", "wo")),
+            "mlp_norm": put(stack(p + "post_attention_layernorm.weight"), ("layers", "mlp_norm")),
+            "w_gate": put(stack(p + "mlp.gate_proj.weight", transpose=True), ("layers", "w_gate")),
+            "w_up": put(stack(p + "mlp.up_proj.weight", transpose=True), ("layers", "w_up")),
+            "w_down": put(stack(p + "mlp.down_proj.weight", transpose=True), ("layers", "w_down")),
+        },
+        "final_norm": put(get("model.norm.weight"), ("final_norm",)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = put(get("lm_head.weight").T, ("lm_head",))
+    return params
+
+
+def save_llama_params(params: dict, cfg, model_dir: str):
+    """Write params back to HF layout (single shard). Test/export helper."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(model_dir, exist_ok=True)
+    out = {}
+    ly = params["layers"]
+    np32 = lambda a: np.asarray(jax.device_get(a), np.float32)
+    out["model.embed_tokens.weight"] = np32(params["embed"])
+    out["model.norm.weight"] = np32(params["final_norm"])
+    if "lm_head" in params:
+        out["lm_head.weight"] = np32(params["lm_head"]).T
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        out[p + "input_layernorm.weight"] = np32(ly["attn_norm"][i])
+        out[p + "self_attn.q_proj.weight"] = np32(ly["wq"][i]).T
+        out[p + "self_attn.k_proj.weight"] = np32(ly["wk"][i]).T
+        out[p + "self_attn.v_proj.weight"] = np32(ly["wv"][i]).T
+        out[p + "self_attn.o_proj.weight"] = np32(ly["wo"][i]).T
+        out[p + "post_attention_layernorm.weight"] = np32(ly["mlp_norm"][i])
+        out[p + "mlp.gate_proj.weight"] = np32(ly["w_gate"][i]).T
+        out[p + "mlp.up_proj.weight"] = np32(ly["w_up"][i]).T
+        out[p + "mlp.down_proj.weight"] = np32(ly["w_down"][i]).T
+    save_file(out, os.path.join(model_dir, "model.safetensors"))

@@ -1,0 +1,240 @@
+"""Model lifecycle: load/route/shutdown backends per model.
+
+Parity with the reference's ModelLoader (reference: pkg/model/loader.go:22-28
+model map keyed by modelID; initializers.go:457 BackendLoader, :502
+GreedyLoader ordered autodetect, :402-423 health-check poll loop,
+loader.go:143-168 busy-aware shutdown, loader.go:170-206 CheckIsLoaded
+zombie cleanup; external backends initializers.go:336-360).
+
+TPU re-design: backends are Python modules spawned as gRPC subprocesses
+(or in-process servers for tests/embedded use). Capability probing is not
+CPU-flag selection (AVX/CUDA variants) but device platform: one engine
+binary serves any TPU/CPU host because XLA owns code generation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendClient, BackendServicer, make_server
+from localai_tpu.modelmgr.process import BackendProcess, free_port, spawn_python_backend
+
+log = logging.getLogger("localai_tpu.modelmgr.loader")
+
+# ordered by priority, mirroring the reference's autoload order
+# (initializers.go:33-57): the main engine first, specialized after.
+KNOWN_BACKENDS: dict = {
+    "tpu-llm": "localai_tpu.backend.runner",
+    "tpu-embeddings": "localai_tpu.backend.embed_runner",
+    "tpu-diffusion": "localai_tpu.backend.diffusion_runner",
+    "tpu-whisper": "localai_tpu.backend.whisper_runner",
+    "tpu-tts": "localai_tpu.backend.tts_runner",
+    "local-store": "localai_tpu.backend.store_backend",
+    "fake": "localai_tpu.backend.fake",
+}
+GREEDY_ORDER = ["tpu-llm"]
+
+
+class LoadedModel:
+    def __init__(self, model_id: str, backend_name: str, client: BackendClient,
+                 process: Optional[BackendProcess] = None, server=None):
+        self.model_id = model_id
+        self.backend_name = backend_name
+        self.client = client
+        self.process = process
+        self.server = server  # in-process grpc server (embedded backends)
+        self.last_used = time.monotonic()
+        self.busy = 0
+        self.watchdog = None  # set by ModelLoader when a watchdog is attached
+        self._lock = threading.Lock()
+
+    def mark_busy(self):
+        with self._lock:
+            self.busy += 1
+            self.last_used = time.monotonic()
+        if self.watchdog is not None:
+            self.watchdog.mark(self.model_id, True)
+
+    def mark_idle(self):
+        with self._lock:
+            self.busy = max(0, self.busy - 1)
+            idle = self.busy == 0
+            self.last_used = time.monotonic()
+        if idle and self.watchdog is not None:
+            self.watchdog.mark(self.model_id, False)
+
+    def close(self):
+        try:
+            self.client.close()
+        except Exception:
+            pass
+        if self.server is not None:
+            self.server.stop(grace=1)
+        if self.process is not None:
+            self.process.stop()
+
+
+class ModelLoader:
+    def __init__(self, health_attempts: int = 600, health_interval_s: float = 0.5,
+                 single_active: bool = False):
+        self.models: dict[str, LoadedModel] = {}
+        self._lock = threading.Lock()           # guards the dicts only
+        self._load_locks: dict[str, threading.Lock] = {}  # serialize per-model loads
+        self.health_attempts = health_attempts
+        self.health_interval_s = health_interval_s
+        self.single_active = single_active
+        self.external_backends: dict[str, str] = {}   # name -> module or host:port
+        self.embedded: dict[str, Callable[[], BackendServicer]] = {}
+        self.watchdog = None
+
+    # ---- registration ----
+
+    def register_external(self, name: str, target: str):
+        """target: python module path or 'host:port' (reference:
+        EXTERNAL_GRPC_BACKENDS semantics, initializers.go:336-360)."""
+        self.external_backends[name] = target
+
+    def register_embedded(self, name: str, factory: Callable[[], BackendServicer]):
+        """In-process backend (reference: pkg/grpc/embed.go Provide)."""
+        self.embedded[name] = factory
+
+    # ---- loading ----
+
+    def backend_loader(self, backend_name: str, model_id: str,
+                       model_opts: pb.ModelOptions) -> LoadedModel:
+        # per-model serialization; the global lock is only held for dict ops
+        # so a multi-minute weight load never blocks other models' lookups
+        with self._lock:
+            load_lock = self._load_locks.setdefault(model_id, threading.Lock())
+        with load_lock:
+            with self._lock:
+                lm = self.models.get(model_id)
+            if lm is not None:
+                if self._healthy(lm):
+                    lm.last_used = time.monotonic()
+                    return lm
+                log.warning("model %s backend unhealthy; respawning", model_id)
+                with self._lock:
+                    self._drop(model_id)
+            if self.single_active:
+                with self._lock:
+                    idle_others = [m for m, o in self.models.items()
+                                   if m != model_id and o.busy == 0]
+                    for other_id in idle_others:
+                        self._drop(other_id)
+            lm = self._spawn_and_load(backend_name, model_id, model_opts)
+            with self._lock:
+                self.models[model_id] = lm
+            return lm
+
+    def greedy_loader(self, model_id: str, model_opts: pb.ModelOptions,
+                      order: Optional[list] = None) -> LoadedModel:
+        """Try backends in priority order (reference: GreedyLoader
+        initializers.go:502)."""
+        errors = []
+        for name in order or GREEDY_ORDER:
+            try:
+                return self.backend_loader(name, model_id, model_opts)
+            except Exception as e:
+                errors.append(f"{name}: {e}")
+        raise RuntimeError("could not load model with any backend: " + "; ".join(errors))
+
+    def _spawn_and_load(self, backend_name: str, model_id: str,
+                        model_opts: pb.ModelOptions) -> LoadedModel:
+        client, process, server = self._connect_backend(backend_name)
+        try:
+            self._wait_healthy(client, process)
+            res = client.load_model(model_opts)
+            if not res.success:
+                raise RuntimeError(f"LoadModel failed: {res.message}")
+        except Exception:
+            client.close()
+            if server is not None:
+                server.stop(grace=0)
+            if process is not None:
+                process.stop()
+            raise
+        lm = LoadedModel(model_id, backend_name, client, process, server)
+        lm.watchdog = self.watchdog
+        if self.watchdog is not None:
+            self.watchdog.add(model_id, lm)
+        return lm
+
+    def _connect_backend(self, backend_name: str):
+        """Returns (client, process|None, inproc_server|None)."""
+        if backend_name in self.embedded:
+            addr = f"127.0.0.1:{free_port()}"
+            server = make_server(self.embedded[backend_name](), addr)
+            server.start()
+            return BackendClient(addr), None, server
+        target = self.external_backends.get(backend_name)
+        if target and _looks_like_addr(target):
+            return BackendClient(target), None, None
+        module = target or KNOWN_BACKENDS.get(backend_name)
+        if module is None:
+            raise ValueError(f"unknown backend: {backend_name}")
+        process = spawn_python_backend(module, name=backend_name)
+        return BackendClient(process.addr), process, None
+
+    def _wait_healthy(self, client: BackendClient, process: Optional[BackendProcess]):
+        for _ in range(self.health_attempts):
+            if process is not None and not process.alive():
+                raise RuntimeError("backend process died during startup")
+            if client.health(timeout=1.0):
+                return
+            time.sleep(self.health_interval_s)
+        raise TimeoutError("backend did not become healthy")
+
+    def _healthy(self, lm: LoadedModel) -> bool:
+        if lm.process is not None and not lm.process.alive():
+            return False
+        return lm.client.health(timeout=2.0)
+
+    # ---- queries ----
+
+    def get(self, model_id: str) -> Optional[LoadedModel]:
+        with self._lock:
+            return self.models.get(model_id)
+
+    def list_loaded(self) -> list:
+        with self._lock:
+            return list(self.models.keys())
+
+    # ---- shutdown ----
+
+    def shutdown_model(self, model_id: str, force: bool = False,
+                       max_wait_s: float = 120.0):
+        """Busy-aware shutdown (reference: loader.go:143-168)."""
+        deadline = time.monotonic() + max_wait_s
+        wait = 2.0
+        while True:
+            with self._lock:
+                lm = self.models.get(model_id)
+                if lm is None:
+                    return
+                if lm.busy == 0 or force or time.monotonic() > deadline:
+                    self._drop(model_id)
+                    return
+            time.sleep(min(wait, 5.0))
+            wait *= 1.5
+
+    def _drop(self, model_id: str):
+        lm = self.models.pop(model_id, None)
+        if lm is not None:
+            if self.watchdog is not None:
+                self.watchdog.remove(model_id)
+            lm.close()
+
+    def stop_all(self):
+        with self._lock:
+            for model_id in list(self.models):
+                self._drop(model_id)
+
+
+def _looks_like_addr(target: str) -> bool:
+    host, _, port = target.rpartition(":")
+    return bool(host) and port.isdigit()

@@ -1,0 +1,102 @@
+"""Backend process management: spawn, log-tail, terminate.
+
+Parity with the reference's process manager (reference: pkg/model/
+process.go:73-137 — chmod+exec with --addr, stdout/stderr tailed into the
+core logs, SIGTERM cleanup), re-based on subprocess + threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.modelmgr.process")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class BackendProcess:
+    """A spawned backend speaking the contract on 127.0.0.1:port."""
+
+    def __init__(self, command: list, addr: str, env: Optional[dict] = None,
+                 name: str = ""):
+        self.command = command
+        self.addr = addr
+        self.name = name or os.path.basename(command[0])
+        self.proc: Optional[subprocess.Popen] = None
+        self._env = env
+        self._tail_threads: list = []
+
+    def start(self):
+        env = dict(os.environ)
+        if self._env:
+            env.update(self._env)
+        log.info("starting backend %s: %s (addr %s)", self.name,
+                 shlex.join(self.command), self.addr)
+        self.proc = subprocess.Popen(
+            self.command,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            start_new_session=True,  # own process group for clean kill
+        )
+        for stream, level in ((self.proc.stdout, logging.DEBUG),
+                              (self.proc.stderr, logging.DEBUG)):
+            t = threading.Thread(target=self._tail, args=(stream, level), daemon=True)
+            t.start()
+            self._tail_threads.append(t)
+
+    def _tail(self, stream, level):
+        try:
+            for line in iter(stream.readline, b""):
+                log.log(level, "[%s] %s", self.name, line.decode(errors="replace").rstrip())
+        except ValueError:
+            pass  # stream closed
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def stop(self, grace_s: float = 10.0):
+        if not self.proc:
+            return
+        if self.proc.poll() is None:
+            try:
+                os.killpg(self.proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline and self.proc.poll() is None:
+                time.sleep(0.1)
+            if self.proc.poll() is None:
+                try:
+                    os.killpg(self.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for s in (self.proc.stdout, self.proc.stderr):
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def spawn_python_backend(module: str, extra_args: Optional[list] = None,
+                         env: Optional[dict] = None, name: str = "") -> BackendProcess:
+    """Spawn `python -m <module> --addr 127.0.0.1:<freeport>`."""
+    port = free_port()
+    addr = f"127.0.0.1:{port}"
+    cmd = [sys.executable, "-m", module, "--addr", addr] + (extra_args or [])
+    bp = BackendProcess(cmd, addr, env=env, name=name or module)
+    bp.start()
+    return bp

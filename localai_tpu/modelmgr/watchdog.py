@@ -1,0 +1,72 @@
+"""WatchDog: kill backends that are busy too long or idle too long.
+
+Parity with the reference (reference: pkg/model/watchdog.go:19-156 —
+busy/idle marks per backend, 30s sweep, kills over-threshold backends).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("localai_tpu.modelmgr.watchdog")
+
+
+class WatchDog:
+    def __init__(self, loader, busy_timeout_s: float = 300.0,
+                 idle_timeout_s: float = 900.0, check_busy: bool = False,
+                 check_idle: bool = False, sweep_interval_s: float = 30.0):
+        self.loader = loader
+        self.busy_timeout_s = busy_timeout_s
+        self.idle_timeout_s = idle_timeout_s
+        self.check_busy = check_busy
+        self.check_idle = check_idle
+        self.sweep_interval_s = sweep_interval_s
+        self._busy_since: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="watchdog", daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def add(self, model_id: str, lm):
+        pass  # tracking happens through mark()/loader state
+
+    def remove(self, model_id: str):
+        with self._lock:
+            self._busy_since.pop(model_id, None)
+
+    def mark(self, model_id: str, busy: bool):
+        with self._lock:
+            if busy:
+                self._busy_since.setdefault(model_id, time.monotonic())
+            else:
+                self._busy_since.pop(model_id, None)
+
+    def _run(self):
+        while not self._stop.wait(self.sweep_interval_s):
+            try:
+                now = time.monotonic()
+                if self.check_busy:
+                    with self._lock:
+                        stuck = [m for m, t in self._busy_since.items()
+                                 if now - t > self.busy_timeout_s]
+                    for m in stuck:
+                        log.warning("watchdog: %s busy > %.0fs, killing", m, self.busy_timeout_s)
+                        self.loader.shutdown_model(m, force=True)
+                if self.check_idle:
+                    for m in self.loader.list_loaded():
+                        lm = self.loader.get(m)
+                        if lm and lm.busy == 0 and now - lm.last_used > self.idle_timeout_s:
+                            log.info("watchdog: %s idle > %.0fs, releasing", m, self.idle_timeout_s)
+                            self.loader.shutdown_model(m, force=True)
+            except Exception:
+                log.exception("watchdog sweep failed")

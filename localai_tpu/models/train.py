@@ -1,0 +1,60 @@
+"""Minimal training/fine-tuning step (next-token cross-entropy).
+
+The reference has no training at all (SURVEY.md section 5.4); this exists so
+the framework's sharding story covers the full dp/tp mesh for gradients too
+(and to seed a future fine-tuning surface). Optimizer state and update are
+deliberately simple (SGD); optax slots in trivially later.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.ops.attention import causal_attention
+
+
+def forward_all_logits(params, cfg, tokens, seq_lens):
+    """Teacher-forced forward returning logits at every position [B, T, V]."""
+    B, T = tokens.shape
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+    sin, cos = rope_frequencies(cfg, positions)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]
+
+    def layer_fn(x, layer):
+        layer.pop("_idx", None)
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        attn = causal_attention(q, k, v, valid, cfg.q_per_kv)
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), layer["wo"])
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(h, layer)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, dict(params["layers"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return llama._unembed(x, params, cfg)
+
+
+def loss_fn(params, cfg, tokens, seq_lens):
+    """Mean next-token cross-entropy over valid positions."""
+    logits = forward_all_logits(params, cfg, tokens, seq_lens)  # [B, T, V]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    T = tokens.shape[1]
+    valid = jnp.arange(T - 1, dtype=jnp.int32)[None, :] < (seq_lens - 1)[:, None]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def train_step(params, cfg, tokens, seq_lens, lr: float = 1e-4):
+    """One SGD step; gradients follow the params' sharding (dp-psum by GSPMD)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens, seq_lens)
+    new_params = jax.tree.map(lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype), params, grads)
+    return loss, new_params

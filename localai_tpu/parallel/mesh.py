@@ -1,0 +1,76 @@
+"""Device mesh management — the TPU-native replacement for the reference's
+distributed stack.
+
+The reference distributes compute by proxying tensor ops to remote
+llama.cpp rpc-servers discovered over a libp2p VPN (reference:
+core/p2p/p2p.go, core/cli/api/p2p.go:61-76 rewriting LLAMACPP_GRPC_SERVERS).
+On TPU none of that userspace machinery is needed: topology is static and
+declarative — ``jax.devices()`` enumerates the slice, a ``Mesh`` names the
+axes, shardings annotate the program, and XLA inserts all-gather/
+all-reduce/reduce-scatter over ICI (intra-slice) or DCN (multi-slice).
+
+Axes (any may be size 1):
+  dp  - data parallel: slots/batch divided across replicas
+  tp  - tensor parallel: attention heads + MLP intermediate divided
+  sp  - sequence parallel: long-context ring attention (parallel/ring_attention.py)
+  pp  - pipeline parallel: layer stages (scan-over-layers split)
+  ep  - expert parallel: MoE experts
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Logical parallelism plan; axis sizes multiply to the device count."""
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> tuple:
+        return (self.dp, self.pp, self.sp, self.tp, self.ep)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh for the plan. ``tp`` is placed on the fastest-varying
+    axis so tensor-parallel collectives ride nearest-neighbor ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if plan.num_devices != len(devices):
+        raise ValueError(
+            f"mesh plan wants {plan.num_devices} devices (dp{plan.dp}*pp{plan.pp}"
+            f"*sp{plan.sp}*tp{plan.tp}*ep{plan.ep}), have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(plan.axis_sizes())
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshPlan(), devices=jax.devices()[:1])
+
+
+def plan_for_devices(n: int, want_tp: Optional[int] = None) -> MeshPlan:
+    """Default plan: as much tp as divides n (serving favors tp for latency),
+    remainder to dp."""
+    tp = want_tp or n
+    while n % tp:
+        tp -= 1
+    return MeshPlan(dp=n // tp, tp=tp)
+
+
+def local_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,113 @@
+"""Prompt templating.
+
+Parity with the reference's template layer (reference: pkg/templates/
+cache.go:40 Go text/template + sprig; multimodal placeholder injection
+pkg/templates/multimodal.go; per-message evaluation + join
+core/http/endpoints/openai/chat.go:296-441) — re-based on Jinja2, the
+ecosystem standard for HF chat templates, so `use_tokenizer_template`
+(vLLM-backend parity, backend.proto UseTokenizerTemplate) is the same
+engine as explicit templates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jinja2
+
+_env = jinja2.Environment(
+    loader=jinja2.BaseLoader(),
+    undefined=jinja2.ChainableUndefined,  # missing fields render empty, like text/template
+    trim_blocks=True,
+    lstrip_blocks=True,
+    keep_trailing_newline=True,
+)
+_cache: dict = {}
+
+
+def render(template: str, **values) -> str:
+    tpl = _cache.get(template)
+    if tpl is None:
+        tpl = _env.from_string(template)
+        if len(_cache) < 512:
+            _cache[template] = tpl
+    return tpl.render(**values)
+
+
+@dataclasses.dataclass
+class ChatMessageData:
+    """Per-message template inputs (reference: chat.go:311-397)."""
+    system_prompt: str = ""
+    role: str = ""
+    role_name: str = ""
+    content: str = ""
+    function_call: Any = None
+    function_name: str = ""
+    last_message: bool = False
+    index: int = 0
+
+
+DEFAULT_CHAT_MESSAGE = "{% if Role %}{{ Role }}: {% endif %}{{ Content }}"
+
+
+def render_chat_message(template: str, msg: ChatMessageData) -> str:
+    return render(
+        template,
+        SystemPrompt=msg.system_prompt,
+        Role=msg.role,
+        RoleName=msg.role_name,
+        Content=msg.content,
+        FunctionCall=msg.function_call,
+        FunctionName=msg.function_name,
+        LastMessage=msg.last_message,
+        MessageIndex=msg.index,
+        # lowercase aliases
+        role=msg.role, content=msg.content,
+    )
+
+
+def render_chat_prompt(template: str, joined_messages: str, system_prompt: str = "",
+                       functions: Optional[list] = None, suppressed: bool = False) -> str:
+    return render(
+        template,
+        Input=joined_messages,
+        SystemPrompt=system_prompt,
+        Functions=functions or [],
+        SuppressSystemPrompt=suppressed,
+        input=joined_messages,
+    )
+
+
+def render_completion(template: str, prompt: str, system_prompt: str = "") -> str:
+    return render(template, Input=prompt, SystemPrompt=system_prompt, input=prompt)
+
+
+def render_edit(template: str, instruction: str, prompt: str) -> str:
+    return render(template, Instruction=instruction, Input=prompt,
+                  instruction=instruction, input=prompt)
+
+
+def multimodal_placeholders(template: str, text: str, n_images: int = 0,
+                            n_audios: int = 0, n_videos: int = 0) -> str:
+    """Inject [img-N]/[audio-N]/[vid-N] placeholders before the text
+    (reference: pkg/templates/multimodal.go:24-26 default template)."""
+    imgs = "".join(f"[img-{i}]" for i in range(n_images))
+    auds = "".join(f"[audio-{i}]" for i in range(n_audios))
+    vids = "".join(f"[vid-{i}]" for i in range(n_videos))
+    if template:
+        return render(template, Text=text, ImagesCount=n_images, AudiosCount=n_audios,
+                      VideosCount=n_videos, Images=imgs, Audios=auds, Videos=vids)
+    out = auds + imgs + vids
+    if out and text:
+        out += "\n"
+    return out + text
+
+
+def apply_tokenizer_template(tokenizer, messages: list, add_generation_prompt: bool = True,
+                             tools: Optional[list] = None) -> str:
+    """use_tokenizer_template path: delegate to the HF chat template."""
+    kwargs = dict(tokenize=False, add_generation_prompt=add_generation_prompt)
+    if tools:
+        kwargs["tools"] = tools
+    return tokenizer.apply_chat_template(messages, **kwargs)

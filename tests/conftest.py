@@ -2,15 +2,19 @@
 
 Mirrors the reference's test stance (SURVEY.md section 4) but adds what it
 lacks: hermetic multi-device sharding tests without real hardware.
+
+NOTE: the axon TPU plugin ignores the JAX_PLATFORMS env var, so the switch
+must go through jax.config before any backend initialization.
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
@@ -18,7 +22,6 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def tiny_llama():
     """A tiny randomly-initialized llama for engine/API tests."""
-    import jax
     from localai_tpu.models import llama
 
     cfg = llama.LlamaConfig(
@@ -32,3 +35,25 @@ def tiny_llama():
     )
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     return cfg, params
+
+
+class ByteTokenizer:
+    """Minimal tokenizer for hermetic tests: bytes <-> ids, id 0 = EOS."""
+
+    eos_token_id = 0
+    bos_token_id = 1
+
+    def encode(self, text: str):
+        return [2 + b for b in text.encode("utf-8")]
+
+    def decode(self, ids, skip_special_tokens=True):
+        data = bytes(i - 2 for i in ids if i >= 2)
+        return data.decode("utf-8", errors="replace")
+
+    def get_vocab_size(self):
+        return 258
+
+
+@pytest.fixture(scope="session")
+def byte_tokenizer():
+    return ByteTokenizer()

@@ -1,0 +1,82 @@
+"""Model lifecycle tests against the fake backend (spawned + embedded)."""
+
+import pytest
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.fake import FakeServicer
+from localai_tpu.modelmgr.loader import ModelLoader
+
+
+@pytest.fixture()
+def loader():
+    ml = ModelLoader(health_attempts=60, health_interval_s=0.2)
+    yield ml
+    ml.stop_all()
+
+
+def test_embedded_backend_load_and_predict(loader):
+    loader.register_embedded("fake", FakeServicer)
+    lm = loader.backend_loader("fake", "m1", pb.ModelOptions(model="whatever"))
+    assert lm.client.health()
+    r = lm.client.predict(pb.PredictOptions(prompt="hello world"))
+    assert r.message == b"hello world"
+    assert r.finish_reason == "stop"
+
+
+def test_spawned_backend_process(loader):
+    lm = loader.backend_loader("fake", "m2", pb.ModelOptions(model="x"))
+    assert lm.process is not None and lm.process.alive()
+    chunks = list(lm.client.predict_stream(pb.PredictOptions(prompt="a b c")))
+    assert b"".join(c.message for c in chunks) == b"a b c"
+    assert chunks[-1].finish_reason == "stop"
+    loader.shutdown_model("m2")
+    assert loader.get("m2") is None
+
+
+def test_load_failure_surfaces(loader):
+    loader.register_embedded("fake", FakeServicer)
+    with pytest.raises(RuntimeError, match="fake load failure"):
+        loader.backend_loader("fake", "bad", pb.ModelOptions(model="fail-this"))
+
+
+def test_model_reuse_same_client(loader):
+    loader.register_embedded("fake", FakeServicer)
+    a = loader.backend_loader("fake", "m3", pb.ModelOptions(model="x"))
+    b = loader.backend_loader("fake", "m3", pb.ModelOptions(model="x"))
+    assert a is b
+
+
+def test_respawn_after_process_death(loader):
+    lm = loader.backend_loader("fake", "m4", pb.ModelOptions(model="x"))
+    lm.process.stop()
+    lm2 = loader.backend_loader("fake", "m4", pb.ModelOptions(model="x"))
+    assert lm2 is not lm
+    assert lm2.client.health()
+
+
+def test_greedy_loader_falls_through(loader):
+    calls = []
+
+    class Failing(FakeServicer):
+        def LoadModel(self, request, context):
+            calls.append("failing")
+            return pb.Result(success=False, message="nope")
+
+    loader.register_embedded("bad", Failing)
+    loader.register_embedded("good", FakeServicer)
+    lm = loader.greedy_loader("m5", pb.ModelOptions(model="x"), order=["bad", "good"])
+    assert lm.backend_name == "good"
+    assert calls == ["failing"]
+
+
+def test_stores_roundtrip_via_contract(loader):
+    loader.register_embedded("fake", FakeServicer)
+    lm = loader.backend_loader("fake", "st", pb.ModelOptions(model="x"))
+    lm.client.stores_set(pb.StoresSetOptions(
+        keys=[pb.StoresKey(floats=[1.0, 0.0]), pb.StoresKey(floats=[0.0, 1.0])],
+        values=[pb.StoresValue(bytes=b"a"), pb.StoresValue(bytes=b"b")],
+    ))
+    found = lm.client.stores_find(pb.StoresFindOptions(
+        key=pb.StoresKey(floats=[1.0, 0.1]), top_k=1))
+    assert found.values[0].bytes == b"a"
+    assert found.similarities[0] > 0.9
