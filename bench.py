@@ -52,27 +52,47 @@ def main():
     )
     active = jnp.ones((S,), jnp.bool_)
 
+    # Multi-step decode burst: K decode+sample steps run device-side per
+    # dispatch (lax.scan), amortizing host->device dispatch latency — the
+    # dominant cost on tunneled/remote TPUs (~30ms RTT measured). params and
+    # state are ARGUMENTS (a closure would bake 2+GB of weights into the HLO
+    # as constants and stall compilation).
+    K = int(os.environ.get("LOCALAI_BENCH_INNER", "16"))
+
     @jax.jit
-    def step(tokens, lengths, ck, cv, counts, keys):
-        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
-        ids, _, keys = sampling.sample(logits, slot_params, counts, bias, keys)
-        counts = sampling.update_token_counts(counts, ids, active)
-        return ids, lengths + 1, ck, cv, counts, keys
+    def burst(params, slot_params, bias, active, tokens, lengths, ck, cv, counts, keys):
+        def body(carry, _):
+            tokens, lengths, ck, cv, counts, keys = carry
+            logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
+            ids, _, keys = sampling.sample(logits, slot_params, counts, bias, keys)
+            counts = sampling.update_token_counts(counts, ids, active)
+            return (ids, lengths + 1, ck, cv, counts, keys), ids
+
+        carry, ids_seq = jax.lax.scan(
+            body, (tokens, lengths, ck, cv, counts, keys), None, length=K)
+        return carry, ids_seq
 
     tokens = jnp.zeros((S,), jnp.int32)
     lengths = jnp.full((S,), C // 2, jnp.int32)  # mid-context, realistic load
 
     # warmup / compile
-    tokens, lengths, ck, cv, counts, keys = step(tokens, lengths, ck, cv, counts, keys)
-    jax.block_until_ready(tokens)
+    carry, ids_seq = burst(params, slot_params, bias, active, tokens, lengths,
+                           ck, cv, counts, keys)
+    jax.block_until_ready(ids_seq)
+    (tokens, lengths, ck, cv, counts, keys) = carry
 
+    n_bursts = max(steps // K, 1)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        tokens, lengths, ck, cv, counts, keys = step(tokens, lengths, ck, cv, counts, keys)
-    jax.block_until_ready(tokens)
+    for _ in range(n_bursts):
+        carry, ids_seq = burst(params, slot_params, bias, active, tokens, lengths,
+                               ck, cv, counts, keys)
+        (tokens, lengths, ck, cv, counts, keys) = carry
+        # tokens MUST reach the host each burst in real serving; device_get
+        # also defeats block_until_ready unreliability on the axon platform
+        np.asarray(ids_seq)
     dt = time.perf_counter() - t0
 
-    tok_s = S * steps / dt
+    tok_s = S * n_bursts * K / dt
     out = {
         "metric": f"aggregate_decode_tok_s_per_chip_llama_{preset}_bf16_slots{S}",
         "value": round(tok_s, 1),

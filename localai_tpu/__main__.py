@@ -1,0 +1,3 @@
+from localai_tpu.cli import main
+
+raise SystemExit(main())

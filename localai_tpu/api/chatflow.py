@@ -1,0 +1,105 @@
+"""Chat request -> prompt assembly.
+
+Parity with the reference's chat pipeline (reference: core/http/endpoints/
+openai/chat.go:296-441 — per-message template evaluation, join, outer chat
+template; multimodal content parts request.go:150-217 -> base64 +
+[img-N]/[audio-N]/[vid-N] placeholders).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+import httpx
+
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.templates import prompts as T
+
+
+def _fetch_media(url: str) -> str:
+    """data: URIs and http(s) URLs -> base64 payload (reference:
+    pkg/utils/base64.go GetImageURLAsBase64)."""
+    if url.startswith("data:"):
+        _, _, payload = url.partition("base64,")
+        if not payload:
+            raise ValueError("unsupported data URI (expected base64)")
+        return payload
+    if url.startswith(("http://", "https://")):
+        resp = httpx.get(url, timeout=30.0, follow_redirects=True)
+        resp.raise_for_status()
+        return base64.b64encode(resp.content).decode()
+    raise ValueError(f"unsupported media URL scheme: {url[:32]}")
+
+
+def flatten_content(message: dict) -> tuple:
+    """OpenAI content parts -> (text, images[], audios[], videos[]) base64.
+
+    (reference: request.go:150-217 'CONTENT' interface handling)
+    """
+    content = message.get("content")
+    if content is None:
+        return "", [], [], []
+    if isinstance(content, str):
+        return content, [], [], []
+    texts, images, audios, videos = [], [], [], []
+    for part in content:
+        ptype = part.get("type", "text")
+        if ptype == "text":
+            texts.append(part.get("text", ""))
+        elif ptype == "image_url":
+            images.append(_fetch_media(part["image_url"]["url"]))
+        elif ptype in ("audio_url", "input_audio"):
+            url = part.get("audio_url", {}).get("url") or part.get("input_audio", {}).get("data", "")
+            audios.append(_fetch_media(url) if url.startswith(("data:", "http")) else url)
+        elif ptype == "video_url":
+            videos.append(_fetch_media(part["video_url"]["url"]))
+    return "\n".join(texts), images, audios, videos
+
+
+def build_chat_prompt(mc: ModelConfig, messages: list, tokenizer=None,
+                      functions: Optional[list] = None) -> tuple:
+    """Returns (prompt_text, images, audios, videos)."""
+    all_images, all_audios, all_videos = [], [], []
+    norm_msgs = []
+    for i, m in enumerate(messages):
+        text, imgs, auds, vids = flatten_content(m)
+        if imgs or auds or vids:
+            text = T.multimodal_placeholders(
+                mc.template.multimodal, text,
+                n_images=len(imgs), n_audios=len(auds), n_videos=len(vids),
+            )
+        all_images += imgs
+        all_audios += auds
+        all_videos += vids
+        norm_msgs.append({"role": m.get("role", "user"), "content": text,
+                          "tool_calls": m.get("tool_calls"),
+                          "name": m.get("name")})
+
+    if mc.template.use_tokenizer_template and tokenizer is not None:
+        prompt = T.apply_tokenizer_template(tokenizer, norm_msgs, tools=functions)
+        return prompt, all_images, all_audios, all_videos
+
+    system_prompt = mc.system_prompt
+    rendered = []
+    msg_tpl = mc.template.chat_message or T.DEFAULT_CHAT_MESSAGE
+    for i, m in enumerate(norm_msgs):
+        data = T.ChatMessageData(
+            system_prompt=system_prompt,
+            role=m["role"], role_name=m["role"], content=m["content"] or "",
+            function_call=m.get("tool_calls"),
+            last_message=(i == len(norm_msgs) - 1),
+            index=i,
+        )
+        s = T.render_chat_message(msg_tpl, data)
+        if s:
+            rendered.append(s)
+    joiner = mc.template.join_chat_messages_by_character
+    joined = (joiner if joiner is not None else "\n").join(rendered)
+
+    if mc.template.chat:
+        prompt = T.render_chat_prompt(mc.template.chat, joined, system_prompt,
+                                      functions=functions)
+    else:
+        prompt = joined
+    return prompt, all_images, all_audios, all_videos

@@ -1,0 +1,392 @@
+"""LocalAI-specific + 3rd-party-compat endpoints.
+
+Parity with the reference route tables (reference: core/http/routes/
+localai.go:14-71 — gallery ops, TTS, sound generation, tokenize, stores,
+/metrics, backend monitor/shutdown, /system, /version, p2p, tokenMetrics;
+routes/health.go — /healthz /readyz; routes/elevenlabs.go; routes/jina.go).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import tempfile
+import time
+
+from aiohttp import web
+
+from localai_tpu import __version__
+from localai_tpu.api.app import api_error, get_state
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.services.metrics import METRICS
+
+
+def register(app: web.Application):
+    r = app.router
+    # health (reference: routes/health.go)
+    r.add_get("/healthz", healthz)
+    r.add_get("/readyz", healthz)
+    # tts + sound generation
+    r.add_post("/tts", tts)
+    r.add_post("/sound-generation", sound_generation)
+    # elevenlabs compat (reference: routes/elevenlabs.go)
+    r.add_post("/v1/text-to-speech/{voice_id}", elevenlabs_tts)
+    r.add_post("/v1/sound-generation", sound_generation)
+    # jina compat (reference: routes/jina.go)
+    r.add_post("/v1/rerank", rerank)
+    # tokenize
+    r.add_post("/v1/tokenize", tokenize)
+    # stores (reference: routes/localai.go:49-53)
+    r.add_post("/stores/set", stores_set)
+    r.add_post("/stores/delete", stores_delete)
+    r.add_post("/stores/get", stores_get)
+    r.add_post("/stores/find", stores_find)
+    # observability
+    r.add_get("/metrics", metrics)
+    r.add_get("/backend/monitor", backend_monitor)
+    r.add_post("/backend/monitor", backend_monitor)
+    r.add_post("/backend/shutdown", backend_shutdown)
+    r.add_get("/system", system_info)
+    r.add_get("/version", version)
+    r.add_get("/v1/tokenMetrics", token_metrics)
+    # gallery (reference: routes/localai.go:14-44)
+    r.add_post("/models/apply", models_apply)
+    r.add_post("/models/delete/{name}", models_delete)
+    r.add_get("/models/available", models_available)
+    r.add_get("/models/jobs/{uuid}", models_job_status)
+    r.add_get("/models/jobs", models_all_jobs)
+    r.add_post("/models/galleries", add_gallery)
+    r.add_delete("/models/galleries", remove_gallery)
+    # p2p parity surface (topology is static on TPU; report the mesh)
+    r.add_get("/api/p2p", p2p_nodes)
+    r.add_get("/api/p2p/token", p2p_token)
+
+
+async def healthz(request):
+    return web.Response(text="OK")
+
+
+async def run_audio_capability(request, call) -> web.Response:
+    """Run a sync capability ``call(dst)`` that writes a wav to dst; return
+    the audio as the response body. The temp file is always cleaned up."""
+    state = get_state(request)
+    dst = os.path.join(tempfile.gettempdir(), f"localai-audio-{secrets.token_hex(8)}.wav")
+    try:
+        await state.run_blocking(call, dst)
+        with open(dst, "rb") as f:
+            return web.Response(body=f.read(), content_type="audio/wav")
+    finally:
+        if os.path.exists(dst):
+            os.unlink(dst)
+
+
+async def version(request):
+    return web.json_response({"version": __version__})
+
+
+async def metrics(request):
+    if get_state(request).config.disable_metrics_endpoint:
+        return api_error("metrics disabled", 404)
+    return web.Response(text=METRICS.render(), content_type="text/plain")
+
+
+# --------------- tts / sound ---------------
+
+async def tts(request):
+    state = get_state(request)
+    body = await request.json()
+    model = body.get("model") or body.get("backend") or ""
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model)
+    return await run_audio_capability(
+        request, lambda dst: state.caps.tts(
+            mc, body.get("input", ""), body.get("voice", ""),
+            body.get("language", ""), dst))
+
+
+async def elevenlabs_tts(request):
+    state = get_state(request)
+    body = await request.json()
+    voice_id = request.match_info["voice_id"]
+    model = body.get("model_id") or ""
+    if not model:
+        return api_error("model_id is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model)
+    return await run_audio_capability(
+        request, lambda dst: state.caps.tts(
+            mc, body.get("text", ""), voice_id, body.get("language_code", ""), dst))
+
+
+async def sound_generation(request):
+    state = get_state(request)
+    body = await request.json()
+    model = body.get("model_id") or body.get("model") or ""
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model)
+    return await run_audio_capability(
+        request, lambda dst: state.caps.sound_generation(
+            mc, body.get("text", ""), dst,
+            body.get("duration_seconds"), body.get("temperature")))
+
+
+# --------------- rerank ---------------
+
+async def rerank(request):
+    state = get_state(request)
+    body = await request.json()
+    model = body.get("model") or ""
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model)
+    res = await state.run_blocking(
+        state.caps.rerank, mc, body.get("query", ""),
+        list(body.get("documents", [])), int(body.get("top_n") or 0))
+    return web.json_response({
+        "model": model,
+        "usage": {"total_tokens": res.usage.total_tokens,
+                  "prompt_tokens": res.usage.prompt_tokens},
+        "results": [
+            {"index": r.index, "relevance_score": r.relevance_score,
+             "document": {"text": r.text}}
+            for r in res.results
+        ],
+    })
+
+
+# --------------- tokenize ---------------
+
+async def tokenize(request):
+    state = get_state(request)
+    body = await request.json()
+    model = body.get("model") or ""
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model)
+    tokens = await state.run_blocking(state.caps.tokenize, mc, body.get("content", ""))
+    return web.json_response({"tokens": tokens})
+
+
+# --------------- stores ---------------
+
+def _store_client(request):
+    return get_state(request).caps.store_client()
+
+
+async def stores_set(request):
+    state = get_state(request)
+    body = await request.json()
+    keys = body.get("keys", [])
+    values = body.get("values", [])
+    if len(keys) != len(values):
+        return api_error("keys and values must have equal length", 400)
+    client = await state.run_blocking(_store_client, request)
+    await state.run_blocking(client.stores_set, pb.StoresSetOptions(
+        keys=[pb.StoresKey(floats=k) for k in keys],
+        values=[pb.StoresValue(bytes=str(v).encode()) for v in values],
+    ))
+    return web.json_response({})
+
+
+async def stores_delete(request):
+    state = get_state(request)
+    body = await request.json()
+    client = await state.run_blocking(_store_client, request)
+    await state.run_blocking(client.stores_delete, pb.StoresDeleteOptions(
+        keys=[pb.StoresKey(floats=k) for k in body.get("keys", [])]))
+    return web.json_response({})
+
+
+async def stores_get(request):
+    state = get_state(request)
+    body = await request.json()
+    client = await state.run_blocking(_store_client, request)
+    res = await state.run_blocking(client.stores_get, pb.StoresGetOptions(
+        keys=[pb.StoresKey(floats=k) for k in body.get("keys", [])]))
+    return web.json_response({
+        "keys": [list(k.floats) for k in res.keys],
+        "values": [v.bytes.decode() for v in res.values],
+    })
+
+
+async def stores_find(request):
+    state = get_state(request)
+    body = await request.json()
+    client = await state.run_blocking(_store_client, request)
+    res = await state.run_blocking(client.stores_find, pb.StoresFindOptions(
+        key=pb.StoresKey(floats=body.get("key", [])),
+        top_k=int(body.get("topk") or body.get("top_k") or 10)))
+    return web.json_response({
+        "keys": [list(k.floats) for k in res.keys],
+        "values": [v.bytes.decode() for v in res.values],
+        "similarities": list(res.similarities),
+    })
+
+
+# --------------- backend monitor / system ---------------
+
+async def backend_monitor(request):
+    """(reference: core/services/backend_monitor.go + endpoint)"""
+    state = get_state(request)
+    if request.method == "POST":
+        body = await request.json()
+        model = body.get("model", "")
+    else:
+        model = request.query.get("model", "")
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    lm = state.caps.loader.get(model)
+    if lm is None:
+        return api_error(f"model {model} is not loaded", 404)
+    status = await state.run_blocking(lm.client.status)
+    return web.json_response({
+        "memory_info": {"total": status.memory.total,
+                        "breakdown": dict(status.memory.breakdown)},
+        "state": pb.StatusResponse.State.Name(status.state),
+    })
+
+
+async def backend_shutdown(request):
+    state = get_state(request)
+    body = await request.json()
+    model = body.get("model", "")
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    await state.run_blocking(state.caps.loader.shutdown_model, model)
+    return web.json_response({})
+
+
+async def system_info(request):
+    """(reference: routes/localai.go:60-66 /system)"""
+    import jax
+
+    state = get_state(request)
+    try:
+        devices = [{"id": d.id, "platform": d.platform,
+                    "kind": getattr(d, "device_kind", "")} for d in jax.devices()]
+    except Exception:
+        devices = []
+    return web.json_response({
+        "backends": sorted(state.caps.loader.list_loaded()),
+        "devices": devices,
+        "loaded_models": sorted(state.caps.loader.list_loaded()),
+        "version": __version__,
+    })
+
+
+async def token_metrics(request):
+    """(reference: core/http/endpoints/localai/get_token_metrics.go)"""
+    state = get_state(request)
+    body = {}
+    if request.can_read_body:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+    model = body.get("model") or request.query.get("model", "")
+    if not model:
+        return api_error("model is required", 400, "invalid_request_error")
+    lm = state.caps.loader.get(model)
+    if lm is None:
+        return api_error(f"model {model} is not loaded", 404)
+    m = await state.run_blocking(lm.client.get_metrics)
+    return web.json_response({
+        "model": model,
+        "tokens_per_second": m.tokens_per_second,
+        "tokens_generated": m.tokens_generated,
+        "slots_active": m.slots_active,
+        "slots_total": m.slots_total,
+        "queued": m.queued,
+        "uptime_s": m.uptime_s,
+    })
+
+
+# --------------- gallery ---------------
+
+async def models_apply(request):
+    state = get_state(request)
+    if state.gallery_service is None:
+        return api_error("gallery service not available", 503)
+    body = await request.json()
+    job_id = state.gallery_service.submit_apply(body)
+    return web.json_response({
+        "uuid": job_id,
+        "status": str(request.url.with_path(f"/models/jobs/{job_id}")),
+    })
+
+
+async def models_delete(request):
+    state = get_state(request)
+    if state.gallery_service is None:
+        return api_error("gallery service not available", 503)
+    name = request.match_info["name"]
+    job_id = state.gallery_service.submit_delete(name)
+    return web.json_response({
+        "uuid": job_id,
+        "status": str(request.url.with_path(f"/models/jobs/{job_id}")),
+    })
+
+
+async def models_available(request):
+    state = get_state(request)
+    if state.gallery_service is None:
+        return api_error("gallery service not available", 503)
+    models = await state.run_blocking(state.gallery_service.list_available)
+    return web.json_response(models)
+
+
+async def models_job_status(request):
+    state = get_state(request)
+    if state.gallery_service is None:
+        return api_error("gallery service not available", 503)
+    status = state.gallery_service.job_status(request.match_info["uuid"])
+    if status is None:
+        return api_error("job not found", 404)
+    return web.json_response(status)
+
+
+async def models_all_jobs(request):
+    state = get_state(request)
+    if state.gallery_service is None:
+        return api_error("gallery service not available", 503)
+    return web.json_response(state.gallery_service.all_jobs())
+
+
+async def add_gallery(request):
+    state = get_state(request)
+    body = await request.json()
+    state.config.galleries.append({"name": body.get("name"), "url": body.get("url")})
+    return web.json_response({"name": body.get("name")})
+
+
+async def remove_gallery(request):
+    state = get_state(request)
+    body = await request.json()
+    state.config.galleries = [
+        g for g in state.config.galleries if g.get("name") != body.get("name")
+    ]
+    return web.json_response({})
+
+
+# --------------- p2p parity ---------------
+
+async def p2p_nodes(request):
+    """On TPU the 'swarm' is the static device mesh — report it in the
+    same shape the reference reports federated nodes (reference:
+    core/http/endpoints/localai/p2p.go)."""
+    import jax
+
+    try:
+        nodes = [
+            {"name": f"device-{d.id}", "id": str(d.id), "online": True,
+             "platform": d.platform}
+            for d in jax.devices()
+        ]
+    except Exception:
+        nodes = []
+    return web.json_response({"nodes": nodes, "federated_nodes": []})
+
+
+async def p2p_token(request):
+    return web.json_response({"token": ""})

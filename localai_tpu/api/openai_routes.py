@@ -1,0 +1,382 @@
+"""OpenAI-compatible endpoints.
+
+Parity with the reference (reference: core/http/endpoints/openai/ — chat.go,
+completion.go, edit.go, embeddings.go, image.go, transcription.go, list.go;
+route table core/http/routes/openai.go:11-85 registers each under /v1/* and
+/* aliases).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import secrets
+import tempfile
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from localai_tpu.api.app import api_error, get_state, sse_response
+from localai_tpu.api.chatflow import build_chat_prompt
+from localai_tpu.capabilities import finetune_response
+from localai_tpu.templates import prompts as T
+
+
+def register(app: web.Application):
+    r = app.router
+    for prefix in ("/v1", ""):
+        r.add_post(f"{prefix}/chat/completions", chat_completions)
+        r.add_post(f"{prefix}/completions", completions)
+        r.add_post(f"{prefix}/edits", edits)
+        r.add_post(f"{prefix}/embeddings", embeddings)
+        r.add_post(f"{prefix}/images/generations", images_generations)
+        r.add_post(f"{prefix}/audio/transcriptions", audio_transcriptions)
+        r.add_post(f"{prefix}/audio/speech", audio_speech)
+        r.add_get(f"{prefix}/models", list_models)
+        r.add_get(f"{prefix}/models/{{model}}", get_model)
+
+
+async def _read_json(request) -> dict:
+    try:
+        return await request.json()
+    except Exception:
+        raise web.HTTPBadRequest(text="invalid JSON body")
+
+
+def _model_from(request, body: dict) -> str:
+    # path override > body > header (reference: fiber.go ModelFromContext)
+    m = body.get("model") or request.headers.get("X-Model") or ""
+    if not m:
+        state = get_state(request)
+        if len(state.caps.configs) == 1:
+            m = next(iter(state.caps.configs))
+    if not m:
+        raise web.HTTPBadRequest(text="model is required")
+    return m
+
+
+def _overrides_from(body: dict) -> dict:
+    o = {}
+    for k in ("temperature", "top_k", "top_p", "min_p", "typical_p", "seed",
+              "presence_penalty", "frequency_penalty", "repeat_penalty",
+              "logit_bias", "ignore_eos", "echo", "grammar"):
+        if k in body and body[k] is not None:
+            o[k] = body[k]
+    if body.get("max_tokens") or body.get("max_completion_tokens"):
+        o["max_tokens"] = body.get("max_tokens") or body.get("max_completion_tokens")
+    stop = body.get("stop")
+    if stop:
+        o["stop"] = [stop] if isinstance(stop, str) else list(stop)
+    return o
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+# --------------- chat ---------------
+
+async def chat_completions(request):
+    state = get_state(request)
+    body = await _read_json(request)
+    model = _model_from(request, body)
+    mc = state.caps.resolve(model)
+    messages = body.get("messages") or []
+    if not messages:
+        return api_error("messages is required", 400, "invalid_request_error")
+
+    correlation_id = request.headers.get("X-Correlation-ID", uuid.uuid4().hex)
+    overrides = _overrides_from(body)
+
+    tools = body.get("tools") or []
+    functions = body.get("functions") or [
+        t["function"] for t in tools if t.get("type") == "function"
+    ]
+    grammar = ""
+    if functions and not body.get("grammar"):
+        from localai_tpu.functions.grammars import json_schema
+
+        tool_choice = body.get("tool_choice") or body.get("function_call")
+        grammar = json_schema.grammar_for_functions(
+            functions, force=tool_choice not in (None, "auto", "none"),
+            parallel_calls=bool(body.get("parallel_tool_calls", False)),
+        )
+        overrides["grammar"] = grammar
+
+    prompt, images, audios, videos = await state.run_blocking(
+        build_chat_prompt, mc, messages, None, functions or None
+    )
+    if images:
+        overrides["images"] = images
+    if audios:
+        overrides["audios"] = audios
+
+    created = int(time.time())
+    cmpl_id = f"chatcmpl-{secrets.token_hex(12)}"
+
+    if body.get("stream"):
+        def gen():
+            first = {"id": cmpl_id, "object": "chat.completion.chunk",
+                     "created": created, "model": model,
+                     "choices": [{"index": 0, "delta": {"role": "assistant",
+                                                        "content": ""},
+                                  "finish_reason": None}]}
+            yield first
+            usage = [0, 0]
+            for chunk in state.caps.inference_stream(mc, prompt, overrides,
+                                                     correlation_id):
+                usage = [chunk.prompt_tokens, chunk.completion_tokens]
+                if chunk.text:
+                    yield {"id": cmpl_id, "object": "chat.completion.chunk",
+                           "created": created, "model": model,
+                           "choices": [{"index": 0,
+                                        "delta": {"content": chunk.text},
+                                        "finish_reason": None}]}
+            final = {"id": cmpl_id, "object": "chat.completion.chunk",
+                     "created": created, "model": model,
+                     "choices": [{"index": 0, "delta": {},
+                                  "finish_reason": "stop"}],
+                     "usage": _usage(*usage)}
+            yield final
+
+        q = await state.iter_blocking(gen)
+        return await sse_response(request, q)
+
+    # non-stream: n choices (reference: ComputeChoices inference.go:11-63)
+    n = int(body.get("n") or 1)
+    choices = []
+    usage_pt, usage_ct = 0, 0
+    for i in range(n):
+        chunk = await state.run_blocking(
+            state.caps.inference, mc, prompt, overrides, correlation_id)
+        usage_pt = chunk.prompt_tokens
+        usage_ct += chunk.completion_tokens
+        text = chunk.text
+        message = {"role": "assistant", "content": text}
+        finish = chunk.finish_reason or "stop"
+        if functions:
+            from localai_tpu.functions import parse as fparse
+
+            calls = fparse.parse_function_calls(text, mc.function)
+            if calls:
+                message = {
+                    "role": "assistant", "content": None,
+                    "tool_calls": [
+                        {"id": f"call_{secrets.token_hex(8)}", "type": "function",
+                         "function": {"name": c.name, "arguments": c.arguments}}
+                        for c in calls
+                    ],
+                }
+                finish = "tool_calls"
+        choices.append({"index": i, "message": message, "finish_reason": finish})
+    return web.json_response({
+        "id": cmpl_id, "object": "chat.completion", "created": created,
+        "model": model, "choices": choices, "usage": _usage(usage_pt, usage_ct),
+    })
+
+
+# --------------- completions ---------------
+
+async def completions(request):
+    state = get_state(request)
+    body = await _read_json(request)
+    model = _model_from(request, body)
+    mc = state.caps.resolve(model)
+    overrides = _overrides_from(body)
+    prompts = body.get("prompt", "")
+    if isinstance(prompts, str):
+        prompts = [prompts]
+
+    created = int(time.time())
+    cmpl_id = f"cmpl-{secrets.token_hex(12)}"
+
+    def render(p):
+        if mc.template.completion:
+            return T.render_completion(mc.template.completion, p, mc.system_prompt)
+        return p
+
+    if body.get("stream"):
+        prompt = render(prompts[0])
+
+        def gen():
+            usage = [0, 0]
+            for chunk in state.caps.inference_stream(mc, prompt, overrides):
+                usage = [chunk.prompt_tokens, chunk.completion_tokens]
+                if chunk.text:
+                    yield {"id": cmpl_id, "object": "text_completion",
+                           "created": created, "model": model,
+                           "choices": [{"index": 0, "text": chunk.text,
+                                        "finish_reason": None}]}
+            yield {"id": cmpl_id, "object": "text_completion", "created": created,
+                   "model": model,
+                   "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],
+                   "usage": _usage(*usage)}
+
+        q = await state.iter_blocking(gen)
+        return await sse_response(request, q)
+
+    choices = []
+    usage_pt, usage_ct = 0, 0
+    for i, p in enumerate(prompts):
+        chunk = await state.run_blocking(state.caps.inference, mc, render(p), overrides)
+        usage_pt += chunk.prompt_tokens
+        usage_ct += chunk.completion_tokens
+        choices.append({"index": i, "text": chunk.text,
+                        "finish_reason": chunk.finish_reason or "stop"})
+    return web.json_response({
+        "id": cmpl_id, "object": "text_completion", "created": created,
+        "model": model, "choices": choices, "usage": _usage(usage_pt, usage_ct),
+    })
+
+
+# --------------- edits ---------------
+
+async def edits(request):
+    state = get_state(request)
+    body = await _read_json(request)
+    model = _model_from(request, body)
+    mc = state.caps.resolve(model)
+    instruction = body.get("instruction", "")
+    inp = body.get("input", "")
+    if mc.template.edit:
+        prompt = T.render_edit(mc.template.edit, instruction, inp)
+    else:
+        prompt = f"{instruction}\n\n{inp}"
+    overrides = _overrides_from(body)
+    chunk = await state.run_blocking(state.caps.inference, mc, prompt, overrides)
+    return web.json_response({
+        "object": "edit", "created": int(time.time()), "model": model,
+        "choices": [{"index": 0, "text": chunk.text}],
+        "usage": _usage(chunk.prompt_tokens, chunk.completion_tokens),
+    })
+
+
+# --------------- embeddings ---------------
+
+async def embeddings(request):
+    state = get_state(request)
+    body = await _read_json(request)
+    model = _model_from(request, body)
+    mc = state.caps.resolve(model)
+    inputs = body.get("input", "")
+    if isinstance(inputs, (str, int)):
+        inputs = [inputs]
+    vecs = await state.run_blocking(state.caps.embeddings, mc, inputs)
+    data = [
+        {"object": "embedding", "index": i, "embedding": v}
+        for i, v in enumerate(vecs)
+    ]
+    return web.json_response({
+        "object": "list", "model": model, "data": data,
+        "usage": _usage(0, 0),
+    })
+
+
+# --------------- images ---------------
+
+async def images_generations(request):
+    state = get_state(request)
+    body = await _read_json(request)
+    model = body.get("model") or "stablediffusion"
+    mc = state.caps.resolve(model)
+    size = body.get("size", "512x512")
+    try:
+        width, height = (int(x) for x in size.split("x"))
+    except ValueError:
+        return api_error(f"invalid size {size}", 400, "invalid_request_error")
+    prompt = body.get("prompt", "")
+    positive, _, negative = prompt.partition("|")
+    n = int(body.get("n") or 1)
+    out = []
+    for _ in range(n):
+        dst = os.path.join(tempfile.gettempdir(),
+                           f"localai-img-{secrets.token_hex(8)}.png")
+        await state.run_blocking(
+            state.caps.generate_image, mc, positive.strip(), negative.strip(),
+            width, height, int(body.get("step", 25)), int(body.get("seed", 0)), dst)
+        if body.get("response_format") == "b64_json":
+            with open(dst, "rb") as f:
+                out.append({"b64_json": base64.b64encode(f.read()).decode()})
+            os.unlink(dst)
+        else:
+            out.append({"url": f"file://{dst}"})
+    return web.json_response({"created": int(time.time()), "data": out})
+
+
+# --------------- audio ---------------
+
+async def audio_transcriptions(request):
+    state = get_state(request)
+    reader = await request.multipart()
+    model, language, translate, audio_path = "", "", False, None
+    async for part in reader:
+        if part.name == "model":
+            model = (await part.read()).decode()
+        elif part.name == "language":
+            language = (await part.read()).decode()
+        elif part.name == "translate":
+            translate = (await part.read()).decode().lower() in ("1", "true")
+        elif part.name == "file":
+            suffix = os.path.splitext(part.filename or "audio.wav")[1]
+            fd, audio_path = tempfile.mkstemp(suffix=suffix, prefix="localai-stt-")
+            with os.fdopen(fd, "wb") as f:
+                f.write(await part.read())
+    if not audio_path:
+        return api_error("file is required", 400, "invalid_request_error")
+    mc = state.caps.resolve(model or "whisper")
+    try:
+        res = await state.run_blocking(
+            state.caps.transcribe, mc, audio_path, language, translate)
+    finally:
+        os.unlink(audio_path)
+    return web.json_response({
+        "text": res.text,
+        "segments": [
+            {"id": s.id, "start": s.start / 1e9, "end": s.end / 1e9,
+             "text": s.text, "tokens": list(s.tokens)}
+            for s in res.segments
+        ],
+    })
+
+
+async def audio_speech(request):
+    """OpenAI TTS endpoint (reference: localai/tts.go handles /tts;
+    /v1/audio/speech maps here too per routes/openai.go)."""
+    from localai_tpu.api.localai_routes import run_audio_capability
+
+    state = get_state(request)
+    body = await _read_json(request)
+    model = _model_from(request, body)
+    mc = state.caps.resolve(model)
+    return await run_audio_capability(
+        request, lambda dst: state.caps.tts(
+            mc, body.get("input", ""), body.get("voice", ""),
+            body.get("language", ""), dst))
+
+
+# --------------- models ---------------
+
+async def list_models(request):
+    state = get_state(request)
+    loaded = set(state.caps.loader.list_loaded())
+    data = [
+        {"id": name, "object": "model", "created": int(state.started_at),
+         "owned_by": "localai-tpu", "ready": name in loaded}
+        for name, mc in sorted(state.caps.configs.items())
+    ]
+    return web.json_response({"object": "list", "data": data})
+
+
+async def get_model(request):
+    state = get_state(request)
+    name = request.match_info["model"]
+    if name not in state.caps.configs:
+        return api_error(f"model {name} not found", 404, "invalid_request_error")
+    return web.json_response({"id": name, "object": "model",
+                              "owned_by": "localai-tpu"})

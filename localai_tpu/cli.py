@@ -1,0 +1,181 @@
+"""CLI entrypoint: python -m localai_tpu <command>.
+
+Parity with the reference CLI (reference: core/cli/cli.go:8-20 —
+run|models|tts|sound-generation|transcript|worker|util subcommands; flags
+with env aliases via core/cli/run.go struct tags).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+
+def _add_run(sub):
+    p = sub.add_parser("run", help="start the API server")
+    p.add_argument("models", nargs="*", help="models to preload (path/URL/gallery name)")
+    p.add_argument("--models-path", default=os.environ.get("LOCALAI_MODELS_PATH", "models"))
+    p.add_argument("--address", default=os.environ.get("LOCALAI_ADDRESS", "127.0.0.1:8080"))
+    p.add_argument("--context-size", type=int, default=None)
+    p.add_argument("--api-keys", default=None, help="comma-separated bearer keys")
+    p.add_argument("--single-active-backend", action="store_true")
+    p.add_argument("--enable-watchdog-idle", action="store_true")
+    p.add_argument("--enable-watchdog-busy", action="store_true")
+    p.add_argument("--mesh-tp", type=int, default=None)
+    p.add_argument("--mesh-dp", type=int, default=None)
+    p.add_argument("--load-to-memory", action="append", default=[])
+    p.add_argument("--log-level", default=os.environ.get("LOCALAI_LOG_LEVEL", "info"))
+    p.add_argument("--disable-webui", action="store_true")
+
+
+def _add_simple(sub):
+    m = sub.add_parser("models", help="list/install models offline")
+    msub = m.add_subparsers(dest="models_cmd", required=True)
+    mi = msub.add_parser("install")
+    mi.add_argument("names", nargs="+")
+    mi.add_argument("--models-path", default="models")
+    ml = msub.add_parser("list")
+    ml.add_argument("--models-path", default="models")
+
+    t = sub.add_parser("tts", help="one-shot TTS")
+    t.add_argument("text")
+    t.add_argument("--model", required=True)
+    t.add_argument("--voice", default="")
+    t.add_argument("--output", default="out.wav")
+    t.add_argument("--models-path", default="models")
+
+    tr = sub.add_parser("transcript", help="one-shot transcription")
+    tr.add_argument("file")
+    tr.add_argument("--model", required=True)
+    tr.add_argument("--language", default="")
+    tr.add_argument("--models-path", default="models")
+
+    w = sub.add_parser("worker", help="start a multi-host worker process")
+    w.add_argument("--coordinator", required=True, help="host:port of process 0")
+    w.add_argument("--num-processes", type=int, required=True)
+    w.add_argument("--process-id", type=int, required=True)
+
+    u = sub.add_parser("util", help="utilities")
+    usub = u.add_subparsers(dest="util_cmd", required=True)
+    ui = usub.add_parser("model-info")
+    ui.add_argument("path")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="localai-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    _add_run(sub)
+    _add_simple(sub)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, getattr(args, "log_level", "info").upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+
+    if args.cmd == "run":
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.startup import serve
+
+        cfg = AppConfig.from_env(
+            models_path=args.models_path,
+            address=args.address,
+            context_size=args.context_size,
+            single_active_backend=args.single_active_backend or None,
+            enable_watchdog_idle=args.enable_watchdog_idle or None,
+            enable_watchdog_busy=args.enable_watchdog_busy or None,
+            mesh_tp=args.mesh_tp,
+            mesh_dp=args.mesh_dp,
+            disable_webui=args.disable_webui or None,
+        )
+        if args.api_keys:
+            cfg.api_keys = [k.strip() for k in args.api_keys.split(",")]
+        cfg.preload_models = list(args.models)
+        cfg.load_to_memory = list(args.load_to_memory)
+        try:
+            asyncio.run(serve(cfg))
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.cmd == "models":
+        from localai_tpu.config.model_config import scan_models_dir
+
+        if args.models_cmd == "list":
+            for name in sorted(scan_models_dir(args.models_path)):
+                print(name)
+        elif args.models_cmd == "install":
+            from localai_tpu.gallery.preload import install_models
+
+            install_models(args.names, args.models_path, [])
+        return 0
+
+    if args.cmd == "tts":
+        from localai_tpu.capabilities import Capabilities
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.config.model_config import scan_models_dir
+        from localai_tpu.modelmgr.loader import ModelLoader
+
+        app = AppConfig.from_env(models_path=args.models_path)
+        loader = ModelLoader()
+        caps = Capabilities(app, loader, scan_models_dir(args.models_path))
+        try:
+            caps.tts(caps.resolve(args.model), args.text, args.voice, "", args.output)
+            print(args.output)
+        finally:
+            loader.stop_all()
+        return 0
+
+    if args.cmd == "transcript":
+        from localai_tpu.capabilities import Capabilities
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.config.model_config import scan_models_dir
+        from localai_tpu.modelmgr.loader import ModelLoader
+
+        app = AppConfig.from_env(models_path=args.models_path)
+        loader = ModelLoader()
+        caps = Capabilities(app, loader, scan_models_dir(args.models_path))
+        try:
+            res = caps.transcribe(caps.resolve(args.model), args.file, args.language, False)
+            print(res.text)
+        finally:
+            loader.stop_all()
+        return 0
+
+    if args.cmd == "worker":
+        # multi-host: join the jax distributed service and block; the
+        # coordinator (process 0) owns the HTTP port (replaces the
+        # reference's p2p rpc-server worker mode, core/cli/worker/)
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        print(f"worker {args.process_id}/{args.num_processes} joined "
+              f"{args.coordinator}; devices: {jax.local_device_count()} local")
+        import time
+
+        while True:
+            time.sleep(60)
+
+    if args.cmd == "util":
+        if args.util_cmd == "model-info":
+            import json
+
+            from localai_tpu.models.llama import LlamaConfig
+
+            cfg_path = os.path.join(args.path, "config.json")
+            cfg = LlamaConfig.from_json(cfg_path)
+            print(json.dumps(cfg.__dict__, default=str, indent=2))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,11 @@ class ModelConfig:
     template: TemplateConfig = dataclasses.field(default_factory=TemplateConfig)
     function: FunctionsConfig = dataclasses.field(default_factory=FunctionsConfig)
     system_prompt: str = ""
+    # response post-processing (reference: Finetune, core/backend/llm.go:179-227)
+    cutstrings: list = dataclasses.field(default_factory=list)
+    extract_regex: list = dataclasses.field(default_factory=list)
+    trimspace: list = dataclasses.field(default_factory=list)
+    trimsuffix: list = dataclasses.field(default_factory=list)
     # TPU-native knobs (replace gpu_layers/tensor_split/low_vram/...)
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"

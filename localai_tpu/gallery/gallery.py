@@ -1,0 +1,116 @@
+"""Model gallery: index fetch, install, delete.
+
+Parity with the reference gallery (reference: core/gallery/gallery.go:19-85
+InstallModelFromGallery, models.go:99 InstallModel — download files with
+sha256 + progress, write the model config YAML with overrides; `@gallery`
+refs; delete removes config + files).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+import yaml
+
+from localai_tpu.gallery import downloader
+
+log = logging.getLogger("localai_tpu.gallery")
+
+
+def load_gallery_index(galleries: list) -> list:
+    """galleries: [{name, url}] -> flat list of model entries with _gallery."""
+    out = []
+    for g in galleries:
+        url = g.get("url", "")
+        try:
+            if url.startswith("file://"):
+                with open(url[len("file://"):]) as f:
+                    entries = yaml.safe_load(f) or []
+            else:
+                import httpx
+
+                resp = httpx.get(downloader.resolve_uri(url), timeout=30.0,
+                                 follow_redirects=True)
+                resp.raise_for_status()
+                entries = yaml.safe_load(resp.text) or []
+            for e in entries:
+                e["_gallery"] = g.get("name", "")
+            out.extend(entries)
+        except Exception:
+            log.exception("failed to load gallery %s", url)
+    return out
+
+
+def find_model(index: list, name: str) -> Optional[dict]:
+    """Resolve 'model' or 'gallery@model' refs (reference: gallery.go:44-72)."""
+    gallery = ""
+    if "@" in name:
+        gallery, _, name = name.partition("@")
+    for e in index:
+        if e.get("name") == name and (not gallery or e.get("_gallery") == gallery):
+            return e
+    return None
+
+
+def install_model(entry: dict, models_path: str, overrides: Optional[dict] = None,
+                  progress: Optional[Callable] = None, name_override: str = ""):
+    """Download the entry's files + write its config YAML."""
+    name = name_override or entry.get("name", "model")
+    os.makedirs(models_path, exist_ok=True)
+
+    files = entry.get("files", [])
+    n = len(files)
+    for i, f in enumerate(files):
+        dest = os.path.join(models_path, f.get("filename", os.path.basename(f["uri"])))
+        def file_progress(done, total, _i=i):
+            if progress:
+                progress((_i + done / max(total, 1)) / max(n, 1), f"downloading {dest}")
+        log.info("downloading %s -> %s", f["uri"], dest)
+        downloader.download_file(f["uri"], dest, f.get("sha256", ""), file_progress)
+
+    config = {}
+    # inline config or a config_file URL (reference: models.go config handling)
+    if entry.get("config_file"):
+        cf = entry["config_file"]
+        if isinstance(cf, dict):
+            config = dict(cf)
+        elif isinstance(cf, str) and cf.startswith(("http", "file://", "github:")):
+            import tempfile
+
+            with tempfile.NamedTemporaryFile(suffix=".yaml", delete=False) as tmp:
+                downloader.download_file(cf, tmp.name)
+                with open(tmp.name) as fh:
+                    config = yaml.safe_load(fh) or {}
+            os.unlink(tmp.name)
+        else:
+            config = yaml.safe_load(cf) or {}
+    if entry.get("url") and not config:
+        config = {"name": name}
+    config.update(overrides or {})
+    config["name"] = name
+
+    cfg_path = os.path.join(models_path, f"{name}.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(config, f, sort_keys=False)
+    if progress:
+        progress(1.0, "done")
+    return cfg_path
+
+
+def delete_model(name: str, models_path: str):
+    """Remove config + referenced weight files (reference: DeleteModelFromSystem)."""
+    cfg_path = os.path.join(models_path, f"{name}.yaml")
+    if os.path.exists(cfg_path):
+        try:
+            with open(cfg_path) as f:
+                cfg = yaml.safe_load(f) or {}
+            model_file = (cfg.get("parameters") or {}).get("model") or cfg.get("model")
+            if model_file:
+                p = os.path.join(models_path, model_file)
+                if os.path.isfile(p):
+                    os.unlink(p)
+        except Exception:
+            log.exception("failed reading config for delete of %s", name)
+        os.unlink(cfg_path)

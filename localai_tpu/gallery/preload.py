@@ -1,0 +1,67 @@
+"""Resolve CLI model args into installed models.
+
+Parity with the reference's model preload (reference: pkg/startup/
+model_preload.go InstallModels — embedded shortcuts, URLs to YAML configs,
+gallery names, raw weight URLs, local paths).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+import yaml
+
+log = logging.getLogger("localai_tpu.gallery.preload")
+
+
+def install_models(names: list, models_path: str, galleries: list):
+    os.makedirs(models_path, exist_ok=True)
+    for name in names:
+        try:
+            _install_one(name, models_path, galleries)
+        except Exception:
+            log.exception("failed to install %s", name)
+
+
+def _install_one(name: str, models_path: str, galleries: list):
+    from localai_tpu.gallery import downloader as dl
+    from localai_tpu.gallery.gallery import find_model, install_model, load_gallery_index
+
+    if os.path.isdir(name):
+        # local HF checkpoint dir: write a config pointing at it
+        cfg_name = os.path.basename(name.rstrip("/"))
+        cfg = {"name": cfg_name, "backend": "tpu-llm",
+               "parameters": {"model": os.path.abspath(name)}}
+        with open(os.path.join(models_path, f"{cfg_name}.yaml"), "w") as f:
+            yaml.safe_dump(cfg, f)
+        return
+    if os.path.isfile(name) and name.endswith((".yaml", ".yml")):
+        shutil.copy(name, models_path)
+        return
+    if name.startswith(("http://", "https://", "file://", "github:")):
+        if name.endswith((".yaml", ".yml")):
+            dest = os.path.join(models_path, os.path.basename(name.split("?")[0]))
+            dl.download_file(name, dest)
+            return
+        # raw weights URL: download + minimal config
+        fname = os.path.basename(name.split("?")[0])
+        dl.download_file(name, os.path.join(models_path, fname))
+        base = os.path.splitext(fname)[0]
+        with open(os.path.join(models_path, f"{base}.yaml"), "w") as f:
+            yaml.safe_dump({"name": base, "parameters": {"model": fname}}, f)
+        return
+    if name.startswith(("huggingface://", "hf://")):
+        fname = name.split("/")[-1]
+        dl.download_file(name, os.path.join(models_path, fname))
+        base = os.path.splitext(fname)[0]
+        with open(os.path.join(models_path, f"{base}.yaml"), "w") as f:
+            yaml.safe_dump({"name": base, "parameters": {"model": fname}}, f)
+        return
+    # gallery name
+    index = load_gallery_index(galleries)
+    entry = find_model(index, name)
+    if entry is None:
+        raise ValueError(f"unknown model {name!r} (not a path/URL/gallery entry)")
+    install_model(entry, models_path)

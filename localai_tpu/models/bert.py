@@ -1,0 +1,173 @@
+"""BERT-family encoder for embeddings, functional JAX.
+
+Capability parity with the reference's embedding backends (reference:
+backend/go/llm/bert/bert.go bert-embeddings; backend/python/
+sentencetransformers/backend.py mean-pooling embeddings). Layers are
+stacked for lax.scan like the llama stack; batched inputs with attention
+masking; mean-pool + L2 normalize (sentence-transformers semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def from_hf_config(cfg: dict, dtype=jnp.float32) -> "BertConfig":
+        return BertConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            max_position_embeddings=cfg.get("max_position_embeddings", 512),
+            type_vocab_size=cfg.get("type_vocab_size", 2),
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+            dtype=dtype,
+        )
+
+    @staticmethod
+    def from_json(path: str, dtype=jnp.float32) -> "BertConfig":
+        with open(path) as f:
+            return BertConfig.from_hf_config(json.load(f), dtype=dtype)
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> dict:
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(key, 12)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "word_embed": init(ks[0], (cfg.vocab_size, D), D),
+        "pos_embed": init(ks[1], (cfg.max_position_embeddings, D), D),
+        "type_embed": init(ks[2], (cfg.type_vocab_size, D), D),
+        "embed_norm_w": jnp.ones((D,), cfg.dtype),
+        "embed_norm_b": jnp.zeros((D,), cfg.dtype),
+        "layers": {
+            "wq": init(ks[3], (L, D, D), D), "bq": jnp.zeros((L, D), cfg.dtype),
+            "wk": init(ks[4], (L, D, D), D), "bk": jnp.zeros((L, D), cfg.dtype),
+            "wv": init(ks[5], (L, D, D), D), "bv": jnp.zeros((L, D), cfg.dtype),
+            "wo": init(ks[6], (L, D, D), D), "bo": jnp.zeros((L, D), cfg.dtype),
+            "attn_norm_w": jnp.ones((L, D), cfg.dtype),
+            "attn_norm_b": jnp.zeros((L, D), cfg.dtype),
+            "w_in": init(ks[7], (L, D, F), D), "b_in": jnp.zeros((L, F), cfg.dtype),
+            "w_out": init(ks[8], (L, F, D), F), "b_out": jnp.zeros((L, D), cfg.dtype),
+            "mlp_norm_w": jnp.ones((L, D), cfg.dtype),
+            "mlp_norm_b": jnp.zeros((L, D), cfg.dtype),
+        },
+    }
+
+
+def encode(params: dict, cfg: BertConfig, tokens: jax.Array, mask: jax.Array):
+    """tokens [B, T] int32, mask [B, T] bool -> hidden [B, T, D]."""
+    B, T = tokens.shape
+    H = cfg.num_heads
+    hd = cfg.hidden_size // H
+    pos = jnp.arange(T, dtype=jnp.int32)
+    x = (jnp.take(params["word_embed"], tokens, axis=0)
+         + params["pos_embed"][None, pos]
+         + params["type_embed"][None, 0][:, None, :])
+    x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"], cfg.layer_norm_eps)
+
+    neg = jnp.float32(-1e30)
+
+    def layer_fn(x, ly):
+        q = (jnp.einsum("btd,de->bte", x, ly["wq"]) + ly["bq"]).reshape(B, T, H, hd)
+        k = (jnp.einsum("btd,de->bte", x, ly["wk"]) + ly["bk"]).reshape(B, T, H, hd)
+        v = (jnp.einsum("btd,de->bte", x, ly["wv"]) + ly["bv"]).reshape(B, T, H, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+        scores = jnp.where(mask[:, None, None, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, -1)
+        attn = jnp.einsum("bte,ed->btd", attn, ly["wo"]) + ly["bo"]
+        x = layer_norm(x + attn, ly["attn_norm_w"], ly["attn_norm_b"], cfg.layer_norm_eps)
+        h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, ly["w_in"]) + ly["b_in"])
+        h = jnp.einsum("btf,fd->btd", h, ly["w_out"]) + ly["b_out"]
+        x = layer_norm(x + h, ly["mlp_norm_w"], ly["mlp_norm_b"], cfg.layer_norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return x
+
+
+def embed(params: dict, cfg: BertConfig, tokens: jax.Array, mask: jax.Array,
+          normalize: bool = True):
+    """Mean-pooled sentence embeddings [B, D] (sentence-transformers style)."""
+    hidden = encode(params, cfg, tokens, mask)
+    m = mask[:, :, None].astype(hidden.dtype)
+    pooled = jnp.sum(hidden * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+    if normalize:
+        pooled = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return pooled
+
+
+def load_hf_params(model_dir: str, cfg: BertConfig) -> dict:
+    """Load HF bert-style safetensors into the stacked pytree."""
+    from localai_tpu.engine.weights import _open_shards
+
+    tensors = _open_shards(model_dir)
+
+    def get(name):
+        for prefix in ("", "bert.", "model."):
+            if prefix + name in tensors:
+                h = tensors[prefix + name]
+                return h.get_tensor(prefix + name)
+        raise KeyError(name)
+
+    L = cfg.num_layers
+    p = "encoder.layer.{i}."
+
+    def stack(fmt, transpose=False):
+        mats = [get(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), cfg.dtype)
+
+    return {
+        "word_embed": jnp.asarray(get("embeddings.word_embeddings.weight"), cfg.dtype),
+        "pos_embed": jnp.asarray(get("embeddings.position_embeddings.weight"), cfg.dtype),
+        "type_embed": jnp.asarray(get("embeddings.token_type_embeddings.weight"), cfg.dtype),
+        "embed_norm_w": jnp.asarray(get("embeddings.LayerNorm.weight"), cfg.dtype),
+        "embed_norm_b": jnp.asarray(get("embeddings.LayerNorm.bias"), cfg.dtype),
+        "layers": {
+            "wq": stack(p + "attention.self.query.weight", True),
+            "bq": stack(p + "attention.self.query.bias"),
+            "wk": stack(p + "attention.self.key.weight", True),
+            "bk": stack(p + "attention.self.key.bias"),
+            "wv": stack(p + "attention.self.value.weight", True),
+            "bv": stack(p + "attention.self.value.bias"),
+            "wo": stack(p + "attention.output.dense.weight", True),
+            "bo": stack(p + "attention.output.dense.bias"),
+            "attn_norm_w": stack(p + "attention.output.LayerNorm.weight"),
+            "attn_norm_b": stack(p + "attention.output.LayerNorm.bias"),
+            "w_in": stack(p + "intermediate.dense.weight", True),
+            "b_in": stack(p + "intermediate.dense.bias"),
+            "w_out": stack(p + "output.dense.weight", True),
+            "b_out": stack(p + "output.dense.bias"),
+            "mlp_norm_w": stack(p + "output.LayerNorm.weight"),
+            "mlp_norm_b": stack(p + "output.LayerNorm.bias"),
+        },
+    }

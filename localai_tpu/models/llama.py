@@ -251,10 +251,12 @@ def decode_step(
         q, k, v = _project_qkv(h, layer, cfg)  # q [S,1,H,hd], k/v [S,1,KV,hd]
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # scatter new k/v at [slot, lengths[slot]]
-        onehot = jax.nn.one_hot(lengths, C, dtype=ck.dtype)  # [S, C]
-        lk = ck[li] * (1 - onehot[:, :, None, None]) + onehot[:, :, None, None] * k.astype(ck.dtype)
-        lv = cv[li] * (1 - onehot[:, :, None, None]) + onehot[:, :, None, None] * v.astype(cv.dtype)
+        # scatter new k/v at [slot, lengths[slot]] — single scatter op; an
+        # out-of-range position (lengths==C) is dropped by XLA scatter
+        # semantics, preserving the documented capacity invariant
+        slot_idx = jnp.arange(S, dtype=jnp.int32)
+        lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
+        lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
         ck = ck.at[li].set(lk)
         cv = cv.at[li].set(lv)
         attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)  # [S,H,hd]

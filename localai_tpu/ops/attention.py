@@ -5,6 +5,11 @@ paged decode) in localai_tpu/ops/pallas/ replace them on TPU via the
 dispatch switch in localai_tpu/ops/__init__.py. Keeping a pure-jnp path
 means every test runs hermetically on the 8-device CPU mesh.
 
+GQA is computed with grouped einsums — queries reshaped to
+[.., KV, G, hd] against un-repeated keys — NOT by materializing
+jnp.repeat(k, G) (which multiplies decode HBM traffic by G; measured 8x
+slowdown on a 1B model at G=8).
+
 Role parity: this is the attention inside the reference's hot loop
 (llama.cpp's llama_decode, driven from grpc-server.cpp:1941).
 """
@@ -17,13 +22,6 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _repeat_kv(k: jax.Array, q_per_kv: int) -> jax.Array:
-    """[.., KV, hd] -> [.., KV*q_per_kv, hd] for GQA."""
-    if q_per_kv == 1:
-        return k
-    return jnp.repeat(k, q_per_kv, axis=-2)
-
-
 def causal_attention(q, k, v, valid, q_per_kv: int):
     """Prefill attention.
 
@@ -31,16 +29,17 @@ def causal_attention(q, k, v, valid, q_per_kv: int):
     Returns [B, T, H, hd].
     """
     dtype = q.dtype
-    hd = q.shape[-1]
-    k = _repeat_kv(k, q_per_kv)
-    v = _repeat_kv(v, q_per_kv)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
-    T = q.shape[1]
+    B, T, H, hd = q.shape
+    KV = k.shape[2]
+    qg = q.reshape(B, T, KV, q_per_kv, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
     causal = jnp.tril(jnp.ones((T, T), bool))
-    mask = causal[None, None, :, :] & valid[:, None, None, :]
-    scores = jnp.where(mask, scores, _NEG_INF)
+    mask = causal[None, :, :] & valid[:, None, None, :]          # [B, T, S]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(B, T, H, hd)
 
 
 def mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, q_per_kv: int):
@@ -52,19 +51,20 @@ def mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, q_per_kv: in
     kp < start_pos + seq_lens (excludes garbage keys written by chunk padding).
     """
     dtype = q.dtype
-    hd = q.shape[-1]
-    k = _repeat_kv(k_rows, q_per_kv)
-    v = _repeat_kv(v_rows, q_per_kv)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
-    B, T = q.shape[:2]
+    B, T, H, hd = q.shape
     C = k_rows.shape[1]
+    KV = k_rows.shape[2]
+    qg = q.reshape(B, T, KV, q_per_kv, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k_rows).astype(jnp.float32) * scale
     abs_q = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]      # [B, T]
     kp = jnp.arange(C, dtype=jnp.int32)                                        # [C]
     mask = kp[None, None, :] <= abs_q[:, :, None]                              # [B, T, C]
     mask &= kp[None, None, :] < (start_pos + seq_lens)[:, None, None]
-    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_rows)
+    return out.reshape(B, T, H, hd)
 
 
 def decode_attention(q, cache_k, cache_v, lengths, q_per_kv: int):
@@ -74,12 +74,14 @@ def decode_attention(q, cache_k, cache_v, lengths, q_per_kv: int):
     positions are [0, lengths[s])). Returns [S, H, hd].
     """
     dtype = q.dtype
-    hd = q.shape[-1]
-    k = _repeat_kv(cache_k, q_per_kv)  # [S, C, H, hd]
-    v = _repeat_kv(cache_v, q_per_kv)
-    scores = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) / jnp.sqrt(hd).astype(jnp.float32)
+    S, H, hd = q.shape
     C = cache_k.shape[1]
+    KV = cache_k.shape[2]
+    qg = q.reshape(S, KV, q_per_kv, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    scores = jnp.einsum("skgd,sckd->skgc", qg, cache_k).astype(jnp.float32) * scale
     mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]  # [S, C]
-    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("shc,schd->shd", probs, v)
+    out = jnp.einsum("skgc,sckd->skgd", probs, cache_v)
+    return out.reshape(S, H, hd)

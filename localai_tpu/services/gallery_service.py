@@ -1,0 +1,148 @@
+"""Gallery job queue: serialized async install/delete worker.
+
+Parity with the reference's gallery service (reference: core/services/
+gallery.go:18-31 op struct + :65-100 serialized channel worker; status
+polled at /models/jobs/:uuid).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import uuid
+from typing import Optional
+
+log = logging.getLogger("localai_tpu.services.gallery")
+
+
+class GalleryService:
+    def __init__(self, app_config, caps):
+        self.app = app_config
+        self.caps = caps
+        self._jobs: dict[str, dict] = {}
+        self._queue: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="gallery", daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._stop = True
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ---- API surface ----
+
+    def submit_apply(self, spec: dict) -> str:
+        job_id = str(uuid.uuid4())
+        with self._lock:
+            self._jobs[job_id] = {"processed": False, "progress": 0.0,
+                                  "message": "queued", "error": None,
+                                  "file_name": "", "gallery_model_name": spec.get("id", "")}
+        self._queue.put((job_id, "apply", spec))
+        return job_id
+
+    def submit_delete(self, name: str) -> str:
+        job_id = str(uuid.uuid4())
+        with self._lock:
+            self._jobs[job_id] = {"processed": False, "progress": 0.0,
+                                  "message": "queued", "error": None,
+                                  "gallery_model_name": name}
+        self._queue.put((job_id, "delete", {"name": name}))
+        return job_id
+
+    def job_status(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            st = self._jobs.get(job_id)
+            return dict(st) if st else None
+
+    def all_jobs(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._jobs.items()}
+
+    def list_available(self) -> list:
+        from localai_tpu.gallery.gallery import load_gallery_index
+
+        index = load_gallery_index(self.app.galleries)
+        return [
+            {"name": e.get("name"), "gallery": e.get("_gallery"),
+             "license": e.get("license", ""), "description": e.get("description", ""),
+             "urls": e.get("urls", []), "tags": e.get("tags", []),
+             "installed": e.get("name") in self.caps.configs}
+            for e in index
+        ]
+
+    # ---- worker ----
+
+    def _run(self):
+        while not self._stop:
+            item = self._queue.get()
+            if item is None:
+                continue
+            job_id, op, spec = item
+            try:
+                self._update(job_id, message="processing")
+                if op == "apply":
+                    self._apply(job_id, spec)
+                elif op == "delete":
+                    self._delete(job_id, spec["name"])
+                self._update(job_id, processed=True, progress=1.0, message="completed")
+            except Exception as e:
+                log.exception("gallery job %s failed", job_id)
+                self._update(job_id, processed=True, error=str(e), message="error")
+
+    def _update(self, job_id: str, **kw):
+        with self._lock:
+            if job_id in self._jobs:
+                self._jobs[job_id].update(kw)
+
+    def _apply(self, job_id: str, spec: dict):
+        from localai_tpu.config.model_config import scan_models_dir
+        from localai_tpu.gallery.gallery import find_model, install_model, load_gallery_index
+
+        def progress(frac, msg):
+            self._update(job_id, progress=float(frac), message=msg)
+
+        name = spec.get("id") or spec.get("name") or ""
+        overrides = spec.get("overrides") or {}
+        if spec.get("url"):
+            # direct config URL install
+            import tempfile
+
+            from localai_tpu.gallery import downloader as dl
+
+            with tempfile.NamedTemporaryFile(suffix=".yaml", delete=False) as tmp:
+                dl.download_file(spec["url"], tmp.name)
+            import os
+
+            import yaml
+
+            with open(tmp.name) as f:
+                config = yaml.safe_load(f) or {}
+            os.unlink(tmp.name)
+            entry = {"name": spec.get("name") or config.get("name", "model"),
+                     "config_file": config, "files": spec.get("files", [])}
+            install_model(entry, self.app.models_path, overrides, progress)
+        else:
+            index = load_gallery_index(self.app.galleries)
+            entry = find_model(index, name)
+            if entry is None:
+                raise ValueError(f"model {name!r} not found in galleries")
+            install_model(entry, self.app.models_path, overrides, progress,
+                          name_override=spec.get("name", ""))
+        self.caps.configs.update(scan_models_dir(self.app.models_path))
+
+    def _delete(self, job_id: str, name: str):
+        from localai_tpu.gallery.gallery import delete_model
+
+        delete_model(name, self.app.models_path)
+        self.caps.configs.pop(name, None)
+        try:
+            self.caps.loader.shutdown_model(name, force=True)
+        except Exception:
+            pass

@@ -1,0 +1,63 @@
+"""Metrics: api_call duration histogram, Prometheus text exposition.
+
+Parity with the reference (reference: core/services/metrics.go:18-45 — an
+OTel meter exporting one `api_call` histogram tagged method/path, served at
+GET /metrics). Hand-rolled exposition keeps the dependency surface zero.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+            30.0, 60.0, 120.0, 300.0)
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (method, path) -> [bucket counts..., +inf], sum, count
+        self._hist = defaultdict(lambda: [[0] * (len(_BUCKETS) + 1), 0.0, 0])
+        self._counters = defaultdict(int)
+
+    def observe_api_call(self, method: str, path: str, seconds: float):
+        with self._lock:
+            h = self._hist[(method, path)]
+            for i, b in enumerate(_BUCKETS):
+                if seconds <= b:
+                    h[0][i] += 1
+                    break
+            else:
+                h[0][-1] += 1
+            h[1] += seconds
+            h[2] += 1
+
+    def inc(self, name: str, labels: str = ""):
+        with self._lock:
+            self._counters[(name, labels)] += 1
+
+    def render(self) -> str:
+        lines = [
+            "# HELP localai_api_call Duration of API calls",
+            "# TYPE localai_api_call histogram",
+        ]
+        with self._lock:
+            for (method, path), (buckets, total, count) in sorted(self._hist.items()):
+                labels = f'method="{method}",path="{path}"'
+                cum = 0
+                for i, b in enumerate(_BUCKETS):
+                    cum += buckets[i]
+                    lines.append(
+                        f'localai_api_call_bucket{{{labels},le="{b}"}} {cum}')
+                cum += buckets[-1]
+                lines.append(f'localai_api_call_bucket{{{labels},le="+Inf"}} {cum}')
+                lines.append(f'localai_api_call_sum{{{labels}}} {total:.6f}')
+                lines.append(f'localai_api_call_count{{{labels}}} {count}')
+            for (name, labels), v in sorted(self._counters.items()):
+                label_part = f"{{{labels}}}" if labels else ""
+                lines.append(f"localai_{name}{label_part} {v}")
+        return "\n".join(lines) + "\n"
+
+
+METRICS = Metrics()

@@ -1,0 +1,231 @@
+"""API-level integration tests: full HTTP app + fake backend over a socket.
+
+Mirrors the reference's app_test.go (boots startup + HTTP on a port per
+suite, drives it with a real client) but hermetic via the fake backend.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import httpx
+import pytest
+
+from localai_tpu.api.app import build_app, run_app
+from localai_tpu.backend.fake import FakeServicer
+from localai_tpu.capabilities import Capabilities
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.modelmgr.loader import ModelLoader
+from localai_tpu.modelmgr.process import free_port
+
+
+class ServerHandle:
+    def __init__(self, port, loader, base):
+        self.port = port
+        self.loader = loader
+        self.base = base
+
+
+@pytest.fixture(scope="module")
+def server():
+    port = free_port()
+    app_config = AppConfig(models_path="/tmp/localai-test-models",
+                           address=f"127.0.0.1:{port}")
+    loader = ModelLoader(health_attempts=100, health_interval_s=0.1)
+    loader.register_embedded("fake", FakeServicer)
+    loader.register_embedded("local-store", FakeServicer)
+    configs = {
+        "tiny": ModelConfig(name="tiny", backend="fake", model="tiny"),
+        "embedder": ModelConfig(name="embedder", backend="fake", model="emb",
+                                embeddings=True),
+    }
+    caps = Capabilities(app_config, loader, configs)
+    app = build_app(caps, app_config)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_box = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            runner_box["runner"] = await run_app(app, app_config.address)
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    handle = ServerHandle(port, loader, f"http://127.0.0.1:{port}")
+    yield handle
+    loop.call_soon_threadsafe(loop.stop)
+    loader.stop_all()
+
+
+def test_healthz_and_version(server):
+    assert httpx.get(f"{server.base}/healthz").status_code == 200
+    v = httpx.get(f"{server.base}/version").json()
+    assert "version" in v
+
+
+def test_list_models(server):
+    r = httpx.get(f"{server.base}/v1/models").json()
+    names = {m["id"] for m in r["data"]}
+    assert {"tiny", "embedder"} <= names
+
+
+def test_chat_completion_nonstream(server):
+    r = httpx.post(f"{server.base}/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hello there general"}],
+    }, timeout=60)
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["object"] == "chat.completion"
+    content = body["choices"][0]["message"]["content"]
+    assert "hello" in content  # fake echoes the prompt words
+    assert body["usage"]["total_tokens"] > 0
+
+
+def test_chat_completion_stream_sse(server):
+    with httpx.stream("POST", f"{server.base}/v1/chat/completions", json={
+        "model": "tiny", "stream": True,
+        "messages": [{"role": "user", "content": "one two three"}],
+    }, timeout=60) as r:
+        assert r.status_code == 200
+        assert r.headers["content-type"].startswith("text/event-stream")
+        events = []
+        for line in r.iter_lines():
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    text = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert "one" in text and "three" in text
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert "usage" in chunks[-1]
+
+
+def test_completions_endpoint(server):
+    r = httpx.post(f"{server.base}/v1/completions", json={
+        "model": "tiny", "prompt": "alpha beta gamma",
+    }, timeout=60)
+    assert r.status_code == 200
+    assert "alpha" in r.json()["choices"][0]["text"]
+
+
+def test_completions_multiple_prompts(server):
+    r = httpx.post(f"{server.base}/v1/completions", json={
+        "model": "tiny", "prompt": ["a b", "c d"],
+    }, timeout=60)
+    ch = r.json()["choices"]
+    assert len(ch) == 2
+    assert ch[0]["index"] == 0 and ch[1]["index"] == 1
+
+
+def test_edits_endpoint(server):
+    r = httpx.post(f"{server.base}/v1/edits", json={
+        "model": "tiny", "instruction": "fix", "input": "teh cat",
+    }, timeout=60)
+    assert r.status_code == 200
+    assert r.json()["object"] == "edit"
+
+
+def test_embeddings_endpoint(server):
+    r = httpx.post(f"{server.base}/v1/embeddings", json={
+        "model": "embedder", "input": ["hello", "world"],
+    }, timeout=60)
+    data = r.json()["data"]
+    assert len(data) == 2
+    assert len(data[0]["embedding"]) == 16
+    assert data[0]["embedding"] != data[1]["embedding"]
+
+
+def test_tokenize_endpoint(server):
+    r = httpx.post(f"{server.base}/v1/tokenize", json={
+        "model": "tiny", "content": "a b c d",
+    }, timeout=60)
+    assert len(r.json()["tokens"]) == 4
+
+
+def test_rerank_endpoint(server):
+    r = httpx.post(f"{server.base}/v1/rerank", json={
+        "model": "tiny", "query": "apple pie",
+        "documents": ["banana bread", "apple pie recipe", "car manual"],
+        "top_n": 2,
+    }, timeout=60)
+    results = r.json()["results"]
+    assert len(results) == 2
+    assert results[0]["index"] == 1  # best match
+
+
+def test_tts_endpoint(server):
+    r = httpx.post(f"{server.base}/tts", json={
+        "model": "tiny", "input": "hello",
+    }, timeout=60)
+    assert r.status_code == 200
+    assert r.headers["content-type"] == "audio/wav"
+    assert r.content[:4] == b"RIFF"
+
+
+def test_stores_roundtrip(server):
+    httpx.post(f"{server.base}/stores/set", json={
+        "keys": [[1.0, 0.0], [0.0, 1.0]], "values": ["a", "b"],
+    }, timeout=60)
+    found = httpx.post(f"{server.base}/stores/find", json={
+        "key": [0.9, 0.1], "topk": 1,
+    }, timeout=60).json()
+    assert found["values"] == ["a"]
+
+
+def test_metrics_endpoint(server):
+    r = httpx.get(f"{server.base}/metrics")
+    assert "localai_api_call" in r.text
+
+
+def test_system_endpoint(server):
+    r = httpx.get(f"{server.base}/system").json()
+    assert "devices" in r
+
+
+def test_backend_monitor_and_shutdown(server):
+    r = httpx.post(f"{server.base}/backend/monitor", json={"model": "tiny"}, timeout=60)
+    assert r.status_code == 200
+    assert r.json()["state"] == "READY"
+    r = httpx.post(f"{server.base}/backend/shutdown", json={"model": "tiny"}, timeout=60)
+    assert r.status_code == 200
+    assert "tiny" not in server.loader.list_loaded()
+
+
+def test_unknown_model_404s_cleanly(server):
+    r = httpx.post(f"{server.base}/v1/chat/completions", json={
+        "model": "definitely-not-a-model",
+        "messages": [{"role": "user", "content": "x"}],
+    }, timeout=120)
+    assert r.status_code == 500
+    assert "error" in r.json()
+
+
+def test_bad_json_400(server):
+    r = httpx.post(f"{server.base}/v1/chat/completions",
+                   content=b"{not json", headers={"Content-Type": "application/json"})
+    assert r.status_code == 400
+
+
+def test_missing_messages_400(server):
+    r = httpx.post(f"{server.base}/v1/chat/completions", json={"model": "tiny"})
+    assert r.status_code == 400
+
+
+def test_elevenlabs_tts_compat(server):
+    r = httpx.post(f"{server.base}/v1/text-to-speech/voice123", json={
+        "model_id": "tiny", "text": "hello",
+    }, timeout=60)
+    assert r.status_code == 200
+    assert r.content[:4] == b"RIFF"

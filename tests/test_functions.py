@@ -1,0 +1,90 @@
+"""Function-calling: grammar generation + response parsing (hermetic)."""
+
+import json
+
+from localai_tpu.config.model_config import FunctionsConfig
+from localai_tpu.functions import parse
+from localai_tpu.functions.grammars import json_schema
+
+
+def test_schema_to_grammar_basic():
+    g = json_schema.schema_to_grammar({
+        "type": "object",
+        "properties": {"name": {"type": "string"}, "age": {"type": "integer"}},
+        "required": ["name", "age"],
+    })
+    assert "root ::=" in g
+    assert '"\\"name\\""' in g
+    assert "integer ::=" in g
+
+
+def test_grammar_for_functions_single():
+    g = json_schema.grammar_for_functions([
+        {"name": "get_weather",
+         "parameters": {"type": "object",
+                        "properties": {"city": {"type": "string"}},
+                        "required": ["city"]}},
+    ])
+    assert '"\\"get_weather\\""' in g
+    assert "root ::=" in g
+
+
+def test_grammar_for_functions_multiple_enum():
+    g = json_schema.grammar_for_functions([
+        {"name": "a", "parameters": {"type": "object"}},
+        {"name": "b", "parameters": {"type": "object"}},
+    ])
+    assert '"\\"a\\""' in g and '"\\"b\\""' in g
+
+
+def test_parse_plain_json_call():
+    calls = parse.parse_function_calls(
+        '{"name": "get_weather", "arguments": {"city": "Paris"}}')
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_parse_json_embedded_in_text():
+    calls = parse.parse_function_calls(
+        'Sure! Here is the call: {"name": "f", "arguments": {"x": 1}} hope that helps')
+    assert calls and calls[0].name == "f"
+
+
+def test_parse_multiple_calls_array():
+    calls = parse.parse_function_calls(
+        '[{"name": "a", "arguments": {}}, {"name": "b", "arguments": {"k": 2}}]')
+    assert [c.name for c in calls] == ["a", "b"]
+
+
+def test_parse_llama31_style():
+    calls = parse.parse_function_calls('<function=search>{"q": "tpu"}</function>')
+    assert calls[0].name == "search"
+    assert json.loads(calls[0].arguments) == {"q": "tpu"}
+
+
+def test_parse_markdown_fenced():
+    calls = parse.parse_function_calls('```json\n{"name": "f", "arguments": {}}\n```')
+    assert calls and calls[0].name == "f"
+
+
+def test_response_regex_named_groups():
+    cfg = FunctionsConfig(response_regex=[r"CALL (?P<name>\w+) WITH (?P<arguments>\{.*\})"])
+    calls = parse.parse_function_calls('CALL foo WITH {"a": 1}', cfg)
+    assert calls[0].name == "foo"
+
+
+def test_custom_keys():
+    cfg = FunctionsConfig(function_name_key="function", function_arguments_key="args")
+    calls = parse.parse_function_calls('{"function": "f", "args": {"z": 3}}', cfg)
+    assert calls[0].name == "f"
+
+
+def test_no_action_filter():
+    cfg = FunctionsConfig(disable_no_action=True, no_action_function_name="answer")
+    calls = parse.parse_function_calls('{"name": "answer", "arguments": {}}', cfg)
+    assert calls == []
+
+
+def test_no_calls_in_plain_text():
+    assert parse.parse_function_calls("just a normal reply, no tools here") == []

@@ -1,0 +1,141 @@
+"""Templates, detok, model-config YAML loading (hermetic, no XLA)."""
+
+import os
+import textwrap
+
+import pytest
+
+from localai_tpu.config import model_config as mcfg
+from localai_tpu.engine.detok import IncrementalDetokenizer
+from localai_tpu.templates import prompts as T
+
+
+# ---------- templates ----------
+
+def test_render_chat_message_default():
+    out = T.render_chat_message(T.DEFAULT_CHAT_MESSAGE,
+                                T.ChatMessageData(role="user", content="hi"))
+    assert out == "user: hi"
+
+
+def test_render_chat_prompt_with_input():
+    out = T.render_chat_prompt("PROMPT:\n{{ Input }}\nASSISTANT:", "user: hi")
+    assert out == "PROMPT:\nuser: hi\nASSISTANT:"
+
+
+def test_render_completion_template():
+    out = T.render_completion("Q: {{ Input }}\nA:", "what?")
+    assert out == "Q: what?\nA:"
+
+
+def test_missing_fields_render_empty():
+    out = T.render_chat_message("{{ Role }}|{{ FunctionName }}|{{ Content }}",
+                                T.ChatMessageData(content="x"))
+    assert out == "||x"
+
+
+def test_multimodal_placeholders_default():
+    out = T.multimodal_placeholders("", "describe this", n_images=2)
+    assert out == "[img-0][img-1]\ndescribe this"
+
+
+def test_multimodal_custom_template():
+    out = T.multimodal_placeholders(
+        "{{ Images }} TEXT: {{ Text }}", "hello", n_images=1)
+    assert out == "[img-0] TEXT: hello"
+
+
+# ---------- detok ----------
+
+class FakeTok:
+    """Maps ids to fixed byte strings; multi-byte chars split across ids."""
+
+    TABLE = {0: b"He", 1: b"llo", 2: b" \xf0\x9f", 3: b"\x98\x80", 4: b"!"}
+
+    def decode(self, ids, skip_special_tokens=True):
+        return b"".join(self.TABLE[i] for i in ids).decode("utf-8", errors="replace")
+
+
+def test_detok_incremental_utf8():
+    d = IncrementalDetokenizer(FakeTok())
+    out = [d.push(0), d.push(1), d.push(2), d.push(3), d.push(4)]
+    # the split emoji must be withheld until complete
+    assert out[2] == ""
+    assert "".join(out) == "Hello 😀!"
+    assert d.text == "Hello 😀!"
+
+
+def test_detok_flush_drops_partial():
+    d = IncrementalDetokenizer(FakeTok())
+    d.push(0)
+    d.push(2)  # incomplete emoji start
+    tail = d.flush()
+    assert "�" not in (d.text + tail)
+
+
+# ---------- model config ----------
+
+def test_load_model_config_yaml(tmp_path):
+    p = tmp_path / "mymodel.yaml"
+    p.write_text(textwrap.dedent("""
+        name: mymodel
+        backend: tpu-llm
+        context_size: 1024
+        parameters:
+          model: weights-dir
+          temperature: 0.2
+          top_p: 0.9
+        stopwords: ["</s>"]
+        template:
+          chat: "{{ Input }}"
+        system_prompt: "be nice"
+    """))
+    mc = mcfg.load_model_config(str(p))
+    assert mc.name == "mymodel"
+    assert mc.model == "weights-dir"
+    assert mc.parameters.temperature == 0.2
+    assert mc.context_size == 1024
+    assert mc.stopwords == ["</s>"]
+    sp = mc.sampling_host()
+    assert sp.temperature == 0.2
+    assert sp.top_p == 0.9
+
+
+def test_request_overrides_beat_config(tmp_path):
+    mc = mcfg.ModelConfig(name="x")
+    mc.parameters.temperature = 0.1
+    sp = mc.sampling_host({"temperature": 0.9})
+    assert sp.temperature == 0.9
+
+
+def test_scan_models_dir_skips_broken(tmp_path):
+    (tmp_path / "good.yaml").write_text("name: good\n")
+    (tmp_path / "bad.yaml").write_text("{ not yaml ::")
+    configs = mcfg.scan_models_dir(str(tmp_path))
+    assert "good" in configs
+    assert len(configs) == 1
+
+
+def test_name_defaults_to_filename(tmp_path):
+    (tmp_path / "implicit.yaml").write_text("backend: fake\n")
+    configs = mcfg.scan_models_dir(str(tmp_path))
+    assert "implicit" in configs
+
+
+def test_usecases_heuristics():
+    mc = mcfg.ModelConfig(name="x", embeddings=True)
+    assert mcfg.Usecase.EMBEDDINGS in mc.usecases()
+    mc2 = mcfg.ModelConfig(name="y", backend="tpu-whisper")
+    assert mcfg.Usecase.TRANSCRIPT in mc2.usecases()
+
+
+def test_multi_config_file(tmp_path):
+    p = tmp_path / "multi.yaml"
+    p.write_text(textwrap.dedent("""
+        - name: a
+          parameters: {model: ma}
+        - name: b
+          parameters: {model: mb}
+    """))
+    configs = mcfg.load_multi_config(str(p))
+    assert [c.name for c in configs] == ["a", "b"]
