@@ -35,7 +35,7 @@ def causal_attention(q, k, v, valid, q_per_kv: int):
     scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
     scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
     causal = jnp.tril(jnp.ones((T, T), bool))
-    mask = causal[None, :, :] & valid[:, None, None, :]          # [B, T, S]
+    mask = causal[None, :, :] & valid[:, None, :]                # [B, T, S]
     scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
