@@ -129,9 +129,12 @@ async def chat_completions(request):
                                   "finish_reason": None}]}
             yield first
             usage = [0, 0]
+            finish = "stop"
             for chunk in state.caps.inference_stream(mc, prompt, overrides,
                                                      correlation_id):
                 usage = [chunk.prompt_tokens, chunk.completion_tokens]
+                if chunk.finish_reason:
+                    finish = chunk.finish_reason
                 if chunk.text:
                     yield {"id": cmpl_id, "object": "chat.completion.chunk",
                            "created": created, "model": model,
@@ -141,7 +144,7 @@ async def chat_completions(request):
             final = {"id": cmpl_id, "object": "chat.completion.chunk",
                      "created": created, "model": model,
                      "choices": [{"index": 0, "delta": {},
-                                  "finish_reason": "stop"}],
+                                  "finish_reason": finish}],
                      "usage": _usage(*usage)}
             yield final
 
@@ -206,8 +209,11 @@ async def completions(request):
 
         def gen():
             usage = [0, 0]
+            finish = "stop"
             for chunk in state.caps.inference_stream(mc, prompt, overrides):
                 usage = [chunk.prompt_tokens, chunk.completion_tokens]
+                if chunk.finish_reason:
+                    finish = chunk.finish_reason
                 if chunk.text:
                     yield {"id": cmpl_id, "object": "text_completion",
                            "created": created, "model": model,
@@ -215,7 +221,7 @@ async def completions(request):
                                         "finish_reason": None}]}
             yield {"id": cmpl_id, "object": "text_completion", "created": created,
                    "model": model,
-                   "choices": [{"index": 0, "text": "", "finish_reason": "stop"}],
+                   "choices": [{"index": 0, "text": "", "finish_reason": finish}],
                    "usage": _usage(*usage)}
 
         q = await state.iter_blocking(gen)

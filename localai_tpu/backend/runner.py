@@ -222,12 +222,33 @@ class EngineServicer(BackendServicer):
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
 
 
+def _apply_platform_env():
+    """Honor LOCALAI_JAX_PLATFORM / LOCALAI_JAX_CPU_DEVICES before any jax use.
+
+    The TPU plugin ignores the JAX_PLATFORMS env var, so spawned backends
+    (e.g. hermetic tests forcing a CPU mesh) need an explicit config hook.
+    """
+    plat = os.environ.get("LOCALAI_JAX_PLATFORM")
+    ndev = os.environ.get("LOCALAI_JAX_CPU_DEVICES")
+    if plat or ndev:
+        import jax
+
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        if ndev:
+            if not ndev.isdigit():
+                raise SystemExit(
+                    f"LOCALAI_JAX_CPU_DEVICES must be an integer, got {ndev!r}")
+            jax.config.update("jax_num_cpu_devices", int(ndev))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--addr", required=True)
     parser.add_argument("--log-level", default="info")
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level.upper())
+    _apply_platform_env()
     servicer = EngineServicer()
     server = make_server(servicer, args.addr)
     server.start()

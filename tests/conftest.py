@@ -19,6 +19,11 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "e2e: full-stack tests spawning real backend subprocesses")
+
+
 @pytest.fixture(scope="session")
 def tiny_llama():
     """A tiny randomly-initialized llama for engine/API tests."""

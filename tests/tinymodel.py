@@ -1,0 +1,67 @@
+"""Tiny random-weights llama checkpoint + byte-level tokenizer fixture.
+
+Writes a real HF-layout model dir (config.json + model.safetensors +
+tokenizer.json) loadable by backend/runner.py over the real engine path —
+the hermetic analogue of the reference's downloaded test models
+(reference: Makefile:435-444 fetches real small weights for app_test.go).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+# 256 byte-level chars + <s>/</s>
+TINY_HF_CONFIG = {
+    "vocab_size": 258,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "rms_norm_eps": 1e-5,
+    "max_position_embeddings": 512,
+    "tie_word_embeddings": False,
+    "rope_theta": 10000.0,
+    "bos_token_id": 0,
+    "eos_token_id": 1,
+    "model_type": "llama",
+}
+
+
+def write_tiny_tokenizer(dst: str):
+    """Byte-level BPE with no merges: every byte is a token. Offline-safe."""
+    from tokenizers import Tokenizer, decoders, models
+    from tokenizers.pre_tokenizers import ByteLevel
+
+    vocab = {"<s>": 0, "</s>": 1}
+    for i, ch in enumerate(sorted(ByteLevel.alphabet())):
+        vocab[ch] = i + 2
+    tok = Tokenizer(models.BPE(vocab=vocab, merges=[]))
+    tok.pre_tokenizer = ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.save(os.path.join(dst, "tokenizer.json"))
+    with open(os.path.join(dst, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<s>", "eos_token": "</s>",
+            "model_max_length": 2048,
+        }, f)
+
+
+def write_tiny_checkpoint(dst: str, seed: int = 0) -> dict:
+    """Random-init tiny llama in HF layout. Returns the HF config dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from localai_tpu.engine import weights
+    from localai_tpu.models import llama
+
+    os.makedirs(dst, exist_ok=True)
+    cfg = llama.LlamaConfig.from_hf_config(TINY_HF_CONFIG, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    weights.save_llama_params(params, cfg, dst)
+    with open(os.path.join(dst, "config.json"), "w") as f:
+        json.dump(TINY_HF_CONFIG, f)
+    write_tiny_tokenizer(dst)
+    return dict(TINY_HF_CONFIG)
