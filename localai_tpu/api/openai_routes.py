@@ -98,16 +98,25 @@ async def chat_completions(request):
     functions = body.get("functions") or [
         t["function"] for t in tools if t.get("type") == "function"
     ]
+    tool_choice = body.get("tool_choice") or body.get("function_call")
+    if tool_choice == "none":
+        functions = []  # OpenAI semantics: tools declared but must not be called
     grammar = ""
     if functions and not body.get("grammar"):
         from localai_tpu.functions.grammars import json_schema
 
-        tool_choice = body.get("tool_choice") or body.get("function_call")
+        force_name = None
+        if isinstance(tool_choice, dict):
+            force_name = ((tool_choice.get("function") or {}).get("name")
+                          or tool_choice.get("name"))
         grammar = json_schema.grammar_for_functions(
-            functions, force=tool_choice not in (None, "auto", "none"),
+            functions, force_name=force_name,
             parallel_calls=bool(body.get("parallel_tool_calls", False)),
+            name_key=mc.function.function_name_key,
+            arguments_key=mc.function.function_arguments_key,
         )
-        overrides["grammar"] = grammar
+        if grammar:
+            overrides["grammar"] = grammar
 
     prompt, images, audios, videos = await state.run_blocking(
         build_chat_prompt, mc, messages, None, functions or None
@@ -130,16 +139,44 @@ async def chat_completions(request):
             yield first
             usage = [0, 0]
             finish = "stop"
+            # under a forced tool grammar the whole output IS the call JSON:
+            # buffer it and emit a tool_calls delta instead of content
+            buffer_tools = bool(functions and grammar)
+            collected = []
             for chunk in state.caps.inference_stream(mc, prompt, overrides,
                                                      correlation_id):
                 usage = [chunk.prompt_tokens, chunk.completion_tokens]
                 if chunk.finish_reason:
                     finish = chunk.finish_reason
                 if chunk.text:
+                    if buffer_tools:
+                        collected.append(chunk.text)
+                    else:
+                        yield {"id": cmpl_id, "object": "chat.completion.chunk",
+                               "created": created, "model": model,
+                               "choices": [{"index": 0,
+                                            "delta": {"content": chunk.text},
+                                            "finish_reason": None}]}
+            if buffer_tools:
+                from localai_tpu.functions import parse as fparse
+
+                calls = fparse.parse_function_calls("".join(collected), mc.function)
+                if calls:
+                    finish = "tool_calls"
+                    yield {"id": cmpl_id, "object": "chat.completion.chunk",
+                           "created": created, "model": model,
+                           "choices": [{"index": 0, "delta": {"tool_calls": [
+                               {"index": i, "id": f"call_{secrets.token_hex(8)}",
+                                "type": "function",
+                                "function": {"name": c.name,
+                                             "arguments": c.arguments}}
+                               for i, c in enumerate(calls)]},
+                               "finish_reason": None}]}
+                elif collected:
                     yield {"id": cmpl_id, "object": "chat.completion.chunk",
                            "created": created, "model": model,
                            "choices": [{"index": 0,
-                                        "delta": {"content": chunk.text},
+                                        "delta": {"content": "".join(collected)},
                                         "finish_reason": None}]}
             final = {"id": cmpl_id, "object": "chat.completion.chunk",
                      "created": created, "model": model,

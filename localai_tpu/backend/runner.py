@@ -126,6 +126,7 @@ class EngineServicer(BackendServicer):
             max_new_tokens=opts.max_tokens or 256,
             stop_sequences=list(opts.stop_sequences),
             ignore_eos=opts.ignore_eos,
+            grammar=opts.grammar,
             request_id=opts.correlation_id or "",
         )
 

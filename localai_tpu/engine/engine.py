@@ -55,6 +55,7 @@ class GenRequest:
     max_new_tokens: int = 256
     stop_sequences: list = dataclasses.field(default_factory=list)
     ignore_eos: bool = False
+    grammar: str = ""               # GBNF constrained decoding
     request_id: str = ""
     # filled by engine:
     out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
@@ -82,6 +83,7 @@ class _Slot:
     __slots__ = (
         "req", "detok", "generated", "held_text", "prompt_len",
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
+        "grammar", "gstate", "bias_base", "cur_penalty",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -94,6 +96,10 @@ class _Slot:
         self.t_first_token = 0.0
         self.n_decoded = 0
         self.t_prefill_ms = 0.0
+        self.grammar = None     # functions.grammars.automaton.Grammar
+        self.gstate = None      # current frozenset state
+        self.bias_base = None   # np [V] logit_bias row under the grammar mask
+        self.cur_penalty = None  # last uploaded penalty row (identity-compared)
 
 
 class Engine:
@@ -149,6 +155,11 @@ class Engine:
 
         self._decode_fn = jax.jit(self._decode_and_sample, donate_argnums=(2, 3, 5, 7))
         self._prefill_fns: dict[int, Callable] = {}
+
+        # grammar-constrained decoding (lazy: built on first grammar request)
+        self._grammar_cache: dict[str, Any] = {}
+        self._mask_builder = None
+        self._token_strs: Optional[list] = None
 
     # ---------- jitted step bodies ----------
 
@@ -279,6 +290,43 @@ class Engine:
             "uptime_s": time.monotonic() - self._load_time,
         }
 
+    # ---------- grammar-constrained decoding ----------
+
+    def _grammar_for(self, text: str):
+        """Compile (cached) + lazily build the vocab mask builder."""
+        from localai_tpu.functions.grammars.automaton import (
+            Grammar, TokenMaskBuilder, token_strings)
+
+        if self._mask_builder is None:
+            self._token_strs = token_strings(self.tokenizer)
+            self._mask_builder = TokenMaskBuilder(
+                self._token_strs, self.eos_ids, self.cfg.vocab_size)
+        g = self._grammar_cache.get(text)
+        if g is None:
+            if len(self._grammar_cache) > 64:
+                self._grammar_cache.clear()
+            g = Grammar.from_text(text)
+            self._grammar_cache[text] = g
+        return g
+
+    def _advance_grammar(self, slot: int, s: _Slot, token_id: int) -> bool:
+        """Advance the slot's grammar by the emitted token and refresh the
+        device bias row. Returns False if the token is outside the grammar
+        (forces a stop)."""
+        piece = (self._token_strs[token_id]
+                 if 0 <= token_id < len(self._token_strs) else None)
+        if piece is None:
+            return False
+        nxt = s.grammar.advance_string(s.gstate, piece)
+        if nxt is None:
+            return False
+        s.gstate = nxt
+        penalty = self._mask_builder.penalty_row(s.grammar, nxt)
+        if penalty is not s.cur_penalty:  # memoized per state: identity == equality
+            self.bias = self.bias.at[slot].set(jnp.asarray(s.bias_base + penalty))
+            s.cur_penalty = penalty
+        return True
+
     # ---------- engine loop ----------
 
     def _bucket_for(self, n: int) -> int:
@@ -388,7 +436,19 @@ class Engine:
         self.rng_keys = sampling.seed_slot_key(
             self.rng_keys, slot, req.params, fallback_seed=hash(req.request_id) & 0x7FFFFFFF
         )
-        self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
+        grammar = gstate = bias_base = penalty0 = None
+        if req.grammar:
+            grammar = self._grammar_for(req.grammar)
+            gstate = grammar.initial_state()
+            bias_base = np.zeros((self.cfg.vocab_size,), np.float32)
+            for tok, b in (req.params.logit_bias or {}).items():
+                t = int(tok)
+                if 0 <= t < bias_base.shape[0]:
+                    bias_base[t] = float(b)
+            penalty0 = self._mask_builder.penalty_row(grammar, gstate)
+            self.bias = self.bias.at[slot].set(jnp.asarray(bias_base + penalty0))
+        else:
+            self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
 
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :T] = ids
@@ -409,6 +469,8 @@ class Engine:
         s = _Slot(req, IncrementalDetokenizer(self.tokenizer), T)
         s.t_prefill_ms = (t1 - t0) * 1e3
         s.t_first_token = t1
+        s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
+        s.cur_penalty = penalty0
         self.slots[slot] = s
         self._emit_token(slot, first_id, first_lp)
 
@@ -432,7 +494,14 @@ class Engine:
         self._total_tokens += 1
         finish = None
 
-        if token_id in self.eos_ids and not s.req.ignore_eos:
+        if token_id in self.eos_ids and not (s.req.ignore_eos and s.grammar is None):
+            # under a grammar, EOS is only reachable when the mask allows it
+            # (grammar accepting/stuck), so it always terminates the request
+            finish = "stop"
+            delta = s.held_text + s.detok.flush()
+        elif s.grammar is not None and not self._advance_grammar(slot, s, token_id):
+            # sampled token fell outside the grammar (masked-to-impossible
+            # state) — terminate rather than emit invalid output
             finish = "stop"
             delta = s.held_text + s.detok.flush()
         elif s.n_decoded >= s.req.max_new_tokens:

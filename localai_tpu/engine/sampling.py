@@ -142,6 +142,11 @@ def sample(logits, slot_params, token_counts, logit_bias, rng_keys):
     inv = jnp.argsort(order, axis=-1)
     keep_typical = jnp.take_along_axis(keep_dev_sorted, inv, axis=-1)
     keep = jnp.where(tp_enabled, keep & keep_typical, keep)
+    # the independent keep-masks can have an empty intersection (typical-p's
+    # lowest-deviation tokens need not lie in the top-p prefix); llama.cpp
+    # applies samplers sequentially so this cannot happen there — guarantee
+    # progress by always keeping the highest-probability candidate
+    keep = keep | (rank == 0)
 
     masked = jnp.where(keep, logp, -jnp.inf)
 

@@ -3,8 +3,8 @@
 Parity with the reference's grammar compiler (reference: pkg/functions/
 grammars/json_schema.go JSONSchemaConverter + bnf.go primitives), written
 fresh: a recursive schema walker emitting llama.cpp-style GBNF. The engine
-consumes this via the grammar automaton (functions/grammars/automaton.py +
-runtime/grammar.cc) to mask logits during sampling.
+consumes this via the grammar automaton (functions/grammars/automaton.py)
+to mask logits during sampling.
 """
 
 from __future__ import annotations
@@ -118,12 +118,19 @@ def schema_to_grammar(schema: dict) -> str:
     return conv.format_grammar(root)
 
 
-def grammar_for_functions(functions: list, force: bool = False,
+def grammar_for_functions(functions: list,
+                          force_name: Optional[str] = None,
                           parallel_calls: bool = False,
                           name_key: str = "name",
                           arguments_key: str = "arguments") -> str:
     """OpenAI tools -> grammar constraining output to function-call JSON
-    (reference: functionsToJSONSchema + grammar options, parse.go:92-150)."""
+    (reference: functionsToJSONSchema + grammar options, parse.go:92-150).
+
+    ``force_name`` narrows the grammar to one named tool (OpenAI
+    tool_choice={"type":"function","function":{"name":...}} semantics).
+    """
+    if force_name:
+        functions = [f for f in functions if f.get("name") == force_name]
     alts = []
     for fn in functions:
         alts.append({

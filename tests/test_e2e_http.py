@@ -172,6 +172,56 @@ def test_stop_sequence_through_engine(real_server):
         assert body["finish_reason"] == "stop"
 
 
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"enum": ["sf", "nyc"]}},
+            "required": ["city"],
+        },
+    },
+}]
+
+
+def test_tool_call_forced_by_grammar(real_server):
+    """Random weights + tools => grammar-masked decoding must yield a
+    syntactically valid tool call (the reference's flagship constrained-
+    decoding behavior, grpc-server.cpp:688,1977)."""
+    r = httpx.post(f"{real_server.base}/v1/chat/completions", json={
+        "model": "tiny", "max_tokens": 96, "temperature": 1.0, "seed": 11,
+        "messages": [{"role": "user", "content": "weather in sf?"}],
+        "tools": TOOLS, "tool_choice": "required",
+    }, timeout=FIRST)
+    assert r.status_code == 200, r.text
+    choice = r.json()["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    calls = choice["message"]["tool_calls"]
+    assert calls[0]["function"]["name"] == "get_weather"
+    args = json.loads(calls[0]["function"]["arguments"])
+    assert args["city"] in ("sf", "nyc")
+
+
+def test_tool_call_streaming(real_server):
+    with httpx.stream("POST", f"{real_server.base}/v1/chat/completions", json={
+        "model": "tiny", "stream": True, "max_tokens": 96, "temperature": 1.0,
+        "seed": 13,
+        "messages": [{"role": "user", "content": "weather please"}],
+        "tools": TOOLS, "tool_choice": "required",
+    }, timeout=FIRST) as r:
+        assert r.status_code == 200
+        events = [json.loads(l[6:]) for l in r.iter_lines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+    tool_chunks = [e for e in events
+                   if e["choices"][0]["delta"].get("tool_calls")]
+    assert tool_chunks, f"no tool_calls delta in stream: {events}"
+    call = tool_chunks[0]["choices"][0]["delta"]["tool_calls"][0]
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"])["city"] in ("sf", "nyc")
+    assert events[-1]["choices"][0]["finish_reason"] == "tool_calls"
+
+
 def test_concurrent_requests_share_slots(real_server):
     import concurrent.futures
 

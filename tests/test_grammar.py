@@ -1,0 +1,176 @@
+"""Grammar-constrained decoding: GBNF parse, pushdown matcher, token
+masks, and end-to-end enforcement in the engine.
+
+The decisive test is the last one: a RANDOM-weights model — which
+unconstrained emits byte soup — is forced by the grammar mask to emit
+syntactically valid JSON matching the tool schema (reference behavior:
+llama.cpp grammar sampling, grpc-server.cpp:688,1977)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.functions.grammars import json_schema
+from localai_tpu.functions.grammars.automaton import (
+    Grammar, GrammarMatcher, TokenMaskBuilder, token_strings)
+from localai_tpu.functions.grammars.gbnf import GrammarError, parse_gbnf
+
+
+# ---------- parser + matcher ----------
+
+def test_literal_and_alternation():
+    g = Grammar.from_text('root ::= "ab" | "cd"')
+    assert g.accepts("ab")
+    assert g.accepts("cd")
+    assert not g.accepts("ac")
+    assert not g.accepts("abx")
+    assert not g.accepts("a")
+
+
+def test_char_class_and_repetition():
+    g = Grammar.from_text('root ::= [a-z]+ [0-9]*')
+    assert g.accepts("abc")
+    assert g.accepts("abc123")
+    assert not g.accepts("123")
+    assert not g.accepts("")
+
+
+def test_optional_and_groups():
+    g = Grammar.from_text('root ::= ("+" | "-")? [0-9]+')
+    assert g.accepts("42")
+    assert g.accepts("-7")
+    assert g.accepts("+1")
+    assert not g.accepts("--1")
+
+
+def test_rule_refs_and_recursion():
+    g = Grammar.from_text('\n'.join([
+        'root ::= value',
+        'value ::= "[" (value ("," value)*)? "]" | [0-9]',
+    ]))
+    assert g.accepts("[]")
+    assert g.accepts("[1,2,[3]]")
+    assert not g.accepts("[1,]")
+
+
+def test_braces_repetition():
+    g = Grammar.from_text('root ::= [a]{2,4}')
+    assert not g.accepts("a")
+    assert g.accepts("aa")
+    assert g.accepts("aaaa")
+    assert not g.accepts("aaaaa")
+
+
+def test_negated_class_and_escapes():
+    g = Grammar.from_text(r'root ::= "\"" [^"]* "\""')
+    assert g.accepts('"hello"')
+    assert not g.accepts('"he"llo"')
+
+
+def test_parse_errors():
+    with pytest.raises(GrammarError):
+        parse_gbnf('root ::= undefined-rule')
+    with pytest.raises(GrammarError):
+        parse_gbnf('notroot ::= "a"')
+    with pytest.raises(GrammarError):
+        parse_gbnf('root ::= "unterminated')
+
+
+def test_json_schema_grammar_accepts_valid_json():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"const": "get_weather"},
+            "arguments": {
+                "type": "object",
+                "properties": {"city": {"type": "string"}},
+                "required": ["city"],
+            },
+        },
+        "required": ["name", "arguments"],
+    }
+    g = Grammar.from_text(json_schema.schema_to_grammar(schema))
+    payload = {"name": "get_weather", "arguments": {"city": "SF"}}
+    assert g.accepts(json.dumps(payload))
+    assert g.accepts('{ "name": "get_weather", "arguments": { "city": "sf" } }')
+    assert not g.accepts('{ "name" "get_weather" }')
+    assert not g.accepts('{"name": "other_fn", "arguments": {"city": "sf"}}')
+
+
+# ---------- token masks ----------
+
+def test_token_mask_allows_only_grammar_tokens(byte_tokenizer):
+    g = Grammar.from_text('root ::= "ab" | "cd"')
+    strs = token_strings(byte_tokenizer)
+    builder = TokenMaskBuilder(strs, {byte_tokenizer.eos_token_id}, 258)
+    st = g.initial_state()
+    mask = builder.allowed(g, st)
+    allowed_chars = {strs[i] for i in np.nonzero(mask)[0]}
+    assert allowed_chars == {"a", "c"}
+    # advance past "ab": grammar complete -> only EOS allowed
+    st2 = g.advance_string(st, "ab")
+    mask2 = builder.allowed(g, st2)
+    ids = set(np.nonzero(mask2)[0].tolist())
+    assert ids == {byte_tokenizer.eos_token_id}
+
+
+def test_token_mask_memoized(byte_tokenizer):
+    g = Grammar.from_text('root ::= [a-z]+')
+    builder = TokenMaskBuilder(token_strings(byte_tokenizer), {0}, 258)
+    st = g.initial_state()
+    m1 = builder.allowed(g, st)
+    m2 = builder.allowed(g, st)
+    assert m1 is m2  # dict hit, not recompute
+
+
+# ---------- engine enforcement ----------
+
+def test_engine_forces_valid_json_from_random_weights(tiny_llama, byte_tokenizer):
+    from localai_tpu.engine import engine as eng
+
+    cfg, params = tiny_llama
+    schema = {
+        "type": "object",
+        "properties": {"city": {"enum": ["sf", "nyc"]}},
+        "required": ["city"],
+    }
+    grammar = json_schema.schema_to_grammar(schema)
+
+    e = eng.Engine(cfg, params, byte_tokenizer,
+                   eng.EngineConfig(num_slots=2, max_context=128,
+                                    prefill_buckets=(16,)))
+    e.start()
+    try:
+        # sampled (not greedy) to prove masking beats randomness
+        req = eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode("call:"),
+            params=eng.sampling.SamplingParamsHost(temperature=1.0, seed=5),
+            max_new_tokens=64, grammar=grammar)
+        text, events = e.generate_text(req)
+        parsed = json.loads(text)
+        assert parsed == {"city": "sf"} or parsed == {"city": "nyc"}
+        assert events[-1].finish_reason == "stop"
+
+        # a second grammared request reuses the compiled grammar + memo
+        req2 = eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode("again:"),
+            params=eng.sampling.SamplingParamsHost(temperature=1.0, seed=9),
+            max_new_tokens=64, grammar=grammar)
+        text2, _ = e.generate_text(req2)
+        assert json.loads(text2)["city"] in ("sf", "nyc")
+
+        # unconstrained control: same model produces NON-json
+        req3 = eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode("call:"),
+            params=eng.sampling.SamplingParamsHost(temperature=1.0, seed=5),
+            max_new_tokens=32, ignore_eos=True)
+        text3, _ = e.generate_text(req3)
+        try:
+            json.loads(text3)
+            unconstrained_valid = True
+        except Exception:
+            unconstrained_valid = False
+        assert not unconstrained_valid
+    finally:
+        e.shutdown()
