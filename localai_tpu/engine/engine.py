@@ -7,17 +7,26 @@ compilation model:
 
   * The decode step is ONE jitted function over ALL slots, compiled once —
     inactive slots ride along masked (static shapes, no recompiles).
-  * Prefill is jitted per padded length bucket (powers of two), so any
-    prompt length hits a warm compile after the first request of its size.
+  * Prompts are prefilled in CHUNKS between decode steps (reference packs
+    prompt chunks and decode tokens into one llama_batch, :1671+; XLA's
+    static shapes make separate interleaved steps the natural mapping), so
+    admitting a long prompt never stalls decode for active slots by more
+    than one chunk's compute.
+  * KV PREFIX REUSE: per-slot cache contents are tracked host-side; a new
+    request is admitted into the free slot sharing the longest common
+    token prefix and only the suffix is prefilled (reference:
+    grpc-server.cpp:1721-1835 cache_tokens common-prefix reuse).
+  * CONTEXT SHIFT: when a slot's cache fills mid-generation, the engine
+    re-prefills the tail half of the context into the slot (chunked, so
+    other slots keep decoding) and generation continues — the recompute
+    equivalent of the reference's KV surgery (llama_kv_cache_seq_rm/add,
+    grpc-server.cpp:1832,1916-1927), which XLA's immutable buffers and
+    RoPE'd keys make the honest TPU design.
   * Sampling (full per-slot parameter suite) and the penalty-histogram
     update are fused INTO the compiled steps — no per-token host round-trip
     for anything but the sampled ids themselves.
   * Admission/stop logic runs host-side on a dedicated engine thread,
     mirroring the reference's queue thread (grpc-server.cpp:2083-2096).
-
-Invariants enforced here for the model layer (see models/llama.py):
-prompts are truncated to fit the cache; a slot finishes with reason
-"length" before lengths[s] can reach cache capacity.
 """
 
 from __future__ import annotations
@@ -43,6 +52,8 @@ class EngineConfig:
     num_slots: int = 8
     max_context: int = 2048
     prefill_buckets: tuple = (32, 128, 512, 2048)
+    prefill_chunk: int = 512   # max prompt tokens processed between decode steps
+    context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
 
 
@@ -84,6 +95,7 @@ class _Slot:
         "req", "detok", "generated", "held_text", "prompt_len",
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
         "grammar", "gstate", "bias_base", "cur_penalty",
+        "phase", "pending", "written", "reused", "cache_len", "committed",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -100,6 +112,12 @@ class _Slot:
         self.gstate = None      # current frozenset state
         self.bias_base = None   # np [V] logit_bias row under the grammar mask
         self.cur_penalty = None  # last uploaded penalty row (identity-compared)
+        self.phase = "prefill"  # "prefill" -> "decode"
+        self.pending: list[int] = []   # prompt tokens not yet prefilled
+        self.written = 0        # cache rows already valid for this request
+        self.reused = 0         # prefix tokens reused from a previous request
+        self.cache_len = 0      # rows occupied in the slot's KV cache
+        self.committed = 0      # rows whose KV write has actually executed
 
 
 class Engine:
@@ -145,6 +163,8 @@ class Engine:
 
         # host mirrors
         self.slots: list[Optional[_Slot]] = [None] * S
+        self._cache_tokens: list[list[int]] = [[] for _ in range(S)]
+        self._prefill_queue: list[int] = []   # slot ids awaiting prefill chunks
         self._cancelled: set = set()
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._wake = threading.Event()
@@ -152,9 +172,16 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
         self._load_time = time.monotonic()
         self._total_tokens = 0
+        self._reused_total = 0
 
         self._decode_fn = jax.jit(self._decode_and_sample, donate_argnums=(2, 3, 5, 7))
-        self._prefill_fns: dict[int, Callable] = {}
+        self._chunk_fns: dict[int, Callable] = {}
+        self._final_fns: dict[tuple, Callable] = {}
+
+        # effective prefill buckets always include the chunk size
+        self._buckets = tuple(sorted(set(
+            [b for b in self.ecfg.prefill_buckets if b <= self.ecfg.prefill_chunk]
+            + [self.ecfg.prefill_chunk])))
 
         # grammar-constrained decoding (lazy: built on first grammar request)
         self._grammar_cache: dict[str, Any] = {}
@@ -165,41 +192,68 @@ class Engine:
 
     def _decode_and_sample(self, params, tokens, ck, cv, lengths, counts, bias, keys,
                            slot_params, active):
-        logits, ck, cv = llama.decode_step(params, self.cfg, tokens, lengths, ck, cv)
-        ids, logprobs, keys = sampling.sample(logits, slot_params, counts, bias, keys)
+        # inactive slots (free / mid-prefill) must NOT write KV: force their
+        # write position to C so the scatter's mode="drop" discards it —
+        # otherwise every decode step would clobber row 0 of slots holding
+        # reusable prefixes or in-flight prefill chunks
+        write_lengths = jnp.where(active, lengths, self.ecfg.max_context)
+        logits, ck, cv = llama.decode_step(params, self.cfg, tokens, write_lengths,
+                                           ck, cv)
+        ids, logprobs, new_keys = sampling.sample(logits, slot_params, counts, bias,
+                                                  keys)
+        # only active slots consume RNG state; a prefilling slot's seeded key
+        # must not advance with other slots' decode steps (reproducibility)
+        keys = jnp.where(active[:, None], new_keys, keys)
         counts = sampling.update_token_counts(counts, ids, active)
         lengths = lengths + active.astype(jnp.int32)
         return ids, logprobs, ck, cv, lengths, counts, keys
 
-    def _prefill_and_sample(self, params, tokens, seq_len, ck, cv, slot, counts, bias,
-                            keys, slot_params):
-        """tokens [1, T]; slot [1] int32. Samples the first token for the slot."""
-        logits, ck, cv = llama.prefill(
-            params, self.cfg, tokens, seq_len, ck, cv, slot,
-            jnp.zeros_like(slot),
-        )
-        # record prompt tokens into the penalty histogram for this slot
+    def _chunk_histogram(self, tokens, seq_len):
+        """[1, T] padded chunk -> [V] int32 histogram of its valid tokens."""
         T = tokens.shape[1]
         valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_len[:, None]
-        row = jnp.zeros((self.cfg.vocab_size,), jnp.int32).at[tokens[0]].add(
-            valid[0].astype(jnp.int32)
-        )
-        counts = counts.at[slot[0]].set(row)
-        # gather this slot's sampling state, sample one token, scatter back
+        return jnp.zeros((self.cfg.vocab_size,), jnp.int32).at[tokens[0]].add(
+            valid[0].astype(jnp.int32))
+
+    def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
+                            counts):
+        """Non-final chunk: write KV + record penalty histogram, no sampling."""
+        _, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv, slot,
+                                  start_pos, continued=True)
+        counts = counts.at[slot[0]].add(self._chunk_histogram(tokens, seq_len))
+        return ck, cv, counts
+
+    def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
+                            counts, bias, keys, slot_params, continued: bool):
+        """Final chunk: write KV, then sample the first output token."""
+        logits, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv,
+                                       slot, start_pos, continued=continued)
+        counts = counts.at[slot[0]].add(self._chunk_histogram(tokens, seq_len))
         sp_row = jax.tree.map(lambda a: jnp.take(a, slot, axis=0), slot_params)
         bias_row = jnp.take(bias, slot, axis=0)
         key_row = jnp.take(keys, slot, axis=0)
         counts_row = jnp.take(counts, slot, axis=0)
-        ids, logprobs, new_key = sampling.sample(logits, sp_row, counts_row, bias_row, key_row)
+        ids, logprobs, new_key = sampling.sample(logits, sp_row, counts_row, bias_row,
+                                                 key_row)
         counts = counts.at[slot[0], ids[0]].add(1)
         keys = keys.at[slot[0]].set(new_key[0])
         return ids, logprobs, ck, cv, counts, keys
 
-    def _get_prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _get_chunk_fn(self, bucket: int):
+        fn = self._chunk_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_and_sample, donate_argnums=(3, 4, 6, 8))
-            self._prefill_fns[bucket] = fn
+            fn = jax.jit(self._prefill_chunk_body, donate_argnums=(3, 4, 7))
+            self._chunk_fns[bucket] = fn
+        return fn
+
+    def _get_final_fn(self, bucket: int, continued: bool):
+        key = (bucket, continued)
+        fn = self._final_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._prefill_final_body(*a, continued=continued),
+                donate_argnums=(3, 4, 7, 9))
+            self._final_fns[key] = fn
         return fn
 
     # ---------- public API ----------
@@ -243,6 +297,8 @@ class Engine:
         self.cur_tokens = jnp.zeros((S,), jnp.int32)
         self.active_dev = jnp.zeros((S,), jnp.bool_)
         self.slot_params = sampling.make_slot_params(S)
+        self._cache_tokens = [[] for _ in range(S)]
+        self._prefill_queue = []
 
     def submit(self, req: GenRequest) -> "queue.Queue":
         self._queue.put(req)
@@ -287,6 +343,7 @@ class Engine:
             "queued": self._queue.qsize(),
             "total_tokens_generated": self._total_tokens,
             "tokens_per_second_active": tok_s,
+            "prompt_tokens_reused": self._reused_total,
             "uptime_s": time.monotonic() - self._load_time,
         }
 
@@ -330,16 +387,33 @@ class Engine:
     # ---------- engine loop ----------
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.ecfg.prefill_buckets:
+        for b in self._buckets:
             if n <= b:
                 return b
-        return self.ecfg.prefill_buckets[-1]
+        return self._buckets[-1]
 
-    def _free_slot(self) -> Optional[int]:
+    def _pick_slot(self, ids: list) -> tuple:
+        """Free slot with the longest cached common prefix (reference:
+        grpc-server.cpp:1721-1835). Returns (slot, reusable_len) or (None, 0)."""
+        best, best_key = None, None
         for i, s in enumerate(self.slots):
-            if s is None:
-                return i
-        return None
+            if s is not None:
+                continue
+            common = 0
+            for a, b in zip(self._cache_tokens[i], ids):
+                if a != b:
+                    break
+                common += 1
+            # prefer the longest common prefix; on ties (esp. common == 0)
+            # evict the slot with the LEAST cached content so unrelated
+            # requests don't destroy another conversation's reusable prefix
+            key = (common, -len(self._cache_tokens[i]))
+            if best_key is None or key > best_key:
+                best, best_key = i, key
+        if best is None:
+            return None, 0
+        # always leave >= 1 token to prefill so we have last-position logits
+        return best, min(best_key[0], len(ids) - 1)
 
     def _run(self):
         import logging
@@ -348,12 +422,14 @@ class Engine:
         while not self._stop:
             try:
                 admitted = self._admit()
-                if self.num_active == 0:
-                    if not admitted:
-                        self._wake.wait(timeout=0.05)
-                        self._wake.clear()
-                    continue
-                self._decode_once()
+                prefilled = self._prefill_step()
+                decoding = any(s is not None and s.phase == "decode"
+                               for s in self.slots)
+                if decoding:
+                    self._decode_once()
+                elif not (admitted or prefilled):
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
             except Exception as e:  # never let the loop die: fail active requests
                 log.exception("engine step failed")
                 for i, s in enumerate(self.slots):
@@ -377,8 +453,7 @@ class Engine:
         self._reap_cancelled()
         admitted = False
         while not self._queue.empty():
-            slot = self._free_slot()
-            if slot is None:
+            if self._free_count() == 0:
                 break
             try:
                 req = self._queue.get_nowait()
@@ -389,20 +464,21 @@ class Engine:
                 req.out.put(None)
                 continue
             try:
-                self._start_request(slot, req)
+                self._start_request(req)
                 admitted = True
             except Exception as e:
                 import logging
 
-                logging.getLogger(__name__).exception("prefill failed")
-                if self.slots[slot] is not None:
-                    self._release_slot(slot)
+                logging.getLogger(__name__).exception("admission failed")
                 req.out.put(StreamEvent(
                     token_id=-1, text="", logprob=0.0, finish_reason="stop",
                     error=f"{type(e).__name__}: {e}",
                 ))
                 req.out.put(None)
         return admitted
+
+    def _free_count(self) -> int:
+        return sum(1 for s in self.slots if s is None)
 
     def _reap_cancelled(self):
         if not self._cancelled:
@@ -413,23 +489,21 @@ class Engine:
                 self._release_slot(i)
                 s.req.out.put(None)
 
-    def _start_request(self, slot: int, req: GenRequest):
+    def _start_request(self, req: GenRequest):
+        """Admit a request: install sampling state and queue its prompt for
+        chunked prefill. No model compute happens here."""
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
         # truncate the prompt head, keeping the tail (reference semantics:
-        # grpc-server.cpp prompt truncation keeps the last part of the prompt);
-        # also bounded by the largest prefill bucket until chunked prefill lands
-        max_prompt = min(
-            C - 1 - min(req.max_new_tokens, C // 4),
-            max(self.ecfg.prefill_buckets),
-        )
+        # grpc-server.cpp prompt truncation keeps the last part of the prompt)
+        max_prompt = C - 1 - min(req.max_new_tokens, C // 4)
         if len(ids) > max_prompt:
             ids = ids[-max_prompt:]
         if not ids:
-            ids = [self.tokenizer.eos_token_id or 0]
-        T = len(ids)
-        bucket = self._bucket_for(T)
-        t0 = time.monotonic()
+            ids = [getattr(self.tokenizer, "eos_token_id", 0) or 0]
+
+        slot, common = self._pick_slot(ids)
+        assert slot is not None, "_start_request called with no free slot"
 
         # install sampling state for the slot
         self.slot_params = sampling.set_slot(self.slot_params, slot, req.params)
@@ -450,29 +524,88 @@ class Engine:
         else:
             self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
 
+        # penalty histogram starts from the reused prefix
+        if common:
+            row = np.bincount(np.asarray(ids[:common], np.int64),
+                              minlength=self.cfg.vocab_size).astype(np.int32)
+            self.counts = self.counts.at[slot].set(jnp.asarray(row))
+            self._reused_total += common
+        else:
+            self.counts = self.counts.at[slot].set(0)
+
+        s = _Slot(req, IncrementalDetokenizer(self.tokenizer), len(ids))
+        s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
+        s.cur_penalty = penalty0
+        s.pending = ids[common:]
+        s.written = common
+        s.reused = common
+        self._cache_tokens[slot] = list(ids)
+        self.slots[slot] = s
+        self._prefill_queue.append(slot)
+
+    def _prefill_step(self) -> bool:
+        """Process ONE prompt chunk for the oldest prefilling slot."""
+        while self._prefill_queue:
+            slot = self._prefill_queue[0]
+            s = self.slots[slot]
+            if s is None or s.phase != "prefill":
+                self._prefill_queue.pop(0)  # cancelled/stale entry
+                continue
+            break
+        else:
+            return False
+
+        chunk = self.ecfg.prefill_chunk
+        remaining = len(s.pending)
+        final = remaining <= chunk
+        take = remaining if final else chunk
+        bucket = self._bucket_for(take) if final else chunk
+        start = s.written
+
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :T] = ids
-        fn = self._get_prefill_fn(bucket)
+        tokens[0, :take] = s.pending[:take]
+        tokens_j = jnp.asarray(tokens)
+        seq_len = jnp.array([take], jnp.int32)
+        slot_j = jnp.array([slot], jnp.int32)
+        start_j = jnp.array([start], jnp.int32)
+
+        t0 = time.monotonic()
+        if not final:
+            fn = self._get_chunk_fn(bucket)
+            self.ck, self.cv, self.counts = fn(
+                self.params, tokens_j, seq_len, self.ck, self.cv, slot_j, start_j,
+                self.counts)
+            s.pending = s.pending[take:]
+            s.written += take
+            s.committed = s.written
+            s.t_prefill_ms += (time.monotonic() - t0) * 1e3
+            return True
+
+        continued = start > 0
+        fn = self._get_final_fn(bucket, continued)
         out_ids, logprobs, self.ck, self.cv, self.counts, self.rng_keys = fn(
-            self.params, jnp.asarray(tokens), jnp.array([T], jnp.int32),
-            self.ck, self.cv, jnp.array([slot], jnp.int32),
-            self.counts, self.bias, self.rng_keys, self.slot_params,
-        )
+            self.params, tokens_j, seq_len, self.ck, self.cv, slot_j, start_j,
+            self.counts, self.bias, self.rng_keys, self.slot_params)
         first_id = int(np.asarray(out_ids)[0])
         first_lp = float(np.asarray(logprobs)[0])
         t1 = time.monotonic()
 
-        self.lengths = self.lengths.at[slot].set(T)
+        s.pending = []
+        s.written += take
+        s.cache_len = s.written
+        s.committed = s.written
+        s.phase = "decode"
+        self._prefill_queue.pop(0)
+
+        self.lengths = self.lengths.at[slot].set(s.written)
         self.cur_tokens = self.cur_tokens.at[slot].set(first_id)
         self.active_dev = self.active_dev.at[slot].set(True)
 
-        s = _Slot(req, IncrementalDetokenizer(self.tokenizer), T)
-        s.t_prefill_ms = (t1 - t0) * 1e3
-        s.t_first_token = t1
-        s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
-        s.cur_penalty = penalty0
-        self.slots[slot] = s
+        s.t_prefill_ms += (t1 - t0) * 1e3
+        if s.t_first_token == 0.0:
+            s.t_first_token = t1
         self._emit_token(slot, first_id, first_lp)
+        return True
 
     def _decode_once(self):
         (ids, logprobs, self.ck, self.cv, self.lengths, self.counts,
@@ -484,7 +617,9 @@ class Engine:
         ids_np = np.asarray(ids)
         lps_np = np.asarray(logprobs)
         for i, s in enumerate(self.slots):
-            if s is not None:
+            if s is not None and s.phase == "decode":
+                # the step just wrote this slot's previous token's KV row
+                s.committed = min(s.committed + 1, s.cache_len)
                 self._emit_token(i, int(ids_np[i]), float(lps_np[i]))
 
     def _emit_token(self, slot: int, token_id: int, logprob: float):
@@ -493,6 +628,7 @@ class Engine:
         s.n_decoded += 1
         self._total_tokens += 1
         finish = None
+        shifted = False
 
         if token_id in self.eos_ids and not (s.req.ignore_eos and s.grammar is None):
             # under a grammar, EOS is only reachable when the mask allows it
@@ -507,9 +643,24 @@ class Engine:
         elif s.n_decoded >= s.req.max_new_tokens:
             finish = "length"
             delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
-        elif s.prompt_len + s.n_decoded >= self.ecfg.max_context - 1:
-            finish = "length"
-            delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
+        elif s.cache_len + 1 >= self.ecfg.max_context - 1:
+            if self.ecfg.context_shift:
+                delta = s.held_text + s.detok.push(token_id)
+                s.held_text = ""
+                # stop sequences still apply at the shift-trigger token —
+                # a completing stop must finish, not leak past the shift
+                if s.req.stop_sequences:
+                    cut = self._check_stops(s, delta)
+                    if cut is not None:
+                        delta, finish = cut, "stop"
+                    elif delta:
+                        delta, s.held_text = self._holdback(s, delta)
+                if finish is None:
+                    self._context_shift(slot, s, token_id)
+                    shifted = True
+            else:
+                finish = "length"
+                delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
         else:
             delta = s.held_text + s.detok.push(token_id)
             s.held_text = ""
@@ -521,6 +672,11 @@ class Engine:
                 elif delta:
                     delta, s.held_text = self._holdback(s, delta)
 
+        if finish is None and not shifted:
+            # this token's KV is written by the next decode step
+            self._cache_tokens[slot].append(token_id)
+            s.cache_len += 1
+
         ev = StreamEvent(
             token_id=token_id, text=delta, logprob=logprob,
             finish_reason=finish,
@@ -530,6 +686,7 @@ class Engine:
             dt = time.monotonic() - s.t_first_token
             ev.timings = {
                 "prefill_ms": s.t_prefill_ms,
+                "reused_prompt_tokens": s.reused,
                 "decode_tokens_per_s": (s.n_decoded - 1) / dt if dt > 0 and s.n_decoded > 1 else 0.0,
             }
             self._release_slot(slot)
@@ -537,6 +694,28 @@ class Engine:
             s.req.out.put(None)
         else:
             s.req.out.put(ev)
+
+    def _context_shift(self, slot: int, s: _Slot, token_id: int):
+        """Cache full mid-generation: re-prefill the tail half of the logical
+        context into the slot and keep generating (reference KV surgery:
+        grpc-server.cpp:1832,1916-1927 — recomputed here; see module doc)."""
+        history = self._cache_tokens[slot] + [token_id]
+        keep = max(self.ecfg.max_context // 2, 1)
+        new_ids = history[-keep:]
+        s.phase = "prefill"
+        s.pending = list(new_ids)
+        s.written = 0
+        s.cache_len = 0
+        s.committed = 0
+        self.active_dev = self.active_dev.at[slot].set(False)
+        self.lengths = self.lengths.at[slot].set(0)
+        # restart the penalty histogram from the kept window
+        row = np.bincount(np.asarray(new_ids, np.int64),
+                          minlength=self.cfg.vocab_size).astype(np.int32)
+        self.counts = self.counts.at[slot].set(jnp.asarray(row))
+        self._prefill_queue.append(slot)
+        # prefix matching against a mid-shift slot cannot happen (occupied)
+        self._cache_tokens[slot] = list(new_ids)
 
     def _check_stops(self, s: _Slot, delta: str) -> Optional[str]:
         """If a stop sequence completes in emitted+delta text, return the
@@ -563,6 +742,12 @@ class Engine:
         return delta, ""
 
     def _release_slot(self, slot: int):
+        # _cache_tokens is intentionally preserved (trimmed to rows whose KV
+        # write actually executed) — the slot's rows stay valid and a future
+        # request sharing a prefix reuses them
+        s = self.slots[slot]
+        if s is not None:
+            self._cache_tokens[slot] = self._cache_tokens[slot][:s.committed]
         self.slots[slot] = None
         self.active_dev = self.active_dev.at[slot].set(False)
         self.lengths = self.lengths.at[slot].set(0)

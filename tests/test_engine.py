@@ -138,3 +138,247 @@ def test_metrics_surface(running_engine):
     m = running_engine.metrics()
     assert m["slots_total"] == 4
     assert m["total_tokens_generated"] > 0
+
+
+def test_chunked_prefill_long_prompt(byte_tokenizer):
+    """A prompt longer than every prefill bucket must be admitted in chunks
+    and produce the same tokens as a model whose buckets cover it."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = byte_tokenizer.encode("q" * 50)  # 50 tokens
+
+    def run(ecfg):
+        e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+        e.start()
+        try:
+            req = eng.GenRequest(
+                prompt_ids=list(prompt),
+                params=sampling.SamplingParamsHost(temperature=0.0),
+                max_new_tokens=6, ignore_eos=True)
+            _, events = e.generate_text(req)
+            return [ev.token_id for ev in events], events[-1]
+        finally:
+            e.shutdown()
+
+    # chunk=16 forces 4 chunks; control covers the prompt in one bucket
+    toks_chunked, last = run(eng.EngineConfig(
+        num_slots=2, max_context=128, prefill_buckets=(16,), prefill_chunk=16))
+    toks_onego, _ = run(eng.EngineConfig(
+        num_slots=2, max_context=128, prefill_buckets=(64,), prefill_chunk=64))
+    assert last.prompt_tokens == 50
+    assert toks_chunked == toks_onego
+
+
+def test_prefix_reuse_across_requests(byte_tokenizer):
+    """Second request sharing a long prefix must reuse cached rows and
+    still produce identical tokens to a fresh engine."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    shared = "the quick brown fox jumps over the lazy dog"
+    p1 = byte_tokenizer.encode(shared + " ONE")
+    p2 = byte_tokenizer.encode(shared + " TWO")
+
+    def make():
+        e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+            num_slots=1, max_context=128, prefill_buckets=(16, 64),
+            prefill_chunk=64))
+        e.start()
+        return e
+
+    def gen(e, ids):
+        req = eng.GenRequest(prompt_ids=list(ids),
+                             params=sampling.SamplingParamsHost(temperature=0.0),
+                             max_new_tokens=6, ignore_eos=True)
+        _, events = e.generate_text(req)
+        return [ev.token_id for ev in events], events[-1]
+
+    e1 = make()
+    try:
+        gen(e1, p1)
+        toks_reused, last = gen(e1, p2)          # same slot, shared prefix
+        # common prefix = shared text + the following space (44 byte tokens)
+        assert last.timings["reused_prompt_tokens"] > 30
+        assert e1.metrics()["prompt_tokens_reused"] > 30
+    finally:
+        e1.shutdown()
+
+    e2 = make()
+    try:
+        toks_fresh, _ = gen(e2, p2)              # cold cache control
+    finally:
+        e2.shutdown()
+    assert toks_reused == toks_fresh
+
+
+def test_context_shift_generates_past_cache_capacity(byte_tokenizer):
+    """max_context=64 but 100 tokens requested: the engine must context-shift
+    (re-prefill the tail window) and keep generating to max_new_tokens."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=64, prefill_buckets=(16, 32),
+        prefill_chunk=32, context_shift=True))
+    e.start()
+    try:
+        req = eng.GenRequest(prompt_ids=byte_tokenizer.encode("shift me " * 3),
+                             params=sampling.SamplingParamsHost(temperature=0.0),
+                             max_new_tokens=100, ignore_eos=True)
+        _, events = e.generate_text(req)
+        assert events[-1].completion_tokens == 100
+        assert events[-1].finish_reason == "length"
+    finally:
+        e.shutdown()
+
+    # control: with context_shift off the request stops early with "length"
+    e2 = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=64, prefill_buckets=(16, 32),
+        prefill_chunk=32, context_shift=False))
+    e2.start()
+    try:
+        req = eng.GenRequest(prompt_ids=byte_tokenizer.encode("shift me " * 3),
+                             params=sampling.SamplingParamsHost(temperature=0.0),
+                             max_new_tokens=100, ignore_eos=True)
+        _, events = e2.generate_text(req)
+        assert events[-1].completion_tokens < 100
+    finally:
+        e2.shutdown()
+
+
+def test_concurrent_admission_does_not_corrupt_chunked_prefill(byte_tokenizer):
+    """Greedy output of a chunked-prefill request must be identical whether
+    the engine is idle or another slot is decoding during admission
+    (regression: decode steps used to clobber KV row 0 of inactive slots)."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def make():
+        e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+            num_slots=2, max_context=256, prefill_buckets=(16,),
+            prefill_chunk=16))
+        e.start()
+        return e
+
+    prompt_b = byte_tokenizer.encode("b" * 49)
+
+    e = make()
+    try:
+        req = eng.GenRequest(prompt_ids=list(prompt_b),
+                             params=sampling.SamplingParamsHost(temperature=0.0),
+                             max_new_tokens=6, ignore_eos=True)
+        _, ev_idle = e.generate_text(req)
+        toks_idle = [x.token_id for x in ev_idle]
+    finally:
+        e.shutdown()
+
+    e = make()
+    try:
+        a = eng.GenRequest(prompt_ids=byte_tokenizer.encode("aaa"),
+                           params=sampling.SamplingParamsHost(temperature=0.0),
+                           max_new_tokens=300, ignore_eos=True)
+        out_a = e.submit(a)
+        out_a.get(timeout=60)  # A is decoding
+        req = eng.GenRequest(prompt_ids=list(prompt_b),
+                             params=sampling.SamplingParamsHost(temperature=0.0),
+                             max_new_tokens=6, ignore_eos=True)
+        _, ev_busy = e.generate_text(req)
+        toks_busy = [x.token_id for x in ev_busy]
+        e.cancel(a.request_id)
+    finally:
+        e.shutdown()
+    assert toks_idle == toks_busy
+
+
+def test_unrelated_request_prefers_empty_slot(byte_tokenizer):
+    """An unrelated request must land in the emptiest free slot, preserving
+    another conversation's cached prefix for reuse."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=128, prefill_buckets=(16, 64),
+        prefill_chunk=64))
+    e.start()
+    try:
+        shared = "a common conversation prefix that is long"
+
+        def gen(text):
+            req = eng.GenRequest(prompt_ids=byte_tokenizer.encode(text),
+                                 params=sampling.SamplingParamsHost(temperature=0.0),
+                                 max_new_tokens=4, ignore_eos=True)
+            _, events = e.generate_text(req)
+            return events[-1]
+
+        gen(shared + " turn1")     # populates slot 0
+        gen("zzz unrelated")       # must take slot 1, not evict slot 0
+        last = gen(shared + " turn2")
+        assert last.timings["reused_prompt_tokens"] > 30
+    finally:
+        e.shutdown()
+
+
+def test_prefill_does_not_stall_decode(byte_tokenizer):
+    """While slot A decodes, admitting a long chunked prompt B must not
+    freeze A: A must receive tokens between B's submit and B's first token
+    (VERDICT weak #4: the old engine prefilled inline, stalling decode)."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=512,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=256, prefill_buckets=(16,), prefill_chunk=16))
+    e.start()
+    try:
+        # warm compiles so timing reflects steady state
+        warm = eng.GenRequest(prompt_ids=byte_tokenizer.encode("w" * 40),
+                              params=sampling.SamplingParamsHost(temperature=0.0),
+                              max_new_tokens=4, ignore_eos=True)
+        e.generate_text(warm)
+
+        a = eng.GenRequest(prompt_ids=byte_tokenizer.encode("aaa"),
+                           params=sampling.SamplingParamsHost(temperature=0.0),
+                           max_new_tokens=200, ignore_eos=True)
+        out_a = e.submit(a)
+        out_a.get(timeout=60)  # A is decoding
+
+        b = eng.GenRequest(prompt_ids=byte_tokenizer.encode("b" * 120),  # 8 chunks
+                           params=sampling.SamplingParamsHost(temperature=0.0),
+                           max_new_tokens=4, ignore_eos=True)
+        t_submit = time.monotonic()
+        out_b = e.submit(b)
+
+        # drain A until B's first token arrives; count A tokens in between
+        a_tokens_during_b_prefill = 0
+        b_first = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and b_first is None:
+            try:
+                ev = out_a.get(timeout=0.5)
+                if ev is not None and ev.finish_reason is None:
+                    a_tokens_during_b_prefill += 1
+            except queue.Empty:
+                pass
+            try:
+                b_first = out_b.get_nowait()
+            except queue.Empty:
+                pass
+        assert b_first is not None
+        assert a_tokens_during_b_prefill >= 2, (
+            "decode stalled during chunked prefill admission")
+        e.cancel(a.request_id)
+    finally:
+        e.shutdown()
