@@ -142,6 +142,7 @@ class Engine:
         V = model_cfg.vocab_size
 
         self.params = params
+        self._state_shardings = self._make_state_shardings()
         self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
         self.slot_params = sampling.make_slot_params(S)
         self.counts = jnp.zeros((S, V), jnp.int32)
@@ -152,6 +153,7 @@ class Engine:
         self.lengths = jnp.zeros((S,), jnp.int32)
         self.cur_tokens = jnp.zeros((S,), jnp.int32)
         self.active_dev = jnp.zeros((S,), jnp.bool_)
+        self._shard_state()
 
         if eos_token_ids:
             self.eos_ids = set(eos_token_ids)
@@ -187,6 +189,48 @@ class Engine:
         self._grammar_cache: dict[str, Any] = {}
         self._mask_builder = None
         self._token_strs: Optional[list] = None
+
+    def _make_state_shardings(self) -> Optional[dict]:
+        """NamedShardings for the engine's device state when serving on a
+        mesh (parallel/sharding.py cache_spec: slots on dp, kv heads on tp).
+        Falls back to replication per axis when sizes don't divide — a
+        wrong-but-silent replicated cache is exactly the HBM waste this
+        exists to avoid, so only shard what divides evenly."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dp = self.mesh.shape.get("dp", 1)
+        tp = self.mesh.shape.get("tp", 1)
+        slot_ax = "dp" if dp > 1 and self.ecfg.num_slots % dp == 0 else None
+        kv_ax = "tp" if tp > 1 and self.cfg.num_kv_heads % tp == 0 else None
+
+        def ns(*spec):
+            return NamedSharding(self.mesh, P(*spec))
+
+        return {
+            "cache": ns(None, slot_ax, None, kv_ax, None),  # [L, S, C, KV, hd]
+            "slot_vec": ns(slot_ax),                        # [S]
+            "slot_mat": ns(slot_ax, None),                  # [S, V] / [S, 2]
+        }
+
+    def _shard_state(self):
+        """Commit cache + per-slot state to the mesh (ADVICE r1: without this
+        the dp/tp cache sharding was never applied in the real serving path —
+        every device held a full replica of the KV cache)."""
+        sh = self._state_shardings
+        if sh is None:
+            return
+        self.ck = jax.device_put(self.ck, sh["cache"])
+        self.cv = jax.device_put(self.cv, sh["cache"])
+        self.counts = jax.device_put(self.counts, sh["slot_mat"])
+        self.bias = jax.device_put(self.bias, sh["slot_mat"])
+        self.rng_keys = jax.device_put(self.rng_keys, sh["slot_mat"])
+        self.lengths = jax.device_put(self.lengths, sh["slot_vec"])
+        self.cur_tokens = jax.device_put(self.cur_tokens, sh["slot_vec"])
+        self.active_dev = jax.device_put(self.active_dev, sh["slot_vec"])
+        self.slot_params = jax.tree.map(
+            lambda a: jax.device_put(a, sh["slot_vec"]), self.slot_params)
 
     # ---------- jitted step bodies ----------
 
@@ -297,6 +341,7 @@ class Engine:
         self.cur_tokens = jnp.zeros((S,), jnp.int32)
         self.active_dev = jnp.zeros((S,), jnp.bool_)
         self.slot_params = sampling.make_slot_params(S)
+        self._shard_state()
         self._cache_tokens = [[] for _ in range(S)]
         self._prefill_queue = []
 
