@@ -37,6 +37,10 @@ def _sampling_from_predict(opts: pb.PredictOptions):
         min_p=opts.min_p,
         typical_p=opts.typical_p if opts.typical_p > 0 else 1.0,
         repeat_penalty=opts.repeat_penalty if opts.repeat_penalty > 0 else 1.0,
+        # llama.cpp semantics: -1 = whole context (capped at the ring size
+        # here), 0/unset = default 64 (proto3 cannot distinguish explicit 0)
+        repeat_last_n=(opts.repeat_last_n if opts.repeat_last_n > 0
+                       else -1 if opts.repeat_last_n < 0 else 64),
         presence_penalty=opts.presence_penalty,
         frequency_penalty=opts.frequency_penalty,
         seed=opts.seed if opts.seed != 0 else -1,
@@ -108,7 +112,11 @@ class EngineServicer(BackendServicer):
         )
         self.model_cfg = cfg
         self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh)
-        self.engine.start()
+        # compile the whole serving surface before accepting traffic (a cold
+        # compile mid-request stalls every active slot for 20-40s); skippable
+        # for tests that only care about wiring
+        self.engine.start(
+            precompile=os.environ.get("LOCALAI_PRECOMPILE", "1") != "0")
         self._embed = request.embeddings
 
     # ---- inference ----
@@ -250,6 +258,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level.upper())
     _apply_platform_env()
+    from localai_tpu.utils.jaxtools import enable_compilation_cache
+
+    enable_compilation_cache()
     servicer = EngineServicer()
     server = make_server(servicer, args.addr)
     server.start()

@@ -55,6 +55,12 @@ class EngineConfig:
     prefill_chunk: int = 512   # max prompt tokens processed between decode steps
     context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
+    # decode BURST: run up to this many decode steps per device dispatch
+    # (lax.scan), amortizing per-dispatch overhead (measured ~3-12 ms on the
+    # serving chip — larger than one step's compute). Bursts shrink to 1 when
+    # a grammar-constrained slot is active (needs per-token logit masks) and
+    # clamp to prefill-pending/cache-capacity conditions; see _pick_burst.
+    decode_burst: int = 16
 
 
 @dataclasses.dataclass
@@ -88,6 +94,21 @@ class StreamEvent:
     completion_tokens: int = 0
     timings: Optional[dict] = None
     error: Optional[str] = None
+
+
+class _Burst:
+    """A dispatched decode burst awaiting host processing."""
+    __slots__ = ("n_steps", "slots", "ids_all", "lps_all", "ids_np", "lps_np",
+                 "folded")
+
+    def __init__(self, n_steps, slots, ids_all, lps_all):
+        self.n_steps = n_steps
+        self.slots = slots          # [(index, _Slot snapshot), ...]
+        self.ids_all = ids_all      # device [K, S]
+        self.lps_all = lps_all
+        self.ids_np = None
+        self.lps_np = None
+        self.folded = False
 
 
 class _Slot:
@@ -143,16 +164,22 @@ class Engine:
 
         self.params = params
         self._state_shardings = self._make_state_shardings()
+        # device-resident state: big (KV cache), rarely-mutated (bias), or
+        # not host-mirrorable (PRNG keys). Everything per-slot and small
+        # lives as HOST numpy — admissions/releases are then free in-place
+        # writes instead of ~3ms `.at[].set` dispatches, and the arrays ride
+        # to the device as ordinary jit args each step.
         self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
-        self.slot_params = sampling.make_slot_params(S)
-        self.counts = jnp.zeros((S, V), jnp.int32)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
         )
-        self.lengths = jnp.zeros((S,), jnp.int32)
-        self.cur_tokens = jnp.zeros((S,), jnp.int32)
-        self.active_dev = jnp.zeros((S,), jnp.bool_)
+        self.slot_params = sampling.make_slot_params(S)
+        self.ring, self.ring_pos = sampling.make_ring(S)
+        self.lengths = np.zeros((S,), np.int32)
+        self.cur_tokens = np.zeros((S,), np.int32)
+        self.active_dev = np.zeros((S,), np.bool_)
+        self._bias_dirty = np.zeros((S,), np.bool_)
         self._shard_state()
 
         if eos_token_ids:
@@ -176,14 +203,30 @@ class Engine:
         self._total_tokens = 0
         self._reused_total = 0
 
-        self._decode_fn = jax.jit(self._decode_and_sample, donate_argnums=(2, 3, 5, 7))
+        self._burst_fns: dict[int, Callable] = {}
         self._chunk_fns: dict[int, Callable] = {}
         self._final_fns: dict[tuple, Callable] = {}
 
-        # effective prefill buckets always include the chunk size
+        # pipelined decode state: device-side burst-to-burst chain of
+        # (tokens, lengths, ring, ring_pos), the not-yet-processed burst,
+        # and whether host events invalidated the chain
+        self._chain = None
+        self._chain_dirty = True
+        self._inflight: Optional[_Burst] = None
+        # async prefill: one final-prefill group may be awaiting its results
+        self._pending_prefill: Optional[tuple] = None
+
+        # effective prefill buckets always include the chunk size; both are
+        # clamped to the cache capacity (a bucket larger than max_context
+        # could never be written and would crash the prefill KV update)
+        self._chunk = min(self.ecfg.prefill_chunk, C)
         self._buckets = tuple(sorted(set(
-            [b for b in self.ecfg.prefill_buckets if b <= self.ecfg.prefill_chunk]
-            + [self.ecfg.prefill_chunk])))
+            [b for b in self.ecfg.prefill_buckets if b <= min(self._chunk, C)]
+            + [self._chunk])))
+        # fresh final prefills batch up to this many prompts per dispatch
+        # (padded by repeating the last entry, so only two compiled batch
+        # sizes exist per bucket: 1 and _final_pad)
+        self._final_pad = 8
 
         # grammar-constrained decoding (lazy: built on first grammar request)
         self._grammar_cache: dict[str, Any] = {}
@@ -215,94 +258,165 @@ class Engine:
         }
 
     def _shard_state(self):
-        """Commit cache + per-slot state to the mesh (ADVICE r1: without this
+        """Commit device-resident state to the mesh (ADVICE r1: without this
         the dp/tp cache sharding was never applied in the real serving path —
-        every device held a full replica of the KV cache)."""
+        every device held a full replica of the KV cache). Host-numpy slot
+        state needs no commitment — it enters jitted steps as arguments and
+        GSPMD places it."""
         sh = self._state_shardings
         if sh is None:
             return
         self.ck = jax.device_put(self.ck, sh["cache"])
         self.cv = jax.device_put(self.cv, sh["cache"])
-        self.counts = jax.device_put(self.counts, sh["slot_mat"])
         self.bias = jax.device_put(self.bias, sh["slot_mat"])
         self.rng_keys = jax.device_put(self.rng_keys, sh["slot_mat"])
-        self.lengths = jax.device_put(self.lengths, sh["slot_vec"])
-        self.cur_tokens = jax.device_put(self.cur_tokens, sh["slot_vec"])
-        self.active_dev = jax.device_put(self.active_dev, sh["slot_vec"])
-        self.slot_params = jax.tree.map(
-            lambda a: jax.device_put(a, sh["slot_vec"]), self.slot_params)
 
     # ---------- jitted step bodies ----------
 
-    def _decode_and_sample(self, params, tokens, ck, cv, lengths, counts, bias, keys,
-                           slot_params, active):
-        # inactive slots (free / mid-prefill) must NOT write KV: force their
-        # write position to C so the scatter's mode="drop" discards it —
-        # otherwise every decode step would clobber row 0 of slots holding
-        # reusable prefixes or in-flight prefill chunks
-        write_lengths = jnp.where(active, lengths, self.ecfg.max_context)
-        logits, ck, cv = llama.decode_step(params, self.cfg, tokens, write_lengths,
-                                           ck, cv)
-        ids, logprobs, new_keys = sampling.sample(logits, slot_params, counts, bias,
-                                                  keys)
-        # only active slots consume RNG state; a prefilling slot's seeded key
-        # must not advance with other slots' decode steps (reproducibility)
-        keys = jnp.where(active[:, None], new_keys, keys)
-        counts = sampling.update_token_counts(counts, ids, active)
-        lengths = lengths + active.astype(jnp.int32)
-        return ids, logprobs, ck, cv, lengths, counts, keys
+    def _decode_burst_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
+                           bias, keys, slot_params, active, n_steps: int):
+        """n_steps decode+sample steps in ONE dispatch (lax.scan).
 
-    def _chunk_histogram(self, tokens, seq_len):
-        """[1, T] padded chunk -> [V] int32 histogram of its valid tokens."""
-        T = tokens.shape[1]
-        valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_len[:, None]
-        return jnp.zeros((self.cfg.vocab_size,), jnp.int32).at[tokens[0]].add(
-            valid[0].astype(jnp.int32))
+        Per-dispatch overhead on the serving chip is comparable to one step's
+        compute, so bursts are the single biggest serving-throughput lever.
+        bias/slot_params/active are constant across the burst (the engine
+        forces n_steps=1 whenever a grammar slot needs per-token bias).
+        """
+        C = self.ecfg.max_context
 
-    def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
-                            counts):
-        """Non-final chunk: write KV + record penalty histogram, no sampling."""
+        def step(carry, _):
+            tokens, ck, cv, lengths, ring, ring_pos, keys = carry
+            # inactive slots (free / mid-prefill) must NOT write KV: force
+            # their write position to C so the scatter's mode="drop" discards
+            # it — otherwise every decode step would clobber row 0 of slots
+            # holding reusable prefixes or in-flight prefill chunks
+            write_lengths = jnp.where(active, lengths, C)
+            logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
+                                               write_lengths, ck, cv)
+            ids, logprobs, new_keys = sampling.sample(logits, slot_params, ring,
+                                                      ring_pos, bias, keys)
+            # only active slots consume RNG state; a prefilling slot's seeded
+            # key must not advance with other slots' decode steps
+            keys = jnp.where(active[:, None], new_keys, keys)
+            ring, ring_pos = sampling.update_ring(ring, ring_pos, ids, active)
+            lengths = lengths + active.astype(jnp.int32)
+            tokens = jnp.where(active, ids, tokens)
+            return (tokens, ck, cv, lengths, ring, ring_pos, keys), (ids, logprobs)
+
+        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys)
+        carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None, length=n_steps)
+        tokens, ck, cv, lengths, ring, ring_pos, keys = carry
+        # tokens/lengths/ring are returned as DEVICE handles so the next
+        # burst can chain off them without a host round-trip (pipelined
+        # decode); the host separately mirrors the same evolution from the
+        # emitted ids for use whenever admissions/releases reset slot state
+        return ids_all, lps_all, ck, cv, keys, (tokens, lengths, ring, ring_pos)
+
+    def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos):
+        """Non-final chunk: write KV only, no sampling. (The penalty ring is
+        seeded host-side at admission from the full prompt tail.)"""
         _, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv, slot,
                                   start_pos, continued=True)
-        counts = counts.at[slot[0]].add(self._chunk_histogram(tokens, seq_len))
-        return ck, cv, counts
+        return ck, cv
 
     def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
-                            counts, bias, keys, slot_params, continued: bool):
-        """Final chunk: write KV, then sample the first output token."""
+                            ring, ring_pos, bias, keys, slot_params, continued: bool):
+        """Final chunk for a BATCH of B prompts: write KV, sample each one's
+        first output token. slot may contain duplicate entries (batch
+        padding repeats the last prompt; duplicate KV writes and key
+        scatters are idempotent — same inputs, last write wins)."""
         logits, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv,
                                        slot, start_pos, continued=continued)
-        counts = counts.at[slot[0]].add(self._chunk_histogram(tokens, seq_len))
-        sp_row = jax.tree.map(lambda a: jnp.take(a, slot, axis=0), slot_params)
-        bias_row = jnp.take(bias, slot, axis=0)
-        key_row = jnp.take(keys, slot, axis=0)
-        counts_row = jnp.take(counts, slot, axis=0)
-        ids, logprobs, new_key = sampling.sample(logits, sp_row, counts_row, bias_row,
-                                                 key_row)
-        counts = counts.at[slot[0], ids[0]].add(1)
-        keys = keys.at[slot[0]].set(new_key[0])
-        return ids, logprobs, ck, cv, counts, keys
+        sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), slot, axis=0),
+                               slot_params)
+        bias_rows = jnp.take(bias, slot, axis=0)
+        key_rows = jnp.take(keys, slot, axis=0)
+        ring_rows = jnp.take(jnp.asarray(ring), slot, axis=0)
+        rpos_rows = jnp.take(jnp.asarray(ring_pos), slot, axis=0)
+        ids, logprobs, new_keys = sampling.sample(logits, sp_rows, ring_rows,
+                                                  rpos_rows, bias_rows, key_rows)
+        keys = keys.at[slot].set(new_keys)
+        return ids, logprobs, ck, cv, keys
+
+    def _get_burst_fn(self, n_steps: int):
+        fn = self._burst_fns.get(n_steps)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._decode_burst_body(*a, n_steps=n_steps),
+                donate_argnums=(2, 3, 8))
+            self._burst_fns[n_steps] = fn
+        return fn
 
     def _get_chunk_fn(self, bucket: int):
         fn = self._chunk_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_chunk_body, donate_argnums=(3, 4, 7))
+            fn = jax.jit(self._prefill_chunk_body, donate_argnums=(3, 4))
             self._chunk_fns[bucket] = fn
         return fn
 
-    def _get_final_fn(self, bucket: int, continued: bool):
-        key = (bucket, continued)
+    def _get_final_fn(self, bucket: int, batch: int, continued: bool):
+        key = (bucket, batch, continued)
         fn = self._final_fns.get(key)
         if fn is None:
             fn = jax.jit(
                 lambda *a: self._prefill_final_body(*a, continued=continued),
-                donate_argnums=(3, 4, 7, 9))
+                donate_argnums=(3, 4, 10))
             self._final_fns[key] = fn
         return fn
 
     # ---------- public API ----------
 
-    def start(self):
+    def precompile(self):
+        """Compile + execute every jitted variant the serving loop can hit
+        (burst sizes, prefill buckets x fresh/continued) BEFORE taking
+        traffic. A cold XLA compile costs 20-40s on the serving chip;
+        hitting one mid-wave stalls every active request (measured: one
+        stray burst-size compile turned a 7s bench wave into 40s).
+
+        Bursts run with all slots inactive — a state-preserving no-op.
+        Prefill warmups write one garbage row into (free) slot 0's cache;
+        admission reseeds all per-slot state, so this is invisible to
+        traffic. Mirrors the reference's LoadToMemory warmup
+        (core/startup/startup.go:148-176); pairs with the persistent
+        compilation cache (utils/jaxtools.py) so restarts compile fast."""
+        k = 1
+        ks = []
+        while k <= self.ecfg.decode_burst:
+            ks.append(k)
+            k *= 2
+        for k in ks:
+            fn = self._get_burst_fn(k)
+            _, _, self.ck, self.cv, self.rng_keys, _ = fn(
+                self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
+                self.ring, self.ring_pos, self.bias, self.rng_keys,
+                self.slot_params, self.active_dev)
+        for bucket in self._buckets:
+            one = np.ones((1,), np.int32)
+            zero = np.zeros((1,), np.int32)
+            tokens = np.zeros((1, bucket), np.int32)
+            if bucket == self._chunk:
+                # non-final chunks always use the full chunk bucket
+                self.ck, self.cv = self._get_chunk_fn(bucket)(
+                    self.params, tokens, one, self.ck, self.cv, zero, zero)
+            for batch, continued in ((1, False), (1, True),
+                                     (self._final_pad, False)):
+                if batch == 1:
+                    tb, sb = tokens, one
+                    slotb = startb = zero
+                else:
+                    tb = np.zeros((batch, bucket), np.int32)
+                    sb = np.ones((batch,), np.int32)
+                    slotb = startb = np.zeros((batch,), np.int32)
+                fn = self._get_final_fn(bucket, batch, continued)
+                _, _, self.ck, self.cv, self.rng_keys = fn(
+                    self.params, tb, sb, self.ck, self.cv, slotb, startb,
+                    self.ring, self.ring_pos, self.bias, self.rng_keys,
+                    self.slot_params)
+        jax.block_until_ready(self.ck)
+
+    def start(self, precompile: bool = False):
+        if precompile:
+            self.precompile()
         self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
         self._thread.start()
 
@@ -332,18 +446,23 @@ class Engine:
         V = self.cfg.vocab_size
         self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
                                             self.ecfg.cache_dtype)
-        self.counts = jnp.zeros((S, V), jnp.int32)
+        self.ring, self.ring_pos = sampling.make_ring(S)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
         )
-        self.lengths = jnp.zeros((S,), jnp.int32)
-        self.cur_tokens = jnp.zeros((S,), jnp.int32)
-        self.active_dev = jnp.zeros((S,), jnp.bool_)
+        self.lengths = np.zeros((S,), np.int32)
+        self.cur_tokens = np.zeros((S,), np.int32)
+        self.active_dev = np.zeros((S,), np.bool_)
+        self._bias_dirty = np.zeros((S,), np.bool_)
         self.slot_params = sampling.make_slot_params(S)
         self._shard_state()
         self._cache_tokens = [[] for _ in range(S)]
         self._prefill_queue = []
+        self._chain = None
+        self._chain_dirty = True
+        self._inflight = None
+        self._pending_prefill = None
 
     def submit(self, req: GenRequest) -> "queue.Queue":
         self._queue.put(req)
@@ -468,13 +587,24 @@ class Engine:
             try:
                 admitted = self._admit()
                 prefilled = self._prefill_step()
+                finalized = self._maybe_finalize_prefill()
                 decoding = any(s is not None and s.phase == "decode"
                                for s in self.slots)
                 if decoding:
                     self._decode_once()
-                elif not (admitted or prefilled):
-                    self._wake.wait(timeout=0.05)
-                    self._wake.clear()
+                else:
+                    if self._inflight is not None:
+                        # every participant finished during processing of the
+                        # prior burst; fold/drop the stale burst now so its
+                        # tokens can never leak into a re-admitted slot
+                        self._process_burst(self._inflight)
+                        self._inflight = None
+                    if self._pending_prefill is not None:
+                        # nothing else to run — block on the prefill result
+                        self._maybe_finalize_prefill(block=True)
+                    elif not (admitted or prefilled or finalized):
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
             except Exception as e:  # never let the loop die: fail active requests
                 log.exception("engine step failed")
                 for i, s in enumerate(self.slots):
@@ -485,7 +615,7 @@ class Engine:
                         ))
                         s.req.out.put(None)
                         self._release_slot(i)
-                # a failure inside a donated jitted call leaves ck/cv/counts/
+                # a failure inside a donated jitted call leaves ck/cv/ring/
                 # keys pointing at deleted buffers — reinitialize device state
                 # so the engine survives instead of erroring forever
                 try:
@@ -494,8 +624,33 @@ class Engine:
                     log.exception("device state reset failed; engine unusable")
                     self._stop = True
 
+    def _admission_ready(self) -> bool:
+        """Hold admissions briefly so batched prefill groups can form:
+        completions arrive a few per decode burst, and admitting each
+        singleton immediately costs a ~140ms prefill dispatch for one
+        prompt. Admit when the queue can fill a decent group, when the
+        engine is otherwise idle, or when the oldest wait exceeds one
+        burst's latency."""
+        if self._queue.empty() or self._free_count() == 0:
+            return False
+        qn = self._queue.qsize()
+        if qn >= min(self._final_pad // 2, self._free_count()):
+            return True
+        n_decoding = sum(1 for s in self.slots
+                         if s is not None and s.phase == "decode")
+        if n_decoding < self.ecfg.num_slots // 2:
+            return True  # light load: completions won't clump; admit now
+        now = time.monotonic()
+        oldest = getattr(self, "_oldest_queued_t", None)
+        return oldest is not None and (now - oldest) > 0.35
+
     def _admit(self) -> bool:
         self._reap_cancelled()
+        if not self._queue.empty() and getattr(self, "_oldest_queued_t", None) is None:
+            self._oldest_queued_t = time.monotonic()
+        if not self._admission_ready():
+            return False
+        self._oldest_queued_t = None
         admitted = False
         while not self._queue.empty():
             if self._free_count() == 0:
@@ -549,6 +704,13 @@ class Engine:
 
         slot, common = self._pick_slot(ids)
         assert slot is not None, "_start_request called with no free slot"
+        # a short accidental prefix match (e.g. two prompts sharing a BOS or
+        # first word) is not worth the slow path it forces: continued
+        # prefills run singly while fresh finals batch 8 per dispatch.
+        # Reuse only prefixes long enough to beat that cost (real multi-turn
+        # chats share hundreds of system/history tokens).
+        if common < 16:
+            common = 0
 
         # install sampling state for the slot
         self.slot_params = sampling.set_slot(self.slot_params, slot, req.params)
@@ -566,17 +728,23 @@ class Engine:
                     bias_base[t] = float(b)
             penalty0 = self._mask_builder.penalty_row(grammar, gstate)
             self.bias = self.bias.at[slot].set(jnp.asarray(bias_base + penalty0))
-        else:
+            self._bias_dirty[slot] = True
+        elif req.params.logit_bias:
             self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
+            self._bias_dirty[slot] = True
+        elif self._bias_dirty[slot]:
+            # clear a previous request's grammar mask / bias row; skipping
+            # the device write for never-biased slots keeps admission free of
+            # dispatches in the common case
+            self.bias = self.bias.at[slot].set(0.0)
+            self._bias_dirty[slot] = False
 
-        # penalty histogram starts from the reused prefix
+        # penalty ring covers the prompt tail (llama.cpp last-n semantics
+        # include prompt tokens); reused prefixes are part of the prompt
+        self.ring, self.ring_pos = sampling.set_slot_ring(
+            self.ring, self.ring_pos, slot, ids)
         if common:
-            row = np.bincount(np.asarray(ids[:common], np.int64),
-                              minlength=self.cfg.vocab_size).astype(np.int32)
-            self.counts = self.counts.at[slot].set(jnp.asarray(row))
             self._reused_total += common
-        else:
-            self.counts = self.counts.at[slot].set(0)
 
         s = _Slot(req, IncrementalDetokenizer(self.tokenizer), len(ids))
         s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
@@ -588,8 +756,29 @@ class Engine:
         self.slots[slot] = s
         self._prefill_queue.append(slot)
 
+    def _prefill_plan(self, slot: int):
+        """(final, take, bucket, continued) for a slot's next chunk."""
+        s = self.slots[slot]
+        chunk = self._chunk
+        remaining = len(s.pending)
+        final = remaining <= chunk
+        take = remaining if final else chunk
+        bucket = self._bucket_for(take) if final else chunk
+        return final, take, bucket, s.written > 0
+
     def _prefill_step(self) -> bool:
-        """Process ONE prompt chunk for the oldest prefilling slot."""
+        """Process the next prompt chunk(s).
+
+        Fresh FINAL chunks sharing a bucket are batched into ONE dispatch of
+        up to _final_pad prompts (padded by repeating the last entry) — the
+        reference packs all prompt chunks into one llama_batch
+        (grpc-server.cpp:1671+); per-prompt dispatches cost ~150ms of
+        overhead each on the serving tunnel. Long-prompt (chunked) and
+        continued (prefix-reuse) prefills go singly. At most one final
+        group is in flight at a time (see _maybe_finalize_prefill).
+        """
+        if self._pending_prefill is not None:
+            return False
         while self._prefill_queue:
             slot = self._prefill_queue[0]
             s = self.slots[slot]
@@ -600,72 +789,213 @@ class Engine:
         else:
             return False
 
-        chunk = self.ecfg.prefill_chunk
-        remaining = len(s.pending)
-        final = remaining <= chunk
-        take = remaining if final else chunk
-        bucket = self._bucket_for(take) if final else chunk
-        start = s.written
-
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :take] = s.pending[:take]
-        tokens_j = jnp.asarray(tokens)
-        seq_len = jnp.array([take], jnp.int32)
-        slot_j = jnp.array([slot], jnp.int32)
-        start_j = jnp.array([start], jnp.int32)
+        final, take, bucket, continued = self._prefill_plan(slot)
 
         t0 = time.monotonic()
         if not final:
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :take] = s.pending[:take]
             fn = self._get_chunk_fn(bucket)
-            self.ck, self.cv, self.counts = fn(
-                self.params, tokens_j, seq_len, self.ck, self.cv, slot_j, start_j,
-                self.counts)
+            self.ck, self.cv = fn(
+                self.params, tokens, np.array([take], np.int32), self.ck, self.cv,
+                np.array([slot], np.int32), np.array([s.written], np.int32))
             s.pending = s.pending[take:]
             s.written += take
             s.committed = s.written
             s.t_prefill_ms += (time.monotonic() - t0) * 1e3
             return True
 
-        continued = start > 0
-        fn = self._get_final_fn(bucket, continued)
-        out_ids, logprobs, self.ck, self.cv, self.counts, self.rng_keys = fn(
-            self.params, tokens_j, seq_len, self.ck, self.cv, slot_j, start_j,
-            self.counts, self.bias, self.rng_keys, self.slot_params)
-        first_id = int(np.asarray(out_ids)[0])
-        first_lp = float(np.asarray(logprobs)[0])
-        t1 = time.monotonic()
+        # collect a batch of fresh finals with the same bucket (queue order)
+        group = [(slot, take)]
+        if not continued:
+            for other in self._prefill_queue[1:]:
+                if len(group) >= self._final_pad:
+                    break
+                so = self.slots[other]
+                if so is None or so.phase != "prefill":
+                    continue
+                of, ot, ob, oc = self._prefill_plan(other)
+                if of and not oc and ob == bucket:
+                    group.append((other, ot))
+        B = 1 if len(group) == 1 else self._final_pad
 
-        s.pending = []
-        s.written += take
-        s.cache_len = s.written
-        s.committed = s.written
-        s.phase = "decode"
-        self._prefill_queue.pop(0)
+        tokens = np.zeros((B, bucket), np.int32)
+        seq_len = np.ones((B,), np.int32)
+        slots_v = np.zeros((B,), np.int32)
+        start_v = np.zeros((B,), np.int32)
+        for b in range(B):
+            gslot, gtake = group[min(b, len(group) - 1)]  # pad = repeat last
+            gs = self.slots[gslot]
+            tokens[b, :gtake] = gs.pending[:gtake]
+            seq_len[b] = gtake
+            slots_v[b] = gslot
+            start_v[b] = gs.written
 
-        self.lengths = self.lengths.at[slot].set(s.written)
-        self.cur_tokens = self.cur_tokens.at[slot].set(first_id)
-        self.active_dev = self.active_dev.at[slot].set(True)
-
-        s.t_prefill_ms += (t1 - t0) * 1e3
-        if s.t_first_token == 0.0:
-            s.t_first_token = t1
-        self._emit_token(slot, first_id, first_lp)
+        fn = self._get_final_fn(bucket, B, continued)
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys = fn(
+            self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
+            self.ring, self.ring_pos, self.bias, self.rng_keys, self.slot_params)
+        # ASYNC: don't sync here — the result would be serialized behind any
+        # in-flight decode burst, idling the device. The group's slots stay
+        # in "prefill" phase (and out of decode bursts) until the sampled
+        # first tokens arrive; _maybe_finalize_prefill polls readiness each
+        # loop iteration. Bookkeeping (pending/written) is advanced NOW so a
+        # second dispatch can't double-prefill the same slots.
+        for gslot, gtake in group:
+            gs = self.slots[gslot]
+            gs.pending = []
+            gs.written += gtake
+            if gslot in self._prefill_queue:
+                self._prefill_queue.remove(gslot)
+        self._pending_prefill = (
+            [(gslot, self.slots[gslot]) for gslot, _ in group],
+            out_ids, logprobs, t0)
         return True
 
-    def _decode_once(self):
-        (ids, logprobs, self.ck, self.cv, self.lengths, self.counts,
-         self.rng_keys) = self._decode_fn(
-            self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
-            self.counts, self.bias, self.rng_keys, self.slot_params, self.active_dev,
-        )
-        self.cur_tokens = ids
-        ids_np = np.asarray(ids)
+    def _maybe_finalize_prefill(self, block: bool = False) -> bool:
+        """Activate a dispatched final-prefill group once its first tokens
+        are available (or immediately when ``block``)."""
+        pp = self._pending_prefill
+        if pp is None:
+            return False
+        group, out_ids, logprobs, t0 = pp
+        if not block and not out_ids.is_ready():
+            return False
+        self._pending_prefill = None
+        ids_np = np.asarray(out_ids)
         lps_np = np.asarray(logprobs)
+        t1 = time.monotonic()
+
+        for b, (gslot, snap) in enumerate(group):
+            gs = self.slots[gslot]
+            if gs is not snap:
+                continue  # cancelled while the prefill was in flight
+            first_id = int(ids_np[b])
+            gs.cache_len = gs.written
+            gs.committed = gs.written
+            gs.phase = "decode"
+
+            self.lengths[gslot] = gs.written
+            self.cur_tokens[gslot] = first_id
+            self.active_dev[gslot] = True
+            self._chain_dirty = True
+            # mirror the sampled token into the penalty ring
+            self.ring[gslot, self.ring_pos[gslot] % sampling.RING_N] = first_id
+            self.ring_pos[gslot] += 1
+
+            gs.t_prefill_ms += (t1 - t0) * 1e3
+            if gs.t_first_token == 0.0:
+                gs.t_first_token = t1
+            self._emit_token(gslot, first_id, float(lps_np[b]))
+        return True
+
+    def _pick_burst(self) -> int:
+        """Burst length for this dispatch: a power of two <= decode_burst,
+        clamped so no slot crosses its context-shift threshold mid-burst
+        (tokens past the threshold would be silently position-less) and
+        forced to 1 when any active slot is grammar-constrained (per-token
+        bias updates). Slots that finish mid-burst (EOS/stop/budget) simply
+        ride out the burst; their tail tokens are discarded host-side —
+        cheaper than clamping every slot to the smallest remaining budget.
+        Host mirrors lag by any in-flight (pipelined) burst, so its steps
+        count against the capacity clamp too."""
+        cap = self.ecfg.decode_burst
+        budget = 1
+        infl = self._inflight
+        inflight_k = infl.n_steps if infl is not None else 0
+        inflight_slots = {i for i, _ in infl.slots} if infl is not None else ()
         for i, s in enumerate(self.slots):
-            if s is not None and s.phase == "decode":
+            if s is None or s.phase != "decode":
+                continue
+            if s.grammar is not None:
+                return 1
+            used = s.cache_len + (inflight_k if i in inflight_slots else 0)
+            cap = min(cap, max(1, self.ecfg.max_context - 2 - used))
+            budget = max(budget, s.req.max_new_tokens - s.n_decoded)
+        cap = min(cap, budget)
+        k = 1
+        while k * 2 <= cap:
+            k *= 2
+        return k
+
+    def _decode_once(self):
+        """Dispatch one decode burst, PIPELINED: the previous burst's host
+        processing (sync, detok, stop-scan, queue puts) happens while this
+        burst runs on the device. Burst-to-burst state (tokens/lengths/ring)
+        chains device-side; whenever host events (admission, release,
+        context shift) invalidate the chain, the burst is fed from the host
+        mirrors instead — which requires the previous burst's results to be
+        folded into the mirrors first."""
+        grammar_sync = any(s is not None and s.phase == "decode"
+                           and s.grammar is not None for s in self.slots)
+        if self._inflight is not None:
+            if grammar_sync:
+                # grammar masks are updated during EMISSION (advance per
+                # token); the next dispatch must see the updated bias
+                self._process_burst(self._inflight)
+                self._inflight = None
+            elif self._chain_dirty:
+                # dispatching from mirrors requires the previous burst
+                # folded in first — but only the FOLD (sync + mirror
+                # arithmetic, ~1ms); the expensive emission still overlaps
+                # the next burst below
+                self._fold_burst(self._inflight)
+        n_steps = self._pick_burst()
+        fn = self._get_burst_fn(n_steps)
+        if self._chain_dirty or self._chain is None:
+            tokens, lengths, ring, rpos = (self.cur_tokens, self.lengths,
+                                           self.ring, self.ring_pos)
+        else:
+            tokens, lengths, ring, rpos = self._chain
+        # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
+        # released and re-admitted while this burst is in flight, and the
+        # new occupant must never receive the stale burst's tokens
+        burst_slots = [(i, s) for i, s in enumerate(self.slots)
+                       if s is not None and s.phase == "decode"]
+        ids_all, lps_all, self.ck, self.cv, self.rng_keys, self._chain = fn(
+            self.params, tokens, self.ck, self.cv, lengths,
+            ring, rpos, self.bias, self.rng_keys, self.slot_params,
+            self.active_dev,
+        )
+        self._chain_dirty = False
+        prev, self._inflight = self._inflight, _Burst(n_steps, burst_slots,
+                                                      ids_all, lps_all)
+        if prev is not None:
+            self._process_burst(prev)
+        if grammar_sync:
+            self._process_burst(self._inflight)
+            self._inflight = None
+
+    def _live(self, i, snap):
+        return self.slots[i] is snap and snap.phase == "decode"
+
+    def _fold_burst(self, b: "_Burst"):
+        """Sync a burst's ids and fold the device-side state evolution into
+        the host mirrors. Cheap (~1ms past the device sync) and idempotent;
+        emission is separate so it can overlap the NEXT dispatch."""
+        if b.folded:
+            return
+        b.ids_np = np.asarray(b.ids_all)    # [K, S]
+        b.lps_np = np.asarray(b.lps_all)
+        live_idx = [i for i, snap in b.slots if self._live(i, snap)]
+        for i in live_idx:
+            self.cur_tokens[i] = b.ids_np[-1, i]
+            self.lengths[i] += b.n_steps
+        sampling.host_update_ring(self.ring, self.ring_pos, b.ids_np, live_idx)
+        b.folded = True
+
+    def _process_burst(self, b: "_Burst"):
+        """Fold (if not already) then emit a burst's tokens (emission may
+        release slots or trigger context shifts — both mark the device
+        chain dirty)."""
+        self._fold_burst(b)
+        for j in range(b.n_steps):
+            for i, snap in b.slots:
+                if not self._live(i, snap):
+                    continue  # finished/shifted/replaced
                 # the step just wrote this slot's previous token's KV row
-                s.committed = min(s.committed + 1, s.cache_len)
-                self._emit_token(i, int(ids_np[i]), float(lps_np[i]))
+                snap.committed = min(snap.committed + 1, snap.cache_len)
+                self._emit_token(i, int(b.ids_np[j, i]), float(b.lps_np[j, i]))
 
     def _emit_token(self, slot: int, token_id: int, logprob: float):
         s = self.slots[slot]
@@ -752,12 +1082,12 @@ class Engine:
         s.written = 0
         s.cache_len = 0
         s.committed = 0
-        self.active_dev = self.active_dev.at[slot].set(False)
-        self.lengths = self.lengths.at[slot].set(0)
-        # restart the penalty histogram from the kept window
-        row = np.bincount(np.asarray(new_ids, np.int64),
-                          minlength=self.cfg.vocab_size).astype(np.int32)
-        self.counts = self.counts.at[slot].set(jnp.asarray(row))
+        self.active_dev[slot] = False
+        self.lengths[slot] = 0
+        self._chain_dirty = True
+        # restart the penalty ring from the kept window
+        self.ring, self.ring_pos = sampling.set_slot_ring(
+            self.ring, self.ring_pos, slot, new_ids)
         self._prefill_queue.append(slot)
         # prefix matching against a mid-shift slot cannot happen (occupied)
         self._cache_tokens[slot] = list(new_ids)
@@ -794,5 +1124,6 @@ class Engine:
         if s is not None:
             self._cache_tokens[slot] = self._cache_tokens[slot][:s.committed]
         self.slots[slot] = None
-        self.active_dev = self.active_dev.at[slot].set(False)
-        self.lengths = self.lengths.at[slot].set(0)
+        self.active_dev[slot] = False
+        self.lengths[slot] = 0
+        self._chain_dirty = True

@@ -174,8 +174,8 @@ def prefill(
     seq_lens-1, cache_k, cache_v).
 
     INVARIANT (enforced by the engine scheduler, not checkable in-jit):
-    start_pos + T <= cache capacity C. dynamic_update_slice clamps
-    out-of-range starts, which would silently overwrite the prefix tail.
+    start_pos + T <= cache capacity C. Out-of-range rows are dropped by
+    the KV scatter (mode="drop"), i.e. silently lost, not clamped.
     """
     B, T = tokens.shape
     positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -190,13 +190,15 @@ def prefill(
         q, k, v = _project_qkv(h, layer, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # write this layer's K/V for all B prompts into their slots:
-        # ck[li, slot_ids[b], start_pos[b]:start_pos[b]+T] = k[b]
-        def write_one(c, kv_b, slot, sp):
-            return jax.lax.dynamic_update_slice(c, kv_b[None], (slot, sp, 0, 0))
-        for b in range(B):
-            ck = ck.at[li].set(write_one(ck[li], k[b].astype(ck.dtype), slot_ids[b], start_pos[b]))
-            cv = cv.at[li].set(write_one(cv[li], v[b].astype(cv.dtype), slot_ids[b], start_pos[b]))
+        # write this layer's K/V for all B prompts into their slots with ONE
+        # batched scatter (ck[li, slot_ids[b], start_pos[b]+t] = k[b, t]) —
+        # a python loop of per-prompt dynamic_update_slices serializes B*2
+        # updates per layer and dominated batched-prefill time. Duplicate
+        # slot entries (engine batch padding) write identical rows.
+        rows = slot_ids[:, None] * jnp.ones((1, T), jnp.int32)              # [B, T]
+        cols = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+        ck = ck.at[li, rows, cols].set(k.astype(ck.dtype), mode="drop")
+        cv = cv.at[li, rows, cols].set(v.astype(cv.dtype), mode="drop")
         if continued:
             # continued prefix: keys live in the cache; attend over the full
             # slot rows with absolute-position causal masking.

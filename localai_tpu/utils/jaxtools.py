@@ -1,0 +1,37 @@
+"""JAX runtime helpers shared by the serving runners and benches."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def enable_compilation_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a stable directory so
+    process restarts (backend respawn, bench runs, tests) deserialize
+    executables instead of recompiling — a cold XLA compile costs 20-40s
+    on the serving chip, and the reference's llama.cpp backend has no such
+    cost to hide (model load there IS the warmup).
+
+    Env override: LOCALAI_JAX_CACHE (empty string disables).
+    """
+    env = os.environ.get("LOCALAI_JAX_CACHE")
+    if env == "":
+        return None
+    path = env or path or os.path.join(
+        os.path.expanduser("~"), ".cache", "localai_tpu", "jax")
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything, even fast compiles — dispatch count matters more
+        # than disk on the serving path
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception:  # pragma: no cover - cache is best-effort
+        log.exception("persistent compilation cache unavailable")
+        return None

@@ -147,7 +147,7 @@ def test_engine_mesh_state_survives_reset(shard_cfg, mesh8, shard_params_pair):
     try:
         e._reset_device_state()
         assert e.ck.sharding.spec == shardlib.cache_spec()
-        assert e.counts.sharding.spec == P("dp", None)
+        assert e.bias.sharding.spec == P("dp", None)
         text, events = e.generate_text(eng.GenRequest(
             prompt_ids=ByteTokenizer().encode("after reset"),
             max_new_tokens=4,

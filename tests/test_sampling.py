@@ -9,24 +9,24 @@ from localai_tpu.engine import sampling
 
 def _mk(S=2, V=64):
     sp = sampling.make_slot_params(S)
-    counts = jnp.zeros((S, V), jnp.int32)
+    ring, pos = sampling.make_ring(S)
     bias = jnp.zeros((S, V), jnp.float32)
     keys = jax.vmap(jax.random.key_data)(
         jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
     )
-    return sp, counts, bias, keys
+    return sp, ring, pos, bias, keys
 
 
 def test_greedy_picks_argmax():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[1, 13].set(5.0)
-    ids, logprobs, _ = sampling.sample(logits, sp, counts, bias, keys)
+    ids, logprobs, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert list(np.asarray(ids)) == [7, 13]
     assert np.all(np.asarray(logprobs) <= 0)
 
 
 def test_top_k_restricts_support():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.0, top_k=2, top_p=1.0))
     sp = sampling.set_slot(sp, 1, sampling.SamplingParamsHost(temperature=1.0, top_k=2, top_p=1.0))
     logits = jnp.zeros((2, 64), jnp.float32).at[:, 3].set(10.0).at[:, 9].set(9.0)
@@ -35,62 +35,78 @@ def test_top_k_restricts_support():
         keys2 = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial * 100)
         )
-        ids, _, _ = sampling.sample(logits, sp, counts, bias, keys2)
+        ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
         seen.update(np.asarray(ids).tolist())
     assert seen <= {3, 9}
 
 
 def test_top_p_keeps_head():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.0, top_k=0, top_p=0.5))
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 5].set(20.0)  # ~all mass on 5
     for trial in range(10):
         keys2 = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial)
         )
-        ids, _, _ = sampling.sample(logits, sp, counts, bias, keys2)
+        ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
         assert int(np.asarray(ids)[0]) == 5
 
 
 def test_repeat_penalty_suppresses_seen_tokens():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, repeat_penalty=100.0))
-    counts = counts.at[0, 7].set(3)
+    ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 7, 7])
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
-    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 8  # 7 heavily penalized
 
 
 def test_frequency_penalty():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, frequency_penalty=2.0))
-    counts = counts.at[0, 7].set(3)  # 5.0 - 6.0 < 4.0
+    ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 7, 7])  # 5.0 - 6.0 < 4.0
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
-    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 8
 
 
+def test_penalty_window_expires():
+    """Tokens older than repeat_last_n are NOT penalized (llama.cpp last-n)."""
+    sp, ring, pos, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(
+        temperature=0.0, repeat_penalty=100.0, repeat_last_n=2))
+    # token 7 seen long ago, then two other tokens push it out of the window
+    ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 1, 2])
+    logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
+    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    assert int(np.asarray(ids)[0]) == 7  # 7 outside window: unpenalized
+
+
+def test_ring_wraps_and_updates():
+    ring, pos = sampling.make_ring(2)
+    active = jnp.array([True, False])
+    for t in range(sampling.RING_N + 3):
+        ids = jnp.array([t % 100, 55], jnp.int32)
+        ring, pos = sampling.update_ring(ring, pos, ids, active)
+    assert int(pos[0]) == sampling.RING_N + 3
+    assert int(pos[1]) == 0
+    assert np.all(np.asarray(ring[1]) == -1)  # inactive slot untouched
+    # most recent write landed at (RING_N + 2) % RING_N
+    assert int(ring[0, (sampling.RING_N + 2) % sampling.RING_N]) == (sampling.RING_N + 2) % 100
+
+
 def test_logit_bias():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     bias = bias.at[0, 42].set(100.0)
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0)
-    ids, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 42
 
 
 def test_deterministic_seed():
-    sp, counts, bias, keys = _mk()
+    sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.5, top_k=0, top_p=1.0))
     logits = jax.random.normal(jax.random.PRNGKey(0), (2, 64)) * 3
-    a, _, _ = sampling.sample(logits, sp, counts, bias, keys)
-    b, _, _ = sampling.sample(logits, sp, counts, bias, keys)
+    a, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    b, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert np.array_equal(np.asarray(a), np.asarray(b))
-
-
-def test_update_token_counts():
-    counts = jnp.zeros((2, 16), jnp.int32)
-    ids = jnp.array([3, 5], jnp.int32)
-    active = jnp.array([True, False])
-    out = sampling.update_token_counts(counts, ids, active)
-    assert int(out[0, 3]) == 1
-    assert int(out[1, 5]) == 0
