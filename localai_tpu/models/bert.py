@@ -80,15 +80,24 @@ def init_params(cfg: BertConfig, key: jax.Array) -> dict:
     }
 
 
-def encode(params: dict, cfg: BertConfig, tokens: jax.Array, mask: jax.Array):
-    """tokens [B, T] int32, mask [B, T] bool -> hidden [B, T, D]."""
+def encode(params: dict, cfg: BertConfig, tokens: jax.Array, mask: jax.Array,
+           type_ids: jax.Array = None):
+    """tokens [B, T] int32, mask [B, T] bool -> hidden [B, T, D].
+
+    type_ids [B, T] selects segment embeddings (None = all segment 0);
+    cross-encoders mark the document half of a (query, document) pair
+    with segment 1."""
     B, T = tokens.shape
     H = cfg.num_heads
     hd = cfg.hidden_size // H
     pos = jnp.arange(T, dtype=jnp.int32)
+    if type_ids is None:
+        seg = params["type_embed"][None, 0][:, None, :]
+    else:
+        seg = jnp.take(params["type_embed"], type_ids, axis=0)
     x = (jnp.take(params["word_embed"], tokens, axis=0)
          + params["pos_embed"][None, pos]
-         + params["type_embed"][None, 0][:, None, :])
+         + seg)
     x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"], cfg.layer_norm_eps)
 
     neg = jnp.float32(-1e30)
@@ -122,6 +131,62 @@ def embed(params: dict, cfg: BertConfig, tokens: jax.Array, mask: jax.Array,
         pooled = pooled / jnp.maximum(
             jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
     return pooled
+
+
+def cross_score(params: dict, cfg: BertConfig, tokens: jax.Array,
+                mask: jax.Array, type_ids: jax.Array):
+    """Cross-encoder relevance scores [B] for (query, document) pairs.
+
+    Capability parity with the reference's reranker backend
+    (reference: backend/python/rerankers/backend.py:1-123, jina-style
+    rerank): BertForSequenceClassification semantics — CLS hidden state
+    -> optional tanh pooler -> 1-logit classifier.
+    """
+    hidden = encode(params, cfg, tokens, mask, type_ids)
+    cls = hidden[:, 0, :]
+    if "pooler_w" in params:
+        cls = jnp.tanh(jnp.einsum("bd,de->be", cls, params["pooler_w"])
+                       + params["pooler_b"])
+    logit = jnp.einsum("bd,dc->bc", cls, params["classifier_w"]) + params["classifier_b"]
+    return logit[:, 0].astype(jnp.float32)
+
+
+def init_cross_params(cfg: BertConfig, key: jax.Array) -> dict:
+    """Random-init encoder + rerank head (for tests/smoke)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = init_params(cfg, k1)
+    D = cfg.hidden_size
+    params["pooler_w"] = (jax.random.normal(k2, (D, D), jnp.float32) / np.sqrt(D)).astype(cfg.dtype)
+    params["pooler_b"] = jnp.zeros((D,), cfg.dtype)
+    params["classifier_w"] = (jax.random.normal(k3, (D, 1), jnp.float32) / np.sqrt(D)).astype(cfg.dtype)
+    params["classifier_b"] = jnp.zeros((1,), cfg.dtype)
+    return params
+
+
+def load_hf_cross_params(model_dir: str, cfg: BertConfig) -> dict:
+    """Load a HF BertForSequenceClassification reranker (1-label head)."""
+    from localai_tpu.engine.weights import _open_shards
+
+    tensors = _open_shards(model_dir)
+    params = load_hf_params(model_dir, cfg)
+
+    def maybe(name):
+        for prefix in ("", "bert."):
+            if prefix + name in tensors:
+                h = tensors[prefix + name]
+                return np.asarray(h.get_tensor(prefix + name))
+        return None
+
+    pw = maybe("pooler.dense.weight")
+    if pw is not None:
+        params["pooler_w"] = jnp.asarray(pw.T, cfg.dtype)
+        params["pooler_b"] = jnp.asarray(maybe("pooler.dense.bias"), cfg.dtype)
+    cw = maybe("classifier.weight")
+    if cw is None:
+        raise KeyError("classifier.weight (not a sequence-classification checkpoint)")
+    params["classifier_w"] = jnp.asarray(cw.T, cfg.dtype)
+    params["classifier_b"] = jnp.asarray(maybe("classifier.bias"), cfg.dtype)
+    return params
 
 
 def load_hf_params(model_dir: str, cfg: BertConfig) -> dict:
