@@ -56,6 +56,8 @@ class EngineServicer(BackendServicer):
         self.engine = None
         self.tokenizer = None
         self.model_cfg = None
+        self.vision = None
+        self.vision_cfg = None
         self._state = pb.StatusResponse.UNINITIALIZED
         self._load_lock = threading.Lock()
         self._embed = False
@@ -119,12 +121,59 @@ class EngineServicer(BackendServicer):
             precompile=os.environ.get("LOCALAI_PRECOMPILE", "1") != "0")
         self._embed = request.embeddings
 
+        # multimodal projector (LLaVA-style vision tower; reference injects
+        # CLIP embeddings at [img-N] placeholders, grpc-server.cpp:1157-1180)
+        self.vision = None
+        self.vision_cfg = None
+        if request.mmproj:
+            from localai_tpu.models import vision
+
+            vdir = request.mmproj
+            if request.model_path and not os.path.isabs(vdir):
+                vdir = os.path.join(request.model_path, vdir)
+            self.vision_cfg = vision.VisionConfig.from_json(
+                os.path.join(vdir, "config.json"), proj_dim=cfg.hidden_size)
+            self.vision = vision.load_params(vdir, self.vision_cfg)
+
     # ---- inference ----
+
+    def _expand_images(self, opts: pb.PredictOptions):
+        """Tokenize the prompt around [img-N] placeholders and compute
+        injection positions + projected embeddings for each image."""
+        import base64
+        import re
+
+        from localai_tpu.models import vision
+
+        pieces = re.split(r"(\[img-\d+\])", opts.prompt)
+        ids: list = []
+        positions: list = []
+        vectors: list = []
+        for piece in pieces:
+            m = re.fullmatch(r"\[img-(\d+)\]", piece)
+            if m and int(m.group(1)) < len(opts.images):
+                img = base64.b64decode(opts.images[int(m.group(1))])
+                emb = vision.embed_image(self.vision, self.vision_cfg, img)
+                pad = getattr(self.tokenizer, "pad_token_id", None) or 0
+                for v in emb:
+                    positions.append(len(ids))
+                    vectors.append(v)
+                    ids.append(pad)
+            elif piece:
+                ids.extend(self.tokenizer.encode(
+                    piece, add_special_tokens=not ids))
+        import numpy as np
+
+        return ids, positions, (np.stack(vectors) if vectors else None)
 
     def _build_request(self, opts: pb.PredictOptions):
         from localai_tpu.engine.engine import GenRequest
 
-        if opts.prompt_ids:
+        mm_positions: list = []
+        mm_vectors = None
+        if opts.images and self.vision is not None and not opts.prompt_ids:
+            ids, mm_positions, mm_vectors = self._expand_images(opts)
+        elif opts.prompt_ids:
             ids = list(opts.prompt_ids)
         else:
             ids = self.tokenizer.encode(opts.prompt)
@@ -135,6 +184,8 @@ class EngineServicer(BackendServicer):
             stop_sequences=list(opts.stop_sequences),
             ignore_eos=opts.ignore_eos,
             grammar=opts.grammar,
+            mm_positions=mm_positions,
+            mm_vectors=mm_vectors,
             request_id=opts.correlation_id or "",
         )
 

@@ -73,6 +73,10 @@ class GenRequest:
     stop_sequences: list = dataclasses.field(default_factory=list)
     ignore_eos: bool = False
     grammar: str = ""               # GBNF constrained decoding
+    # multimodal (LLaVA-style): projected image embeddings to inject at
+    # absolute prompt positions (prompt_ids holds pad tokens there)
+    mm_positions: list = dataclasses.field(default_factory=list)  # [P] ints
+    mm_vectors: Any = None          # np [P, hidden] float32
     request_id: str = ""
     # filled by engine:
     out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
@@ -117,6 +121,7 @@ class _Slot:
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
         "grammar", "gstate", "bias_base", "cur_penalty",
         "phase", "pending", "written", "reused", "cache_len", "committed",
+        "mm_pos", "mm_vec",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -134,6 +139,8 @@ class _Slot:
         self.bias_base = None   # np [V] logit_bias row under the grammar mask
         self.cur_penalty = None  # last uploaded penalty row (identity-compared)
         self.phase = "prefill"  # "prefill" -> "decode"
+        self.mm_pos = None      # np [P] absolute prompt positions (P-bucketed)
+        self.mm_vec = None      # np [P, hidden] injected embeddings
         self.pending: list[int] = []   # prompt tokens not yet prefilled
         self.written = 0        # cache rows already valid for this request
         self.reused = 0         # prefix tokens reused from a previous request
@@ -312,21 +319,25 @@ class Engine:
         # emitted ids for use whenever admissions/releases reset slot state
         return ids_all, lps_all, ck, cv, keys, (tokens, lengths, ring, ring_pos)
 
-    def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos):
+    def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
+                            mm_pos=None, mm_vec=None):
         """Non-final chunk: write KV only, no sampling. (The penalty ring is
         seeded host-side at admission from the full prompt tail.)"""
         _, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv, slot,
-                                  start_pos, continued=True)
+                                  start_pos, continued=True,
+                                  mm_pos=mm_pos, mm_vec=mm_vec)
         return ck, cv
 
     def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
-                            ring, ring_pos, bias, keys, slot_params, continued: bool):
+                            ring, ring_pos, bias, keys, slot_params, continued: bool,
+                            mm_pos=None, mm_vec=None):
         """Final chunk for a BATCH of B prompts: write KV, sample each one's
         first output token. slot may contain duplicate entries (batch
         padding repeats the last prompt; duplicate KV writes and key
         scatters are idempotent — same inputs, last write wins)."""
         logits, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv,
-                                       slot, start_pos, continued=continued)
+                                       slot, start_pos, continued=continued,
+                                       mm_pos=mm_pos, mm_vec=mm_vec)
         sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), slot, axis=0),
                                slot_params)
         bias_rows = jnp.take(bias, slot, axis=0)
@@ -360,6 +371,28 @@ class Engine:
         if fn is None:
             fn = jax.jit(
                 lambda *a: self._prefill_final_body(*a, continued=continued),
+                donate_argnums=(3, 4, 10))
+            self._final_fns[key] = fn
+        return fn
+
+    # multimodal prefill variants (B=1, lazily compiled on first vision
+    # request; keyed additionally on the image-embedding bucket P)
+
+    def _get_mm_chunk_fn(self, bucket: int, pbucket: int):
+        key = ("mm", bucket, pbucket)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            fn = jax.jit(self._prefill_chunk_body, donate_argnums=(3, 4))
+            self._chunk_fns[key] = fn
+        return fn
+
+    def _get_mm_final_fn(self, bucket: int, pbucket: int, continued: bool):
+        key = ("mm", bucket, pbucket, continued)
+        fn = self._final_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._prefill_final_body(*a[:12], continued=continued,
+                                                    mm_pos=a[12], mm_vec=a[13]),
                 donate_argnums=(3, 4, 10))
             self._final_fns[key] = fn
         return fn
@@ -697,10 +730,28 @@ class Engine:
         # truncate the prompt head, keeping the tail (reference semantics:
         # grpc-server.cpp prompt truncation keeps the last part of the prompt)
         max_prompt = C - 1 - min(req.max_new_tokens, C // 4)
+        shift = 0
         if len(ids) > max_prompt:
+            shift = len(ids) - max_prompt
             ids = ids[-max_prompt:]
         if not ids:
             ids = [getattr(self.tokenizer, "eos_token_id", 0) or 0]
+
+        mm_pos = mm_vec = None
+        if req.mm_vectors is not None and len(req.mm_positions):
+            pos = np.asarray(req.mm_positions, np.int64) - shift
+            keep = (pos >= 0) & (pos < len(ids))
+            pos = pos[keep]
+            vec = np.asarray(req.mm_vectors, np.float32)[keep]
+            pb = 16
+            while pb < len(pos):
+                pb *= 2
+            # sentinel >= any bucket so the injection scatter DROPS pads
+            # (negative sentinels would wrap to the last column)
+            mm_pos = np.full((pb,), 1 << 30, np.int64)
+            mm_pos[: len(pos)] = pos
+            mm_vec = np.zeros((pb, self.cfg.hidden_size), np.float32)
+            mm_vec[: len(pos)] = vec
 
         slot, common = self._pick_slot(ids)
         assert slot is not None, "_start_request called with no free slot"
@@ -708,8 +759,9 @@ class Engine:
         # first word) is not worth the slow path it forces: continued
         # prefills run singly while fresh finals batch 8 per dispatch.
         # Reuse only prefixes long enough to beat that cost (real multi-turn
-        # chats share hundreds of system/history tokens).
-        if common < 16:
+        # chats share hundreds of system/history tokens). Multimodal prompts
+        # never reuse (their cache rows hold image embeddings, not tokens).
+        if common < 16 or mm_pos is not None:
             common = 0
 
         # install sampling state for the slot
@@ -749,10 +801,13 @@ class Engine:
         s = _Slot(req, IncrementalDetokenizer(self.tokenizer), len(ids))
         s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
         s.cur_penalty = penalty0
+        s.mm_pos, s.mm_vec = mm_pos, mm_vec
         s.pending = ids[common:]
         s.written = common
         s.reused = common
-        self._cache_tokens[slot] = list(ids)
+        # multimodal rows are image embeddings, not token embeddings — a
+        # later text request must never "reuse" them as a token prefix
+        self._cache_tokens[slot] = [] if mm_pos is not None else list(ids)
         self.slots[slot] = s
         self._prefill_queue.append(slot)
 
@@ -791,28 +846,41 @@ class Engine:
 
         final, take, bucket, continued = self._prefill_plan(slot)
 
+        def mm_rel(mm_pos, start, take, bucket):
+            """Chunk-relative injection positions (pads -> OOB sentinel)."""
+            rel = np.where((mm_pos >= start) & (mm_pos < start + take),
+                           mm_pos - start, 1 << 30)
+            return rel.astype(np.int32)[None]
+
         t0 = time.monotonic()
         if not final:
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :take] = s.pending[:take]
-            fn = self._get_chunk_fn(bucket)
-            self.ck, self.cv = fn(
-                self.params, tokens, np.array([take], np.int32), self.ck, self.cv,
-                np.array([slot], np.int32), np.array([s.written], np.int32))
+            args = (self.params, tokens, np.array([take], np.int32), self.ck,
+                    self.cv, np.array([slot], np.int32),
+                    np.array([s.written], np.int32))
+            if s.mm_pos is not None:
+                fn = self._get_mm_chunk_fn(bucket, len(s.mm_pos))
+                args = args + (mm_rel(s.mm_pos, s.written, take, bucket),
+                               s.mm_vec[None])
+            else:
+                fn = self._get_chunk_fn(bucket)
+            self.ck, self.cv = fn(*args)
             s.pending = s.pending[take:]
             s.written += take
             s.committed = s.written
             s.t_prefill_ms += (time.monotonic() - t0) * 1e3
             return True
 
-        # collect a batch of fresh finals with the same bucket (queue order)
+        # collect a batch of fresh finals with the same bucket (queue order);
+        # multimodal finals go singly (their injection shapes are per-request)
         group = [(slot, take)]
-        if not continued:
+        if not continued and s.mm_pos is None:
             for other in self._prefill_queue[1:]:
                 if len(group) >= self._final_pad:
                     break
                 so = self.slots[other]
-                if so is None or so.phase != "prefill":
+                if so is None or so.phase != "prefill" or so.mm_pos is not None:
                     continue
                 of, ot, ob, oc = self._prefill_plan(other)
                 if of and not oc and ob == bucket:
@@ -831,13 +899,18 @@ class Engine:
             slots_v[b] = gslot
             start_v[b] = gs.written
 
-        fn = self._get_final_fn(bucket, B, continued)
         # ring/ring_pos/slot_params copied: see the aliasing note in
         # _decode_once (in-flight dispatches must not see host mutations)
-        out_ids, logprobs, self.ck, self.cv, self.rng_keys = fn(
-            self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
-            self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
-            jax.tree.map(np.array, self.slot_params))
+        args = (self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
+                self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
+                jax.tree.map(np.array, self.slot_params))
+        if s.mm_pos is not None:
+            fn = self._get_mm_final_fn(bucket, len(s.mm_pos), continued)
+            args = args + (mm_rel(s.mm_pos, start_v[0], take, bucket),
+                           s.mm_vec[None])
+        else:
+            fn = self._get_final_fn(bucket, B, continued)
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys = fn(*args)
         # ASYNC: don't sync here — the result would be serialized behind any
         # in-flight decode burst, idling the device. The group's slots stay
         # in "prefill" phase (and out of decode bursts) until the sampled

@@ -165,6 +165,8 @@ def prefill(
     slot_ids: jax.Array,    # [B] int32 cache slots to fill
     start_pos: jax.Array,   # [B] int32 position offset (nonzero = continued prefix)
     continued: bool = False,  # STATIC: True when any start_pos may be nonzero
+    mm_pos: Optional[jax.Array] = None,   # [B, P] chunk-relative positions
+    mm_vec: Optional[jax.Array] = None,   # [B, P, D] injected embeddings
 ):
     """Process full prompts, write KV into the cache slots, return last-token logits.
 
@@ -172,6 +174,12 @@ def prefill(
     attend chunk-locally (cheap); continued chunks attend through the cache
     rows with absolute-position masking. Returns (logits [B, V] at position
     seq_lens-1, cache_k, cache_v).
+
+    mm_pos/mm_vec implement LLaVA-style multimodal injection (reference:
+    grpc-server.cpp:1157-1180,1425-1440): projected image-patch embeddings
+    replace the token embeddings at the given chunk-relative positions.
+    Inactive entries must use a LARGE positive sentinel (>= T) so the
+    scatter's mode="drop" discards them — negative indices would WRAP.
 
     INVARIANT (enforced by the engine scheduler, not checkable in-jit):
     start_pos + T <= cache capacity C. Out-of-range rows are dropped by
@@ -181,6 +189,9 @@ def prefill(
     positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     sin, cos = rope_frequencies(cfg, positions)
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if mm_pos is not None:
+        bidx = jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.ones_like(mm_pos)
+        x = x.at[bidx, mm_pos].set(mm_vec.astype(cfg.dtype), mode="drop")
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]  # [B, T]
 
     def layer_fn(carry, layer):

@@ -1,0 +1,283 @@
+"""Vision encoder for multimodal chat: CLIP-style ViT + LLaVA projector.
+
+Capability parity with the reference's LLaVA path (reference:
+backend/cpp/llama/grpc-server.cpp:1157-1180,1425-1440 — CLIP image
+embeddings computed per [img-N] placeholder and injected into the prompt
+at the placeholder position). The encoder is a scan-stacked pre-LN ViT
+over fixed-size patches; the projector is LLaVA's 2-layer GELU MLP into
+the language model's hidden size.
+
+Weight layout matches HF ``CLIPVisionModel`` (vision_model.*) plus LLaVA's
+``multi_modal_projector``; init_params/save_params provide the
+framework-native tiny-checkpoint path for offline tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# CLIP preprocessing constants
+_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    proj_dim: int = 4096           # language model hidden size
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def from_hf_config(cfg: dict, proj_dim: int = None, dtype=jnp.float32):
+        v = cfg.get("vision_config", cfg)
+        return VisionConfig(
+            image_size=v.get("image_size", 224),
+            patch_size=v.get("patch_size", 14),
+            hidden_size=v.get("hidden_size", 768),
+            intermediate_size=v.get("intermediate_size", 3072),
+            num_layers=v.get("num_hidden_layers", 12),
+            num_heads=v.get("num_attention_heads", 12),
+            proj_dim=proj_dim or cfg.get("proj_dim", v.get("projection_dim", 4096)),
+            layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+            dtype=dtype,
+        )
+
+    @staticmethod
+    def from_json(path: str, proj_dim: int = None, dtype=jnp.float32):
+        with open(path) as f:
+            return VisionConfig.from_hf_config(json.load(f), proj_dim, dtype)
+
+
+def init_params(cfg: VisionConfig, key: jax.Array) -> dict:
+    D, F, L, P = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.patch_size
+    ks = iter(jax.random.split(key, 16))
+
+    def init(shape, fan_in):
+        return (jax.random.normal(next(ks), shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    n_pos = cfg.num_patches + 1
+    return {
+        "patch_embed": init((D, 3, P, P), 3 * P * P),
+        "cls_embed": init((D,), D),
+        "pos_embed": init((n_pos, D), D),
+        "pre_norm_w": jnp.ones((D,), cfg.dtype),
+        "pre_norm_b": jnp.zeros((D,), cfg.dtype),
+        "layers": {
+            "norm1_w": jnp.ones((L, D), cfg.dtype), "norm1_b": jnp.zeros((L, D), cfg.dtype),
+            "wq": init((L, D, D), D), "bq": jnp.zeros((L, D), cfg.dtype),
+            "wk": init((L, D, D), D), "bk": jnp.zeros((L, D), cfg.dtype),
+            "wv": init((L, D, D), D), "bv": jnp.zeros((L, D), cfg.dtype),
+            "wo": init((L, D, D), D), "bo": jnp.zeros((L, D), cfg.dtype),
+            "norm2_w": jnp.ones((L, D), cfg.dtype), "norm2_b": jnp.zeros((L, D), cfg.dtype),
+            "w1": init((L, D, F), D), "b1": jnp.zeros((L, F), cfg.dtype),
+            "w2": init((L, F, D), F), "b2": jnp.zeros((L, D), cfg.dtype),
+        },
+        "proj_w1": init((D, cfg.proj_dim), D),
+        "proj_b1": jnp.zeros((cfg.proj_dim,), cfg.dtype),
+        "proj_w2": init((cfg.proj_dim, cfg.proj_dim), cfg.proj_dim),
+        "proj_b2": jnp.zeros((cfg.proj_dim,), cfg.dtype),
+    }
+
+
+def _ln(x, w, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+def encode(params: dict, cfg: VisionConfig, pixels: jax.Array) -> jax.Array:
+    """pixels [B, 3, H, W] (CLIP-normalized) -> projected patch embeddings
+    [B, num_patches, proj_dim] (LLaVA drops the CLS token)."""
+    B = pixels.shape[0]
+    D = cfg.hidden_size
+    H = cfg.num_heads
+    hd = D // H
+    eps = cfg.layer_norm_eps
+    x = jax.lax.conv_general_dilated(
+        pixels.astype(cfg.dtype), params["patch_embed"],
+        (cfg.patch_size, cfg.patch_size), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))     # [B, D, gh, gw]
+    x = x.reshape(B, D, -1).transpose(0, 2, 1)           # [B, N, D]
+    cls = jnp.broadcast_to(params["cls_embed"], (B, 1, D))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    x = _ln(x, params["pre_norm_w"], params["pre_norm_b"], eps)
+
+    def layer(x, ly):
+        h = _ln(x, ly["norm1_w"], ly["norm1_b"], eps)
+        q = (jnp.einsum("btd,de->bte", h, ly["wq"]) + ly["bq"]).reshape(B, -1, H, hd)
+        k = (jnp.einsum("btd,de->bte", h, ly["wk"]) + ly["bk"]).reshape(B, -1, H, hd)
+        v = (jnp.einsum("btd,de->bte", h, ly["wv"]) + ly["bv"]).reshape(B, -1, H, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(hd)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        a = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, -1, D)
+        x = x + jnp.einsum("bte,ed->btd", a, ly["wo"]) + ly["bo"]
+        h = _ln(x, ly["norm2_w"], ly["norm2_b"], eps)
+        h = jax.nn.gelu(jnp.einsum("btd,df->btf", h, ly["w1"]) + ly["b1"])
+        x = x + jnp.einsum("btf,fd->btd", h, ly["w2"]) + ly["b2"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    patches = x[:, 1:, :]                                # drop CLS (LLaVA)
+    h = jax.nn.gelu(jnp.einsum("bnd,de->bne", patches, params["proj_w1"])
+                    + params["proj_b1"])
+    return jnp.einsum("bne,ef->bnf", h, params["proj_w2"]) + params["proj_b2"]
+
+
+@functools.lru_cache(maxsize=4)
+def _jit_encode(cfg: VisionConfig):
+    return jax.jit(lambda p, px: encode(p, cfg, px))
+
+
+def preprocess(image_bytes: bytes, cfg: VisionConfig) -> np.ndarray:
+    """Decode + resize + CLIP-normalize an image -> [1, 3, H, W] float32."""
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(image_bytes)).convert("RGB")
+    im = im.resize((cfg.image_size, cfg.image_size), Image.BICUBIC)
+    arr = np.asarray(im, np.float32) / 255.0
+    arr = (arr - _MEAN) / _STD
+    return arr.transpose(2, 0, 1)[None]
+
+
+def embed_image(params: dict, cfg: VisionConfig, image_bytes: bytes) -> np.ndarray:
+    """bytes -> [num_patches, proj_dim] float32 prompt-injectable embeddings."""
+    px = preprocess(image_bytes, cfg)
+    return np.asarray(_jit_encode(cfg)(params, px)[0], np.float32)
+
+
+def save_params(params: dict, cfg: VisionConfig, model_dir: str):
+    from safetensors.numpy import save_file
+
+    os.makedirs(model_dir, exist_ok=True)
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}{k}.", v)
+        else:
+            flat[prefix[:-1]] = np.asarray(node)
+
+    walk("", params)
+    save_file(flat, os.path.join(model_dir, "model.safetensors"))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "localai_tpu_vision",
+            "vision_config": {
+                "image_size": cfg.image_size, "patch_size": cfg.patch_size,
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "layer_norm_eps": cfg.layer_norm_eps,
+            },
+            "proj_dim": cfg.proj_dim,
+        }, f)
+
+
+def load_params(model_dir: str, cfg: VisionConfig) -> dict:
+    """Load framework-native or HF CLIPVisionModel(+projector) safetensors."""
+    from localai_tpu.engine.weights import _open_shards
+
+    tensors = _open_shards(model_dir)
+    names = set(tensors)
+    if "patch_embed" in names:  # framework-native flat layout
+        from safetensors.numpy import load_file
+
+        flat = load_file(os.path.join(model_dir, "model.safetensors"))
+        params: dict = {}
+        for name, arr in flat.items():
+            parts = name.split(".")
+            node = params
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = jnp.asarray(arr, cfg.dtype)
+        return params
+
+    def get(name):
+        for prefix in ("vision_model.", "vision_tower.vision_model.", ""):
+            if prefix + name in tensors:
+                return np.asarray(tensors[prefix + name].get_tensor(prefix + name))
+        raise KeyError(name)
+
+    dt = cfg.dtype
+    L = cfg.num_layers
+
+    def stack(fmt, transpose=False):
+        mats = [get(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), dt)
+
+    e = "encoder.layers.{i}."
+    params = {
+        "patch_embed": jnp.asarray(get("embeddings.patch_embedding.weight"), dt),
+        "cls_embed": jnp.asarray(get("embeddings.class_embedding"), dt).reshape(-1),
+        "pos_embed": jnp.asarray(get("embeddings.position_embedding.weight"), dt),
+        "pre_norm_w": jnp.asarray(get("pre_layrnorm.weight"), dt),
+        "pre_norm_b": jnp.asarray(get("pre_layrnorm.bias"), dt),
+        "layers": {
+            "norm1_w": stack(e + "layer_norm1.weight"),
+            "norm1_b": stack(e + "layer_norm1.bias"),
+            "wq": stack(e + "self_attn.q_proj.weight", True),
+            "bq": stack(e + "self_attn.q_proj.bias"),
+            "wk": stack(e + "self_attn.k_proj.weight", True),
+            "bk": stack(e + "self_attn.k_proj.bias"),
+            "wv": stack(e + "self_attn.v_proj.weight", True),
+            "bv": stack(e + "self_attn.v_proj.bias"),
+            "wo": stack(e + "self_attn.out_proj.weight", True),
+            "bo": stack(e + "self_attn.out_proj.bias"),
+            "norm2_w": stack(e + "layer_norm2.weight"),
+            "norm2_b": stack(e + "layer_norm2.bias"),
+            "w1": stack(e + "mlp.fc1.weight", True),
+            "b1": stack(e + "mlp.fc1.bias"),
+            "w2": stack(e + "mlp.fc2.weight", True),
+            "b2": stack(e + "mlp.fc2.bias"),
+        },
+    }
+
+    def proj(name):
+        for cand in (f"multi_modal_projector.linear_{name[-1]}.{name[:-2]}",
+                     f"mm_projector.{name}"):
+            for key in (cand,):
+                if key in tensors:
+                    return np.asarray(tensors[key].get_tensor(key))
+        raise KeyError(name)
+
+    try:
+        params["proj_w1"] = jnp.asarray(proj("weight_1").T, dt)
+        params["proj_b1"] = jnp.asarray(proj("bias_1"), dt)
+        params["proj_w2"] = jnp.asarray(proj("weight_2").T, dt)
+        params["proj_b2"] = jnp.asarray(proj("bias_2"), dt)
+    except KeyError:
+        # CLIP-only checkpoint: identity-ish projector to proj_dim
+        D = cfg.hidden_size
+        eye = np.zeros((D, cfg.proj_dim), np.float32)
+        np.fill_diagonal(eye, 1.0)
+        params["proj_w1"] = jnp.asarray(eye, dt)
+        params["proj_b1"] = jnp.zeros((cfg.proj_dim,), dt)
+        eye2 = np.eye(cfg.proj_dim, dtype=np.float32)
+        params["proj_w2"] = jnp.asarray(eye2, dt)
+        params["proj_b2"] = jnp.zeros((cfg.proj_dim,), dt)
+    return params

@@ -1,0 +1,95 @@
+"""Multimodal (LLaVA-style) vision path: encoder, injection, chat e2e.
+
+Reference semantics: CLIP embeddings injected at [img-N] placeholder
+positions during prefill (grpc-server.cpp:1157-1180,1425-1440).
+"""
+
+import base64
+import io
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.models import vision
+
+
+def _png_bytes(color):
+    from PIL import Image
+
+    im = Image.new("RGB", (20, 20), color)
+    buf = io.BytesIO()
+    im.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+TINY_VCFG = vision.VisionConfig(
+    image_size=16, patch_size=4, hidden_size=32, intermediate_size=64,
+    num_layers=1, num_heads=2, proj_dim=64)
+
+
+def test_vision_encoder_shapes_and_sensitivity():
+    params = vision.init_params(TINY_VCFG, jax.random.PRNGKey(0))
+    red = vision.embed_image(params, TINY_VCFG, _png_bytes("red"))
+    blue = vision.embed_image(params, TINY_VCFG, _png_bytes("blue"))
+    assert red.shape == (TINY_VCFG.num_patches, 64)
+    assert np.all(np.isfinite(red))
+    assert not np.allclose(red, blue)  # different images -> different embeds
+
+
+def test_vision_save_load_roundtrip(tmp_path):
+    params = vision.init_params(TINY_VCFG, jax.random.PRNGKey(1))
+    vdir = str(tmp_path / "vis")
+    vision.save_params(params, TINY_VCFG, vdir)
+    cfg2 = vision.VisionConfig.from_json(os.path.join(vdir, "config.json"),
+                                         proj_dim=64)
+    params2 = vision.load_params(vdir, cfg2)
+    a = vision.embed_image(params, TINY_VCFG, _png_bytes("green"))
+    b = vision.embed_image(params2, cfg2, _png_bytes("green"))
+    assert np.allclose(a, b, atol=1e-5)
+
+
+def test_multimodal_chat_through_engine(tmp_path):
+    """image_url-style chat: [img-0] placeholder + base64 image through the
+    real runner/engine; the image content must influence generation."""
+    os.environ["LOCALAI_PRECOMPILE"] = "0"
+    import localai_tpu.backend.runner as runner
+    from tests.tinymodel import write_tiny_checkpoint, write_tiny_tokenizer
+
+    mdir = str(tmp_path / "llm")
+    os.makedirs(mdir)
+    write_tiny_checkpoint(mdir)
+    write_tiny_tokenizer(mdir)
+    vdir = str(tmp_path / "vis")
+    vision.save_params(vision.init_params(TINY_VCFG, jax.random.PRNGKey(0)),
+                       TINY_VCFG, vdir)
+
+    sv = runner.EngineServicer()
+    res = sv.LoadModel(pb.ModelOptions(
+        model=mdir, mmproj=vdir, num_slots=2, context_size=128,
+        prefill_buckets=[16, 64], mesh_tp=1, mesh_dp=1), None)
+    assert res.success, res.message
+    try:
+        def ask(images, prompt):
+            return sv.Predict(pb.PredictOptions(
+                prompt=prompt, images=images, max_tokens=6, ignore_eos=True,
+                temperature=0.0), None)
+
+        b64_red = base64.b64encode(_png_bytes("red")).decode()
+        b64_blue = base64.b64encode(_png_bytes("blue")).decode()
+        r1 = ask([b64_red], "[img-0]\ndescribe")
+        r2 = ask([b64_red], "[img-0]\ndescribe")
+        r3 = ask([b64_blue], "[img-0]\ndescribe")
+        assert r1.tokens == 6
+        assert r1.message == r2.message          # deterministic greedy
+        assert r1.message != r3.message          # image content matters
+        # prompt accounting includes the image patch positions
+        assert r1.prompt_tokens >= TINY_VCFG.num_patches
+        # plain text still works with the vision tower loaded
+        r4 = sv.Predict(pb.PredictOptions(
+            prompt="hello", max_tokens=4, ignore_eos=True, temperature=0.0), None)
+        assert r4.tokens == 4
+    finally:
+        sv.engine.shutdown()
