@@ -202,6 +202,14 @@ def build_app(caps, app_config, gallery_service=None) -> web.Application:
     app["state"] = state
     openai_routes.register(app)
     localai_routes.register(app)
+
+    from localai_tpu.api import assistants_routes
+
+    assistants_routes.register(app)
+    if not app_config.disable_webui:
+        from localai_tpu.api import webui
+
+        webui.register(app)
     return app
 
 

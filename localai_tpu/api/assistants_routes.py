@@ -1,0 +1,332 @@
+"""OpenAI Assistants + Files APIs with JSON-blob persistence.
+
+Capability parity with the reference (reference:
+core/http/endpoints/openai/assistant.go:1-522 — assistant CRUD + modify +
+assistant-file attach/list/get/delete persisted to assistants.json /
+assistantsFile.json; core/http/endpoints/openai/files.go:1-194 — multipart
+upload, purpose filter, content download, persisted to uploadedFiles.json;
+blobs reloaded at boot, core/http/app.go:154-156).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+from aiohttp import web
+
+from localai_tpu.api.app import get_state
+
+ASSISTANTS_FILE = "assistants.json"
+ASSISTANT_FILES_FILE = "assistantsFile.json"
+UPLOADED_FILES_FILE = "uploadedFiles.json"
+
+
+class AssistantStore:
+    """File-backed store for assistants, assistant-file links, and uploads."""
+
+    def __init__(self, upload_dir: str):
+        self.dir = upload_dir
+        self.lock = threading.Lock()
+        os.makedirs(upload_dir, exist_ok=True)
+        self.assistants: list = self._load(ASSISTANTS_FILE)
+        self.assistant_files: list = self._load(ASSISTANT_FILES_FILE)
+        self.files: list = self._load(UPLOADED_FILES_FILE)
+
+    def _load(self, name):
+        path = os.path.join(self.dir, name)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except Exception:
+                return []
+        return []
+
+    def save(self):
+        for name, data in ((ASSISTANTS_FILE, self.assistants),
+                           (ASSISTANT_FILES_FILE, self.assistant_files),
+                           (UPLOADED_FILES_FILE, self.files)):
+            with open(os.path.join(self.dir, name), "w") as f:
+                json.dump(data, f)
+
+    def file_path(self, file_id: str) -> str:
+        return os.path.join(self.dir, file_id)
+
+
+def _store(request) -> AssistantStore:
+    state = get_state(request)
+    store = getattr(state, "assistant_store", None)
+    if store is None:
+        base = state.config.uploads_path
+        if not os.path.isabs(base):
+            base = os.path.join(state.config.models_path, base)
+        store = AssistantStore(base)
+        state.assistant_store = store
+    return store
+
+
+def _json(data, status=200):
+    return web.json_response(data, status=status)
+
+
+# ---------- assistants ----------
+
+async def create_assistant(request):
+    store = _store(request)
+    body = await request.json()
+    if not body.get("model"):
+        raise web.HTTPBadRequest(text="model is required")
+    with store.lock:
+        a = {
+            "id": f"asst_{uuid.uuid4().hex[:24]}",
+            "object": "assistant",
+            "created": int(time.time()),
+            "model": body["model"],
+            "name": body.get("name", ""),
+            "description": body.get("description", ""),
+            "instructions": body.get("instructions", ""),
+            "tools": body.get("tools", []),
+            "file_ids": body.get("file_ids", []),
+            "metadata": body.get("metadata", {}),
+        }
+        store.assistants.append(a)
+        store.save()
+    return _json(a)
+
+
+async def list_assistants(request):
+    store = _store(request)
+    limit = int(request.query.get("limit", "20"))
+    order = request.query.get("order", "desc")
+    after = request.query.get("after")
+    before = request.query.get("before")
+    with store.lock:
+        items = sorted(store.assistants, key=lambda a: a["id"],
+                       reverse=(order != "asc"))
+        if after:
+            ids = [a["id"] for a in items]
+            if after in ids:
+                items = items[ids.index(after) + 1:]
+        if before:
+            ids = [a["id"] for a in items]
+            if before in ids:
+                items = items[: ids.index(before)]
+        return _json(items[:limit])
+
+
+def _find(items, key, value):
+    for x in items:
+        if x[key] == value:
+            return x
+    return None
+
+
+async def get_assistant(request):
+    store = _store(request)
+    a = _find(store.assistants, "id", request.match_info["assistant_id"])
+    if a is None:
+        raise web.HTTPNotFound(text="assistant not found")
+    return _json(a)
+
+
+async def modify_assistant(request):
+    store = _store(request)
+    body = await request.json()
+    with store.lock:
+        a = _find(store.assistants, "id", request.match_info["assistant_id"])
+        if a is None:
+            raise web.HTTPNotFound(text="assistant not found")
+        for k in ("model", "name", "description", "instructions", "tools",
+                  "file_ids", "metadata"):
+            if k in body:
+                a[k] = body[k]
+        store.save()
+    return _json(a)
+
+
+async def delete_assistant(request):
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    with store.lock:
+        before = len(store.assistants)
+        store.assistants = [a for a in store.assistants if a["id"] != aid]
+        deleted = len(store.assistants) != before
+        if deleted:
+            store.assistant_files = [
+                f for f in store.assistant_files if f["assistant_id"] != aid]
+            store.save()
+    return _json({"id": aid, "object": "assistant.deleted", "deleted": deleted},
+                 status=200 if deleted else 404)
+
+
+# ---------- assistant files ----------
+
+async def create_assistant_file(request):
+    store = _store(request)
+    body = await request.json()
+    aid = request.match_info["assistant_id"]
+    with store.lock:
+        a = _find(store.assistants, "id", aid)
+        if a is None:
+            raise web.HTTPNotFound(text="assistant not found")
+        if _find(store.files, "id", body.get("file_id")) is None:
+            raise web.HTTPNotFound(text="file not found")
+        af = {
+            "id": f"af_{uuid.uuid4().hex[:24]}",
+            "object": "assistant.file",
+            "created_at": int(time.time()),
+            "assistant_id": aid,
+            "file_id": body["file_id"],
+        }
+        store.assistant_files.append(af)
+        if body["file_id"] not in a["file_ids"]:
+            a["file_ids"].append(body["file_id"])
+        store.save()
+    return _json(af)
+
+
+async def list_assistant_files(request):
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    items = [f for f in store.assistant_files if f["assistant_id"] == aid]
+    return _json({"object": "list", "data": items})
+
+
+async def get_assistant_file(request):
+    store = _store(request)
+    af = _find(store.assistant_files, "id", request.match_info["file_id"])
+    if af is None or af["assistant_id"] != request.match_info["assistant_id"]:
+        raise web.HTTPNotFound(text="assistant file not found")
+    return _json(af)
+
+
+async def delete_assistant_file(request):
+    store = _store(request)
+    aid = request.match_info["assistant_id"]
+    fid = request.match_info["file_id"]
+    with store.lock:
+        before = len(store.assistant_files)
+        store.assistant_files = [
+            f for f in store.assistant_files
+            if not (f["assistant_id"] == aid
+                    and (f["id"] == fid or f["file_id"] == fid))]
+        deleted = len(store.assistant_files) != before
+        a = _find(store.assistants, "id", aid)
+        if a and fid in a.get("file_ids", []):
+            a["file_ids"].remove(fid)
+        if deleted:
+            store.save()
+    return _json({"id": fid, "object": "assistant.file.deleted",
+                  "deleted": deleted})
+
+
+# ---------- files ----------
+
+async def upload_file(request):
+    store = _store(request)
+    reader = await request.multipart()
+    purpose = ""
+    filename = ""
+    content = b""
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.name == "purpose":
+            purpose = (await part.read()).decode()
+        elif part.name == "file":
+            filename = part.filename or "upload"
+            content = await part.read()
+    if not purpose:
+        raise web.HTTPBadRequest(text="purpose is required")
+    if not content:
+        raise web.HTTPBadRequest(text="file is required")
+    with store.lock:
+        f = {
+            "id": f"file-{uuid.uuid4().hex[:24]}",
+            "object": "file",
+            "bytes": len(content),
+            "created_at": int(time.time()),
+            "filename": filename,
+            "purpose": purpose,
+        }
+        with open(store.file_path(f["id"]), "wb") as fh:
+            fh.write(content)
+        store.files.append(f)
+        store.save()
+    return _json(f)
+
+
+async def list_files(request):
+    store = _store(request)
+    purpose = request.query.get("purpose")
+    items = (store.files if not purpose
+             else [f for f in store.files if f["purpose"] == purpose])
+    return _json({"object": "list", "data": items})
+
+
+async def get_file(request):
+    store = _store(request)
+    f = _find(store.files, "id", request.match_info["file_id"])
+    if f is None:
+        raise web.HTTPNotFound(text="file not found")
+    return _json(f)
+
+
+async def get_file_content(request):
+    store = _store(request)
+    f = _find(store.files, "id", request.match_info["file_id"])
+    if f is None:
+        raise web.HTTPNotFound(text="file not found")
+    path = store.file_path(f["id"])
+    if not os.path.exists(path):
+        raise web.HTTPNotFound(text="file content missing")
+    return web.FileResponse(path)
+
+
+async def delete_file(request):
+    store = _store(request)
+    fid = request.match_info["file_id"]
+    with store.lock:
+        f = _find(store.files, "id", fid)
+        if f is None:
+            raise web.HTTPNotFound(text="file not found")
+        store.files.remove(f)
+        store.assistant_files = [
+            af for af in store.assistant_files if af["file_id"] != fid]
+        for a in store.assistants:
+            if fid in a.get("file_ids", []):
+                a["file_ids"].remove(fid)
+        try:
+            os.remove(store.file_path(fid))
+        except OSError:
+            pass
+        store.save()
+    return _json({"id": fid, "object": "file", "deleted": True})
+
+
+def register(app: web.Application):
+    r = app.router
+    for prefix in ("/v1", ""):
+        r.add_get(f"{prefix}/assistants", list_assistants)
+        r.add_post(f"{prefix}/assistants", create_assistant)
+        r.add_get(f"{prefix}/assistants/{{assistant_id}}", get_assistant)
+        r.add_post(f"{prefix}/assistants/{{assistant_id}}", modify_assistant)
+        r.add_delete(f"{prefix}/assistants/{{assistant_id}}", delete_assistant)
+        r.add_get(f"{prefix}/assistants/{{assistant_id}}/files",
+                  list_assistant_files)
+        r.add_post(f"{prefix}/assistants/{{assistant_id}}/files",
+                   create_assistant_file)
+        r.add_get(f"{prefix}/assistants/{{assistant_id}}/files/{{file_id}}",
+                  get_assistant_file)
+        r.add_delete(f"{prefix}/assistants/{{assistant_id}}/files/{{file_id}}",
+                     delete_assistant_file)
+        r.add_post(f"{prefix}/files", upload_file)
+        r.add_get(f"{prefix}/files", list_files)
+        r.add_get(f"{prefix}/files/{{file_id}}", get_file)
+        r.add_get(f"{prefix}/files/{{file_id}}/content", get_file_content)
+        r.add_delete(f"{prefix}/files/{{file_id}}", delete_file)

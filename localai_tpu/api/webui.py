@@ -1,0 +1,218 @@
+"""WebUI: server-rendered dashboard over the existing JSON APIs.
+
+Capability parity with the reference's HTMX dashboard (reference:
+core/http/routes/ui.go:88-413 + core/http/views/ — model browse/install
+with live progress, chat, text-to-image, TTS, and p2p/swarm pages).
+Re-designed as dependency-free server-rendered pages with small inline
+scripts that drive the SAME public endpoints a programmatic client uses
+(/v1/models, /models/apply, /models/jobs/:uid, /v1/chat/completions SSE,
+/v1/images/generations, /tts, /api/p2p) — no template engine, no asset
+pipeline, nothing the JSON API can't do.
+"""
+
+from __future__ import annotations
+
+import html
+
+from aiohttp import web
+
+from localai_tpu.api.app import get_state
+
+_STYLE = """
+body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1c2430}
+header{background:#1c2430;color:#fff;padding:10px 24px;display:flex;gap:18px;align-items:baseline}
+header a{color:#9fc1ff;text-decoration:none;margin-right:10px}
+header .brand{font-weight:700;font-size:18px;color:#fff}
+main{max-width:960px;margin:24px auto;padding:0 16px}
+.card{background:#fff;border:1px solid #e2e6ec;border-radius:8px;padding:16px;margin-bottom:16px}
+table{width:100%;border-collapse:collapse}
+td,th{text-align:left;padding:6px 8px;border-bottom:1px solid #eef1f5;font-size:14px}
+button{background:#2a62d9;color:#fff;border:0;border-radius:6px;padding:6px 12px;cursor:pointer}
+button:disabled{background:#9fb3d9}
+input,textarea,select{width:100%;box-sizing:border-box;padding:8px;border:1px solid #cdd5e0;border-radius:6px;font:inherit}
+pre{white-space:pre-wrap;background:#0f1420;color:#d7e3f4;padding:12px;border-radius:6px;min-height:80px}
+.status{font-size:13px;color:#5a6678}
+"""
+
+
+def _page(title: str, body: str) -> web.Response:
+    doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>{html.escape(title)} — LocalAI TPU</title><style>{_STYLE}</style></head>
+<body><header><span class="brand">LocalAI&nbsp;TPU</span>
+<nav><a href="/">Models</a><a href="/browse">Browse</a><a href="/chat">Chat</a>
+<a href="/text2image">Image</a><a href="/tts-ui">TTS</a><a href="/p2p-ui">Mesh</a></nav>
+</header><main>{body}</main></body></html>"""
+    return web.Response(text=doc, content_type="text/html")
+
+
+async def index(request):
+    state = get_state(request)
+    rows = []
+    for name, mc in sorted(state.caps.configs.items()):
+        loaded = state.caps.loader.is_loaded(name) if hasattr(
+            state.caps.loader, "is_loaded") else False
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{html.escape(mc.backend or 'auto')}</td>"
+            f"<td>{'loaded' if loaded else 'on disk'}</td>"
+            f"<td><button onclick=\"del('{html.escape(name)}')\">delete</button></td></tr>")
+    body = f"""
+<div class="card"><h2>Installed models</h2>
+<table><tr><th>name</th><th>backend</th><th>state</th><th></th></tr>
+{''.join(rows) or '<tr><td colspan=4>no models installed — try Browse</td></tr>'}
+</table></div>
+<script>
+async function del(name){{
+  if(!confirm('Delete '+name+'?'))return;
+  await fetch('/models/delete/'+name,{{method:'POST'}});location.reload();
+}}
+</script>"""
+    return _page("Models", body)
+
+
+async def browse(request):
+    body = """
+<div class="card"><h2>Model gallery</h2>
+<p class="status">Models from configured galleries; installs stream progress from /models/jobs.</p>
+<div id="list">loading…</div></div>
+<script>
+async function load(){
+  const r = await fetch('/models/available');
+  const items = await r.json();
+  const div = document.getElementById('list');
+  if(!Array.isArray(items)||!items.length){div.textContent='no gallery models available';return}
+  div.innerHTML = '<table><tr><th>name</th><th>gallery</th><th></th></tr>'+items.map(m=>
+    `<tr><td>${m.name}</td><td>${m.gallery||''}</td>
+     <td><button onclick="install('${m.gallery?m.gallery+'@':''}${m.name}', this)">install</button></td></tr>`).join('')+'</table>';
+}
+async function install(id, btn){
+  btn.disabled = true;
+  const r = await fetch('/models/apply',{method:'POST',headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({id})});
+  const {uuid} = await r.json();
+  const tick = setInterval(async ()=>{
+    const s = await (await fetch('/models/jobs/'+uuid)).json();
+    btn.textContent = s.processed ? (s.error?'failed':'installed')
+                                  : `${Math.round((s.progress||0))}%`;
+    if(s.processed){clearInterval(tick);}
+  }, 700);
+}
+load();
+</script>"""
+    return _page("Browse", body)
+
+
+async def chat(request):
+    state = get_state(request)
+    options = "".join(f"<option>{html.escape(n)}</option>"
+                      for n in sorted(state.caps.configs))
+    body = f"""
+<div class="card"><h2>Chat</h2>
+<select id="model">{options}</select>
+<pre id="out"></pre>
+<textarea id="msg" rows="3" placeholder="Say something…"></textarea>
+<p><button id="send">Send</button> <span class="status" id="st"></span></p></div>
+<script>
+const hist = [];
+send.onclick = async () => {{
+  const text = msg.value.trim(); if(!text) return;
+  hist.push({{role:'user', content:text}});
+  out.textContent += 'you: ' + text + '\\n'; msg.value=''; st.textContent='…';
+  const r = await fetch('/v1/chat/completions', {{method:'POST',
+    headers:{{'Content-Type':'application/json'}},
+    body: JSON.stringify({{model:model.value, messages:hist, stream:true}})}});
+  out.textContent += 'assistant: ';
+  const reader = r.body.getReader(); const dec = new TextDecoder();
+  let reply = '', buf='';
+  while(true){{
+    const {{done, value}} = await reader.read(); if(done) break;
+    buf += dec.decode(value, {{stream:true}});
+    for(const line of buf.split('\\n')){{
+      if(!line.startsWith('data: ')) continue;
+      const payload = line.slice(6);
+      if(payload === '[DONE]') continue;
+      try {{
+        const d = JSON.parse(payload).choices?.[0]?.delta?.content;
+        if(d) {{ reply += d; }}
+      }} catch(e) {{}}
+    }}
+    buf = buf.slice(buf.lastIndexOf('\\n')+1);
+    out.textContent = out.textContent.replace(/assistant: [^]*$/, 'assistant: '+reply);
+  }}
+  out.textContent += '\\n'; hist.push({{role:'assistant', content:reply}});
+  st.textContent='';
+}};
+</script>"""
+    return _page("Chat", body)
+
+
+async def text2image(request):
+    state = get_state(request)
+    options = "".join(f"<option>{html.escape(n)}</option>"
+                      for n in sorted(state.caps.configs))
+    body = f"""
+<div class="card"><h2>Text to image</h2>
+<select id="model">{options}</select>
+<input id="prompt" placeholder="a pelican riding a bicycle">
+<p><button id="go">Generate</button> <span class="status" id="st"></span></p>
+<img id="img" style="max-width:100%"></div>
+<script>
+go.onclick = async () => {{
+  st.textContent='generating…'; go.disabled=true;
+  const r = await fetch('/v1/images/generations', {{method:'POST',
+    headers:{{'Content-Type':'application/json'}},
+    body: JSON.stringify({{model:model.value, prompt:prompt.value, size:'256x256',
+                           response_format:'b64_json'}})}});
+  const j = await r.json(); go.disabled=false;
+  if(j.data && j.data[0]){{
+    img.src = j.data[0].b64_json ? 'data:image/png;base64,'+j.data[0].b64_json : j.data[0].url;
+    st.textContent='';
+  }} else st.textContent = JSON.stringify(j);
+}};
+</script>"""
+    return _page("Image", body)
+
+
+async def tts_ui(request):
+    state = get_state(request)
+    options = "".join(f"<option>{html.escape(n)}</option>"
+                      for n in sorted(state.caps.configs))
+    body = f"""
+<div class="card"><h2>Text to speech</h2>
+<select id="model">{options}</select>
+<input id="text" placeholder="Hello from the TPU">
+<p><button id="go">Speak</button> <span class="status" id="st"></span></p>
+<audio id="audio" controls style="width:100%"></audio></div>
+<script>
+go.onclick = async () => {{
+  st.textContent='synthesizing…'; go.disabled=true;
+  const r = await fetch('/tts', {{method:'POST',
+    headers:{{'Content-Type':'application/json'}},
+    body: JSON.stringify({{model:model.value, input:text.value}})}});
+  go.disabled=false;
+  if(!r.ok){{ st.textContent = await r.text(); return; }}
+  audio.src = URL.createObjectURL(await r.blob()); audio.play(); st.textContent='';
+}};
+</script>"""
+    return _page("TTS", body)
+
+
+async def p2p_ui(request):
+    body = """
+<div class="card"><h2>Device mesh</h2><pre id="out">loading…</pre></div>
+<script>
+fetch('/api/p2p').then(r=>r.json()).then(j=>{
+  out.textContent = JSON.stringify(j, null, 2);
+}).catch(e=>{ out.textContent = String(e); });
+</script>"""
+    return _page("Mesh", body)
+
+
+def register(app: web.Application):
+    r = app.router
+    r.add_get("/", index)
+    r.add_get("/browse", browse)
+    r.add_get("/chat", chat)
+    r.add_get("/text2image", text2image)
+    r.add_get("/tts-ui", tts_ui)
+    r.add_get("/p2p-ui", p2p_ui)

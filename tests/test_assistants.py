@@ -1,0 +1,121 @@
+"""Assistants + Files APIs (reference: openai/assistant.go, files.go).
+
+CRUD + attach flows + JSON-blob persistence reloaded at boot, mirroring
+the reference's assistant tests (assistant_test.go pattern).
+"""
+
+import asyncio
+import threading
+
+import httpx
+import pytest
+
+from localai_tpu.api.app import build_app, run_app
+from localai_tpu.capabilities import Capabilities
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.modelmgr.loader import ModelLoader
+from localai_tpu.modelmgr.process import free_port
+
+
+def _boot(models_path):
+    port = free_port()
+    app_config = AppConfig(models_path=str(models_path),
+                           address=f"127.0.0.1:{port}")
+    loader = ModelLoader()
+    caps = Capabilities(app_config, loader,
+                        {"tiny": ModelConfig(name="tiny", backend="fake",
+                                             model="tiny")})
+    app = build_app(caps, app_config)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            await run_app(app, app_config.address)
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return f"http://127.0.0.1:{port}", loop
+
+
+def test_assistants_and_files_crud(tmp_path):
+    base, loop = _boot(tmp_path)
+    c = httpx.Client(base_url=base, timeout=30)
+
+    # upload a file (multipart, purpose required)
+    r = c.post("/v1/files", files={"file": ("notes.txt", b"hello world")},
+               data={"purpose": "assistants"})
+    assert r.status_code == 200, r.text
+    file_id = r.json()["id"]
+    assert r.json()["bytes"] == 11
+    assert r.json()["filename"] == "notes.txt"
+
+    # purpose is mandatory
+    r = c.post("/v1/files", files={"file": ("x.txt", b"y")})
+    assert r.status_code == 400
+
+    # file content download
+    r = c.get(f"/v1/files/{file_id}/content")
+    assert r.status_code == 200 and r.content == b"hello world"
+
+    # purpose filter
+    assert len(c.get("/v1/files", params={"purpose": "assistants"}).json()["data"]) == 1
+    assert len(c.get("/v1/files", params={"purpose": "other"}).json()["data"]) == 0
+
+    # create assistants
+    r = c.post("/v1/assistants", json={"model": "tiny", "name": "helper",
+                                       "instructions": "be brief"})
+    assert r.status_code == 200, r.text
+    asst = r.json()
+    assert asst["object"] == "assistant" and asst["model"] == "tiny"
+    c.post("/v1/assistants", json={"model": "tiny", "name": "second"})
+
+    # model required
+    assert c.post("/v1/assistants", json={"name": "x"}).status_code == 400
+
+    # list with limit/order
+    items = c.get("/v1/assistants", params={"limit": 1, "order": "asc"}).json()
+    assert len(items) == 1
+
+    # get + modify
+    got = c.get(f"/v1/assistants/{asst['id']}").json()
+    assert got["name"] == "helper"
+    r = c.post(f"/v1/assistants/{asst['id']}", json={"name": "renamed"})
+    assert r.json()["name"] == "renamed"
+
+    # attach the file
+    r = c.post(f"/v1/assistants/{asst['id']}/files", json={"file_id": file_id})
+    assert r.status_code == 200, r.text
+    af = r.json()
+    assert af["assistant_id"] == asst["id"]
+    listed = c.get(f"/v1/assistants/{asst['id']}/files").json()["data"]
+    assert len(listed) == 1
+    assert c.get(f"/v1/assistants/{asst['id']}").json()["file_ids"] == [file_id]
+
+    # attach unknown file -> 404
+    r = c.post(f"/v1/assistants/{asst['id']}/files", json={"file_id": "nope"})
+    assert r.status_code == 404
+
+    # persistence: a new app instance over the same dir reloads everything
+    base2, _ = _boot(tmp_path)
+    c2 = httpx.Client(base_url=base2, timeout=30)
+    names = {a["name"] for a in c2.get("/v1/assistants").json()}
+    assert "renamed" in names and "second" in names
+    assert len(c2.get("/v1/files").json()["data"]) == 1
+
+    # detach + deletes
+    r = c.delete(f"/v1/assistants/{asst['id']}/files/{af['id']}")
+    assert r.json()["deleted"] is True
+    r = c.delete(f"/v1/files/{file_id}")
+    assert r.json()["deleted"] is True
+    assert c.get(f"/v1/files/{file_id}").status_code == 404
+    r = c.delete(f"/v1/assistants/{asst['id']}")
+    assert r.json()["deleted"] is True
+    assert c.get(f"/v1/assistants/{asst['id']}").status_code == 404

@@ -1,0 +1,44 @@
+"""WebUI pages (reference: core/http/routes/ui.go:88-413 + views/)."""
+
+import httpx
+
+from localai_tpu.api.app import build_app
+from localai_tpu.capabilities import Capabilities
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.modelmgr.loader import ModelLoader
+
+from tests.test_assistants import _boot
+
+
+def test_webui_pages_render(tmp_path):
+    base, _ = _boot(tmp_path)
+    c = httpx.Client(base_url=base, timeout=30)
+    for path, marker in (
+        ("/", "Installed models"),
+        ("/browse", "Model gallery"),
+        ("/chat", "Chat"),
+        ("/text2image", "Text to image"),
+        ("/tts-ui", "Text to speech"),
+        ("/p2p-ui", "Device mesh"),
+    ):
+        r = c.get(path)
+        assert r.status_code == 200, (path, r.text[:200])
+        assert r.headers["content-type"].startswith("text/html")
+        assert marker in r.text
+    # the model list renders configured models
+    assert "tiny" in c.get("/").text
+
+
+def test_disable_webui(tmp_path):
+    app_config = AppConfig(models_path=str(tmp_path), address="127.0.0.1:0",
+                           disable_webui=True)
+    caps = Capabilities(app_config, ModelLoader(),
+                        {"tiny": ModelConfig(name="tiny", backend="fake",
+                                             model="t")})
+    app = build_app(caps, app_config)
+    routes = {r.resource.canonical for r in app.router.routes()
+              if r.resource is not None}
+    assert "/" not in routes
+    assert "/chat" not in routes
+    assert "/v1/chat/completions" in routes  # API stays on
