@@ -176,7 +176,7 @@ def bench_kernel(cfg, S, C, steps, inner):
         def body(carry, _):
             tokens, lengths, ck, cv, ring, rpos, keys = carry
             logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
-            ids, _, keys = sampling.sample(logits, slot_params, ring, rpos, bias, keys)
+            ids, _, keys, _ = sampling.sample(logits, slot_params, ring, rpos, bias, keys)
             ring, rpos = sampling.update_ring(ring, rpos, ids, active)
             return (ids, lengths + 1, ck, cv, ring, rpos, keys), ids
 

@@ -43,6 +43,9 @@ def _sampling_from_predict(opts: pb.PredictOptions):
                        else -1 if opts.repeat_last_n < 0 else 64),
         presence_penalty=opts.presence_penalty,
         frequency_penalty=opts.frequency_penalty,
+        mirostat=opts.mirostat,
+        mirostat_tau=opts.mirostat_tau or 5.0,
+        mirostat_eta=opts.mirostat_eta or 0.1,
         seed=opts.seed if opts.seed != 0 else -1,
         logit_bias={int(k): float(v) for k, v in opts.logit_bias.items()},
     )

@@ -63,6 +63,21 @@ def _add_simple(sub):
     ui = usub.add_parser("model-info")
     ui.add_argument("path")
 
+    sg = sub.add_parser("sound-generation", help="one-shot sound generation")
+    sg.add_argument("text")
+    sg.add_argument("--model", required=True)
+    sg.add_argument("--duration", type=float, default=None)
+    sg.add_argument("--output", default="out.wav")
+    sg.add_argument("--models-path", default="models")
+
+    f = sub.add_parser("federated",
+                       help="request-level load balancer over N instances")
+    f.add_argument("--address", default="127.0.0.1:8080")
+    f.add_argument("--workers", required=True,
+                   help="comma-separated base URLs (http://host:port)")
+    f.add_argument("--load-balancing-strategy", default="random",
+                   choices=["random", "least_number_of_requests"])
+
 
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="localai-tpu")
@@ -162,6 +177,34 @@ def main(argv=None):
 
         while True:
             time.sleep(60)
+
+    if args.cmd == "sound-generation":
+        from localai_tpu.capabilities import Capabilities
+        from localai_tpu.config.app_config import AppConfig
+        from localai_tpu.config.model_config import scan_models_dir
+        from localai_tpu.modelmgr.loader import ModelLoader
+
+        app = AppConfig.from_env(models_path=args.models_path)
+        loader = ModelLoader()
+        caps = Capabilities(app, loader, scan_models_dir(args.models_path))
+        try:
+            caps.sound_generation(caps.resolve(args.model), args.text,
+                                  args.output, duration=args.duration)
+            print(args.output)
+        finally:
+            loader.stop_all()
+        return 0
+
+    if args.cmd == "federated":
+        from localai_tpu.federation import serve as fed_serve
+
+        workers = [w.strip() for w in args.workers.split(",") if w.strip()]
+        try:
+            asyncio.run(fed_serve(workers, args.address,
+                                  args.load_balancing_strategy))
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     if args.cmd == "util":
         if args.util_cmd == "model-info":

@@ -235,6 +235,11 @@ def scan_models_dir(models_path: str) -> dict:
             problems = mc.validate()
             if problems:
                 raise ValueError("; ".join(problems))
+            # fill missing chat templates/stopwords from the checkpoint
+            # family (reference: guessDefaultsFromFile, guesser.go:145)
+            from localai_tpu.config.guesser import guess_defaults
+
+            guess_defaults(mc, models_path)
             configs[mc.name] = mc
         except Exception as e:  # mirror reference: log and skip broken configs
             import logging

@@ -1,0 +1,92 @@
+"""Dynamic config hot-reload: api_keys.json + external_backends.json.
+
+Capability parity with the reference's config file watcher (reference:
+core/startup/config_file_watcher.go:29-43 registers handlers for
+api_keys.json [JSON list of keys, appended to the startup keys,
+:130-152] and external_backends.json [JSON map name -> backend target,
+merged over the startup set, :157-180], re-applied on write/create/
+remove). The reference uses fsnotify with a polling fallback; a polling
+thread is the portable equivalent here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+log = logging.getLogger(__name__)
+
+WATCHED = ("api_keys.json", "external_backends.json")
+
+
+class ConfigWatcher:
+    """Polls a dynamic-config dir and applies updates in place.
+
+    api_keys: the live list object used by the auth middleware is mutated
+    in place (the middleware holds a reference, so updates apply to the
+    next request without restarting the server).
+    """
+
+    def __init__(self, app_config, loader, interval_s: float = 1.0):
+        self.app_config = app_config
+        self.loader = loader
+        self.interval_s = interval_s
+        self._startup_keys = list(app_config.api_keys)
+        self._mtimes: dict = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if not self.app_config.dynamic_config_dir:
+            return self
+        self.poll_once()  # apply any existing files at boot
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="config-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("dynamic config poll failed")
+
+    def poll_once(self):
+        d = self.app_config.dynamic_config_dir
+        for name in WATCHED:
+            path = os.path.join(d, name)
+            try:
+                mtime = os.stat(path).st_mtime_ns
+            except OSError:
+                mtime = None  # removed -> revert to startup values
+            if self._mtimes.get(name, "unset") == mtime:
+                continue
+            self._mtimes[name] = mtime
+            self._apply(name, path if mtime is not None else None)
+
+    def _apply(self, name: str, path):
+        content = None
+        if path is not None:
+            try:
+                with open(path) as f:
+                    content = json.load(f)
+            except Exception:
+                log.exception("invalid dynamic config file: %s", name)
+                return
+        if name == "api_keys.json":
+            keys = self._startup_keys + (content or [])
+            # in-place: the auth middleware closes over this list object
+            self.app_config.api_keys[:] = keys
+            log.info("api keys reloaded (%d total)", len(keys))
+        elif name == "external_backends.json":
+            for backend, target in (content or {}).items():
+                self.loader.register_external(backend, target)
+            log.info("external backends reloaded (%d)", len(content or {}))

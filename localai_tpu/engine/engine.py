@@ -102,14 +102,15 @@ class StreamEvent:
 
 class _Burst:
     """A dispatched decode burst awaiting host processing."""
-    __slots__ = ("n_steps", "slots", "ids_all", "lps_all", "ids_np", "lps_np",
-                 "folded")
+    __slots__ = ("n_steps", "slots", "ids_all", "lps_all", "mu_out", "ids_np",
+                 "lps_np", "folded")
 
-    def __init__(self, n_steps, slots, ids_all, lps_all):
+    def __init__(self, n_steps, slots, ids_all, lps_all, mu_out):
         self.n_steps = n_steps
         self.slots = slots          # [(index, _Slot snapshot), ...]
         self.ids_all = ids_all      # device [K, S]
         self.lps_all = lps_all
+        self.mu_out = mu_out        # device [S] mirostat state after the burst
         self.ids_np = None
         self.lps_np = None
         self.folded = False
@@ -183,6 +184,7 @@ class Engine:
         )
         self.slot_params = sampling.make_slot_params(S)
         self.ring, self.ring_pos = sampling.make_ring(S)
+        self.mu = sampling.make_mu(S)
         self.lengths = np.zeros((S,), np.int32)
         self.cur_tokens = np.zeros((S,), np.int32)
         self.active_dev = np.zeros((S,), np.bool_)
@@ -281,7 +283,7 @@ class Engine:
     # ---------- jitted step bodies ----------
 
     def _decode_burst_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
-                           bias, keys, slot_params, active, n_steps: int):
+                           bias, keys, slot_params, active, mu, n_steps: int):
         """n_steps decode+sample steps in ONE dispatch (lax.scan).
 
         Per-dispatch overhead on the serving chip is comparable to one step's
@@ -292,7 +294,7 @@ class Engine:
         C = self.ecfg.max_context
 
         def step(carry, _):
-            tokens, ck, cv, lengths, ring, ring_pos, keys = carry
+            tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
             # inactive slots (free / mid-prefill) must NOT write KV: force
             # their write position to C so the scatter's mode="drop" discards
             # it — otherwise every decode step would clobber row 0 of slots
@@ -300,24 +302,26 @@ class Engine:
             write_lengths = jnp.where(active, lengths, C)
             logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
                                                write_lengths, ck, cv)
-            ids, logprobs, new_keys = sampling.sample(logits, slot_params, ring,
-                                                      ring_pos, bias, keys)
-            # only active slots consume RNG state; a prefilling slot's seeded
-            # key must not advance with other slots' decode steps
+            ids, logprobs, new_keys, new_mu = sampling.sample(
+                logits, slot_params, ring, ring_pos, bias, keys, mu)
+            # only active slots consume RNG/mirostat state; a prefilling
+            # slot's seeded state must not advance with others' decode steps
             keys = jnp.where(active[:, None], new_keys, keys)
+            mu = jnp.where(active, new_mu, mu)
             ring, ring_pos = sampling.update_ring(ring, ring_pos, ids, active)
             lengths = lengths + active.astype(jnp.int32)
             tokens = jnp.where(active, ids, tokens)
-            return (tokens, ck, cv, lengths, ring, ring_pos, keys), (ids, logprobs)
+            return (tokens, ck, cv, lengths, ring, ring_pos, keys, mu), (ids, logprobs)
 
-        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys)
+        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
         carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None, length=n_steps)
-        tokens, ck, cv, lengths, ring, ring_pos, keys = carry
-        # tokens/lengths/ring are returned as DEVICE handles so the next
+        tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
+        # tokens/lengths/ring/mu are returned as DEVICE handles so the next
         # burst can chain off them without a host round-trip (pipelined
         # decode); the host separately mirrors the same evolution from the
         # emitted ids for use whenever admissions/releases reset slot state
-        return ids_all, lps_all, ck, cv, keys, (tokens, lengths, ring, ring_pos)
+        # (mu is device-only knowledge: it is folded back from this output)
+        return ids_all, lps_all, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
 
     def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
                             mm_pos=None, mm_vec=None):
@@ -329,8 +333,8 @@ class Engine:
         return ck, cv
 
     def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
-                            ring, ring_pos, bias, keys, slot_params, continued: bool,
-                            mm_pos=None, mm_vec=None):
+                            ring, ring_pos, bias, keys, slot_params, mu,
+                            continued: bool, mm_pos=None, mm_vec=None):
         """Final chunk for a BATCH of B prompts: write KV, sample each one's
         first output token. slot may contain duplicate entries (batch
         padding repeats the last prompt; duplicate KV writes and key
@@ -344,10 +348,12 @@ class Engine:
         key_rows = jnp.take(keys, slot, axis=0)
         ring_rows = jnp.take(jnp.asarray(ring), slot, axis=0)
         rpos_rows = jnp.take(jnp.asarray(ring_pos), slot, axis=0)
-        ids, logprobs, new_keys = sampling.sample(logits, sp_rows, ring_rows,
-                                                  rpos_rows, bias_rows, key_rows)
+        mu_rows = jnp.take(jnp.asarray(mu), slot, axis=0)
+        ids, logprobs, new_keys, new_mu = sampling.sample(
+            logits, sp_rows, ring_rows, rpos_rows, bias_rows, key_rows, mu_rows)
         keys = keys.at[slot].set(new_keys)
-        return ids, logprobs, ck, cv, keys
+        mu = jnp.asarray(mu).at[slot].set(new_mu)
+        return ids, logprobs, ck, cv, keys, mu
 
     def _get_burst_fn(self, n_steps: int):
         fn = self._burst_fns.get(n_steps)
@@ -391,8 +397,8 @@ class Engine:
         fn = self._final_fns.get(key)
         if fn is None:
             fn = jax.jit(
-                lambda *a: self._prefill_final_body(*a[:12], continued=continued,
-                                                    mm_pos=a[12], mm_vec=a[13]),
+                lambda *a: self._prefill_final_body(*a[:13], continued=continued,
+                                                    mm_pos=a[13], mm_vec=a[14]),
                 donate_argnums=(3, 4, 10))
             self._final_fns[key] = fn
         return fn
@@ -422,7 +428,7 @@ class Engine:
             _, _, self.ck, self.cv, self.rng_keys, _ = fn(
                 self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
                 self.ring, self.ring_pos, self.bias, self.rng_keys,
-                self.slot_params, self.active_dev)
+                self.slot_params, self.active_dev, self.mu)
         for bucket in self._buckets:
             one = np.ones((1,), np.int32)
             zero = np.zeros((1,), np.int32)
@@ -441,10 +447,10 @@ class Engine:
                     sb = np.ones((batch,), np.int32)
                     slotb = startb = np.zeros((batch,), np.int32)
                 fn = self._get_final_fn(bucket, batch, continued)
-                _, _, self.ck, self.cv, self.rng_keys = fn(
+                _, _, self.ck, self.cv, self.rng_keys, _ = fn(
                     self.params, tb, sb, self.ck, self.cv, slotb, startb,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
-                    self.slot_params)
+                    self.slot_params, self.mu)
         jax.block_until_ready(self.ck)
 
     def start(self, precompile: bool = False):
@@ -489,6 +495,7 @@ class Engine:
         self.active_dev = np.zeros((S,), np.bool_)
         self._bias_dirty = np.zeros((S,), np.bool_)
         self.slot_params = sampling.make_slot_params(S)
+        self.mu = sampling.make_mu(S)
         self._shard_state()
         self._cache_tokens = [[] for _ in range(S)]
         self._prefill_queue = []
@@ -766,6 +773,9 @@ class Engine:
 
         # install sampling state for the slot
         self.slot_params = sampling.set_slot(self.slot_params, slot, req.params)
+        # mirostat v2 initializes mu at 2*tau (llama.cpp semantics)
+        tau = req.params.mirostat_tau if req.params.mirostat_tau > 0 else 5.0
+        self.mu[slot] = 2.0 * tau
         self.rng_keys = sampling.seed_slot_key(
             self.rng_keys, slot, req.params, fallback_seed=hash(req.request_id) & 0x7FFFFFFF
         )
@@ -903,14 +913,14 @@ class Engine:
         # _decode_once (in-flight dispatches must not see host mutations)
         args = (self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
                 self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
-                jax.tree.map(np.array, self.slot_params))
+                jax.tree.map(np.array, self.slot_params), self.mu.copy())
         if s.mm_pos is not None:
             fn = self._get_mm_final_fn(bucket, len(s.mm_pos), continued)
             args = args + (mm_rel(s.mm_pos, start_v[0], take, bucket),
                            s.mm_vec[None])
         else:
             fn = self._get_final_fn(bucket, B, continued)
-        out_ids, logprobs, self.ck, self.cv, self.rng_keys = fn(*args)
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
         # ASYNC: don't sync here — the result would be serialized behind any
         # in-flight decode burst, idling the device. The group's slots stay
         # in "prefill" phase (and out of decode bursts) until the sampled
@@ -925,7 +935,7 @@ class Engine:
                 self._prefill_queue.remove(gslot)
         self._pending_prefill = (
             [(gslot, self.slots[gslot]) for gslot, _ in group],
-            out_ids, logprobs, t0)
+            out_ids, logprobs, mu_out, t0)
         return True
 
     def _maybe_finalize_prefill(self, block: bool = False) -> bool:
@@ -934,12 +944,13 @@ class Engine:
         pp = self._pending_prefill
         if pp is None:
             return False
-        group, out_ids, logprobs, t0 = pp
+        group, out_ids, logprobs, mu_out, t0 = pp
         if not block and not out_ids.is_ready():
             return False
         self._pending_prefill = None
         ids_np = np.asarray(out_ids)
         lps_np = np.asarray(logprobs)
+        self.mu = np.asarray(mu_out).copy()
         t1 = time.monotonic()
 
         for b, (gslot, snap) in enumerate(group):
@@ -1024,12 +1035,13 @@ class Engine:
             # the live mirror arrays would see later in-place host mutations
             # (admission/finalize/release) and e.g. decode an activating
             # slot with lengths still 0, clobbering its prefilled KV rows
-            tokens, lengths, ring, rpos = (self.cur_tokens.copy(),
-                                           self.lengths.copy(),
-                                           self.ring.copy(),
-                                           self.ring_pos.copy())
+            tokens, lengths, ring, rpos, mu = (self.cur_tokens.copy(),
+                                               self.lengths.copy(),
+                                               self.ring.copy(),
+                                               self.ring_pos.copy(),
+                                               self.mu.copy())
         else:
-            tokens, lengths, ring, rpos = self._chain
+            tokens, lengths, ring, rpos, mu = self._chain
         # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
         # released and re-admitted while this burst is in flight, and the
         # new occupant must never receive the stale burst's tokens
@@ -1039,11 +1051,12 @@ class Engine:
             self.params, tokens, self.ck, self.cv, lengths,
             ring, rpos, self.bias, self.rng_keys,
             jax.tree.map(np.array, self.slot_params),
-            self.active_dev.copy(),
+            self.active_dev.copy(), mu,
         )
         self._chain_dirty = False
         prev, self._inflight = self._inflight, _Burst(n_steps, burst_slots,
-                                                      ids_all, lps_all)
+                                                      ids_all, lps_all,
+                                                      self._chain[4])
         if prev is not None:
             self._process_burst(prev)
         if grammar_sync:
@@ -1061,7 +1074,10 @@ class Engine:
             return
         b.ids_np = np.asarray(b.ids_all)    # [K, S]
         b.lps_np = np.asarray(b.lps_all)
+        mu_np = np.asarray(b.mu_out)
         live_idx = [i for i, snap in b.slots if self._live(i, snap)]
+        for i in live_idx:
+            self.mu[i] = mu_np[i]
         for i in live_idx:
             self.cur_tokens[i] = b.ids_np[-1, i]
             self.lengths[i] += b.n_steps

@@ -44,6 +44,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 SORT_K = 256  # candidate window (cap for TopK)
 RING_N = 256  # penalty ring capacity (cap for repeat_last_n)
@@ -61,6 +62,9 @@ class SamplingParamsHost:
     repeat_last_n: int = 64           # penalty window (llama.cpp default)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    mirostat: int = 0                 # 0=off; 1/2 run the v2 sampler
+    mirostat_tau: float = 5.0         # target surprise (bits)
+    mirostat_eta: float = 0.1         # mu learning rate
     seed: int = -1
     logit_bias: dict = dataclasses.field(default_factory=dict)  # token_id -> bias
 
@@ -86,6 +90,9 @@ def make_slot_params(num_slots: int):
         "repeat_last_n": np.full((S,), 64, np.int32),
         "presence_penalty": np.zeros((S,), np.float32),
         "frequency_penalty": np.zeros((S,), np.float32),
+        "mirostat": np.zeros((S,), np.int32),
+        "mirostat_tau": np.full((S,), 5.0, np.float32),
+        "mirostat_eta": np.full((S,), 0.1, np.float32),
         "greedy": np.ones((S,), np.bool_),
     }
 
@@ -105,8 +112,18 @@ def set_slot(slot_params, slot: int, p: SamplingParamsHost):
     sp["repeat_last_n"][slot] = RING_N if n < 0 else min(n, RING_N)
     sp["presence_penalty"][slot] = p.presence_penalty
     sp["frequency_penalty"][slot] = p.frequency_penalty
+    sp["mirostat"][slot] = p.mirostat or 0
+    sp["mirostat_tau"][slot] = p.mirostat_tau if p.mirostat_tau > 0 else 5.0
+    sp["mirostat_eta"][slot] = p.mirostat_eta if p.mirostat_eta > 0 else 0.1
     sp["greedy"][slot] = p.temperature <= 0
     return sp
+
+
+def make_mu(num_slots: int):
+    """Per-slot mirostat mu state (init 2*tau at admission; host numpy)."""
+    import numpy as np
+
+    return np.full((num_slots,), 10.0, np.float32)
 
 
 def seed_slot_key(rng_keys, slot: int, p: SamplingParamsHost, fallback_seed: int):
@@ -198,12 +215,18 @@ def _window_counts(ring, pos, idx, repeat_last_n):
     return jnp.sum(match & in_window[:, None, :], axis=-1).astype(jnp.int32)
 
 
-def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys):
+def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
     """Sample one token per slot.
 
     logits: [S, V] fp32; ring/ring_pos: penalty state from make_ring;
-    logit_bias: [S, V] fp32; rng_keys: [S, 2] uint32 (per-slot PRNG data).
-    Returns (token_ids [S] int32, logprobs [S] fp32, new_rng_keys).
+    logit_bias: [S, V] fp32; rng_keys: [S, 2] uint32 (per-slot PRNG data);
+    mu: [S] fp32 mirostat state (None = mirostat disabled everywhere).
+    Returns (token_ids [S] int32, logprobs [S] fp32, new_rng_keys, new_mu).
+
+    Mirostat (llama.cpp mirostat v2 semantics, sample_token_mirostat_v2:
+    truncate candidates whose surprise exceeds mu, sample, then
+    mu -= eta * (observed_surprise - tau)) replaces the top-k/p/min-p
+    chain for slots with slot_params["mirostat"] > 0.
     """
     S, V = logits.shape
     k = min(SORT_K, V)
@@ -258,7 +281,17 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys):
     # progress by always keeping the highest-probability candidate
     keep = keep | (rank == 0)
 
-    masked = jnp.where(keep, logp, -jnp.inf)
+    # mirostat v2: replace the keep-chain with the surprise-<=-mu cut over
+    # the full-window distribution (softmax of scaled, no top-k mask)
+    miro_on = slot_params["mirostat"][:, None] > 0
+    if mu is not None:
+        full_logp = jax.nn.log_softmax(scaled, axis=-1)
+        surprise = -full_logp / jnp.float32(np.log(2.0))          # bits
+        keep_miro = (surprise <= jnp.asarray(mu)[:, None]) | (rank == 0)
+        keep = jnp.where(miro_on, keep_miro, keep)
+        masked = jnp.where(keep, jnp.where(miro_on, full_logp, logp), -jnp.inf)
+    else:
+        masked = jnp.where(keep, logp, -jnp.inf)
 
     def sample_one(key_data, logits_row):
         key = jax.random.wrap_key_data(key_data)
@@ -270,10 +303,23 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys):
     sampled_ids = jnp.take_along_axis(idx, choices[:, None], axis=-1)[:, 0]
 
     ids = jnp.where(slot_params["greedy"], greedy_ids, sampled_ids).astype(jnp.int32)
+
+    if mu is not None:
+        # observed surprise under the truncated+renormalized distribution
+        lse = jax.nn.logsumexp(masked, axis=-1, keepdims=True)
+        chosen_lp = jnp.take_along_axis(masked - lse, choices[:, None], axis=-1)[:, 0]
+        obs = -chosen_lp / jnp.float32(np.log(2.0))
+        new_mu = jnp.asarray(mu) - slot_params["mirostat_eta"] * (
+            obs - slot_params["mirostat_tau"])
+        new_mu = jnp.where(miro_on[:, 0] & ~jnp.asarray(slot_params["greedy"]),
+                           new_mu, jnp.asarray(mu))
+    else:
+        new_mu = None
+
     # logprob of the chosen token under the post-penalty, pre-temperature
     # window distribution (window-normalized; see module docstring)
     win_logp = jax.nn.log_softmax(vals, axis=-1)
     chosen_rank = jnp.where(slot_params["greedy"][:, None],
                             jnp.zeros_like(choices[:, None]), choices[:, None])
     logprobs = jnp.take_along_axis(win_logp, chosen_rank, axis=-1)[:, 0]
-    return ids, logprobs, new_keys
+    return ids, logprobs, new_keys, new_mu

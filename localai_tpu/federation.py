@@ -1,0 +1,107 @@
+"""Federation: request-level load balancing across full serving instances.
+
+Capability parity with the reference's federated server (reference:
+core/p2p/federated_server.go:36-105 + federated.go:39-99 — a thin proxy
+in front of N LocalAI instances choosing a worker per request, randomly
+or by least in-flight load, skipping offline workers). The reference
+discovers workers over its libp2p VPN; the TPU design replaces discovery
+with an explicit worker list (pod addresses are static and declarative —
+SURVEY §2.4: "front-door LB over N model servers / pods (DCN)").
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+log = logging.getLogger("localai_tpu.federation")
+
+HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
+               "keep-alive", "te", "upgrade"}
+
+
+class Worker:
+    def __init__(self, base: str):
+        self.base = base.rstrip("/")
+        self.inflight = 0
+        self.failed_at = 0.0
+
+    def online(self, cooldown_s: float = 10.0) -> bool:
+        return (time.monotonic() - self.failed_at) > cooldown_s
+
+
+class FederatedServer:
+    """Reverse proxy with random / least-used worker selection."""
+
+    def __init__(self, workers: list, strategy: str = "random",
+                 timeout_s: float = 600.0):
+        if not workers:
+            raise ValueError("federation needs at least one worker")
+        self.workers = [Worker(w) for w in workers]
+        self.strategy = strategy
+        self.timeout_s = timeout_s
+
+    def pick(self):
+        candidates = [w for w in self.workers if w.online()] or self.workers
+        if self.strategy in ("least_number_of_requests", "least_used"):
+            return min(candidates, key=lambda w: w.inflight)
+        return random.choice(candidates)
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        worker = self.pick()
+        url = f"{worker.base}{request.path_qs}"
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in HOP_HEADERS}
+        body = await request.read()
+        worker.inflight += 1
+        try:
+            async with ClientSession(
+                timeout=ClientTimeout(total=self.timeout_s)
+            ) as session:
+                async with session.request(request.method, url, data=body,
+                                           headers=headers) as upstream:
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_HEADERS:
+                            resp.headers[k] = v
+                    await resp.prepare(request)
+                    # stream chunks through (SSE token streams stay live)
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
+        except Exception as e:
+            worker.failed_at = time.monotonic()
+            log.warning("worker %s failed: %s", worker.base, e)
+            raise web.HTTPBadGateway(text=f"worker {worker.base} failed: {e}")
+        finally:
+            worker.inflight -= 1
+
+    async def status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "strategy": self.strategy,
+            "workers": [{"base": w.base, "inflight": w.inflight,
+                         "online": w.online()} for w in self.workers],
+        })
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/federation/status", self.status)
+        app.router.add_route("*", "/{path:.*}", self.proxy)
+        return app
+
+
+async def serve(workers: list, address: str, strategy: str = "random"):
+    from localai_tpu.api.app import run_app
+
+    server = FederatedServer(workers, strategy)
+    await run_app(server.build_app(), address)
+    log.info("federated front listening on %s -> %d workers",
+             address, len(workers))
+    import asyncio
+
+    while True:
+        await asyncio.sleep(3600)

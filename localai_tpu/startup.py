@@ -61,6 +61,12 @@ def startup(app_config: AppConfig):
 
     gallery_service = GalleryService(app_config, caps)
     gallery_service.start()
+
+    # dynamic config hot-reload (reference: config_file_watcher.go:29-43)
+    if app_config.dynamic_config_dir:
+        from localai_tpu.config.watcher import ConfigWatcher
+
+        ConfigWatcher(app_config, loader).start()
     return caps, loader, gallery_service
 
 

@@ -1,0 +1,156 @@
+"""Config hot-reload, federation LB, and checkpoint-family guesser."""
+
+import asyncio
+import json
+import os
+import threading
+
+import httpx
+import pytest
+
+from localai_tpu.config.app_config import AppConfig
+from localai_tpu.config.guesser import guess_defaults, identify_family
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.config.watcher import ConfigWatcher
+from localai_tpu.modelmgr.loader import ModelLoader
+from localai_tpu.modelmgr.process import free_port
+
+
+# ---------- dynamic config hot-reload ----------
+
+def test_config_watcher_api_keys_and_backends(tmp_path):
+    cfg = AppConfig(models_path=str(tmp_path), dynamic_config_dir=str(tmp_path),
+                    api_keys=["startup-key"])
+    loader = ModelLoader()
+    w = ConfigWatcher(cfg, loader)
+    live_keys = cfg.api_keys  # the middleware closes over this object
+
+    (tmp_path / "api_keys.json").write_text(json.dumps(["hot-key"]))
+    w.poll_once()
+    assert live_keys == ["startup-key", "hot-key"]
+    assert cfg.api_keys is live_keys  # mutated in place
+
+    # removal reverts to startup keys (reference: readApiKeysJson)
+    os.remove(tmp_path / "api_keys.json")
+    w.poll_once()
+    assert live_keys == ["startup-key"]
+
+    (tmp_path / "external_backends.json").write_text(
+        json.dumps({"my-backend": "127.0.0.1:9999"}))
+    w.poll_once()
+    assert loader.external_backends["my-backend"] == "127.0.0.1:9999"
+
+
+# ---------- federation ----------
+
+def _tiny_worker(name, fail=False):
+    from aiohttp import web
+
+    async def handler(request):
+        if fail:
+            raise web.HTTPInternalServerError(text="boom")
+        body = await request.read()
+        return web.json_response({"worker": name, "path": request.path,
+                                  "len": len(body)})
+
+    app = web.Application()
+    app.router.add_route("*", "/{p:.*}", handler)
+    return app
+
+
+def _run_app_bg(app, port):
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        from localai_tpu.api.app import run_app
+
+        async def boot():
+            await run_app(app, f"127.0.0.1:{port}")
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+
+def test_federated_server_balances_and_survives_dead_worker():
+    from localai_tpu.federation import FederatedServer
+
+    p1, p2, pf = free_port(), free_port(), free_port()
+    _run_app_bg(_tiny_worker("w1"), p1)
+    _run_app_bg(_tiny_worker("w2"), p2)
+
+    fed = FederatedServer([f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}",
+                           "http://127.0.0.1:1"],  # dead worker
+                          strategy="random")
+    _run_app_bg(fed.build_app(), pf)
+
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pf}", timeout=30)
+    seen = set()
+    ok = 0
+    for i in range(24):
+        r = c.post("/v1/chat/completions", json={"x": i})
+        if r.status_code == 200:
+            ok += 1
+            seen.add(r.json()["worker"])
+    # the dead worker can eat a few requests before its cooldown marks it
+    # offline; both live workers must have served
+    assert ok >= 16
+    assert seen == {"w1", "w2"}
+
+    st = c.get("/federation/status").json()
+    assert st["strategy"] == "random"
+    assert len(st["workers"]) == 3
+    assert any(not w["online"] for w in st["workers"])
+
+    # least-used: ties resolve deterministically to the first online worker
+    fed2 = FederatedServer([f"http://127.0.0.1:{p1}", f"http://127.0.0.1:{p2}"],
+                           strategy="least_number_of_requests")
+    assert fed2.pick().base == f"http://127.0.0.1:{p1}"
+    fed2.workers[0].inflight = 3
+    assert fed2.pick().base == f"http://127.0.0.1:{p2}"
+
+
+# ---------- guesser ----------
+
+def _ckpt(tmp_path, name, chat_template=None, model_type="llama", extra=None):
+    d = tmp_path / name
+    d.mkdir()
+    cfg = {"model_type": model_type, "vocab_size": 32000}
+    cfg.update(extra or {})
+    (d / "config.json").write_text(json.dumps(cfg))
+    if chat_template:
+        (d / "tokenizer_config.json").write_text(
+            json.dumps({"chat_template": chat_template}))
+    return str(d)
+
+
+def test_identify_family(tmp_path):
+    assert identify_family(_ckpt(tmp_path, "l3",
+                                 "{{ '<|start_header_id|>' }}")) == "llama3"
+    assert identify_family(_ckpt(tmp_path, "qw",
+                                 "<|im_start|>{{ role }}")) == "chatml"
+    assert identify_family(_ckpt(tmp_path, "ge", None,
+                                 model_type="gemma")) == "gemma"
+    assert identify_family(_ckpt(tmp_path, "l3b", None, model_type="llama",
+                                 extra={"vocab_size": 128256})) == "llama3"
+    assert identify_family(_ckpt(tmp_path, "unk", None,
+                                 model_type="rwkv")) is None
+
+
+def test_guess_defaults_fills_templates(tmp_path):
+    d = _ckpt(tmp_path, "m", "<|im_start|>x")
+    mc = ModelConfig(name="m", model=d)
+    assert guess_defaults(mc, str(tmp_path))
+    assert "<|im_start|>" in mc.template.chat_message
+    assert "<|im_end|>" in mc.stopwords
+    # explicit templates are never overwritten
+    mc2 = ModelConfig(name="m", model=d)
+    mc2.template.chat = "custom"
+    mc2.template.chat_message = "custom"
+    assert not guess_defaults(mc2, str(tmp_path))
+    assert mc2.template.chat == "custom"

@@ -20,7 +20,7 @@ def _mk(S=2, V=64):
 def test_greedy_picks_argmax():
     sp, ring, pos, bias, keys = _mk()
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[1, 13].set(5.0)
-    ids, logprobs, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    ids, logprobs, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert list(np.asarray(ids)) == [7, 13]
     assert np.all(np.asarray(logprobs) <= 0)
 
@@ -35,7 +35,7 @@ def test_top_k_restricts_support():
         keys2 = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial * 100)
         )
-        ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
+        ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
         seen.update(np.asarray(ids).tolist())
     assert seen <= {3, 9}
 
@@ -48,7 +48,7 @@ def test_top_p_keeps_head():
         keys2 = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32) + trial)
         )
-        ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
+        ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys2)
         assert int(np.asarray(ids)[0]) == 5
 
 
@@ -57,7 +57,7 @@ def test_repeat_penalty_suppresses_seen_tokens():
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, repeat_penalty=100.0))
     ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 7, 7])
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
-    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 8  # 7 heavily penalized
 
 
@@ -66,7 +66,7 @@ def test_frequency_penalty():
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=0.0, frequency_penalty=2.0))
     ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 7, 7])  # 5.0 - 6.0 < 4.0
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
-    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 8
 
 
@@ -78,7 +78,7 @@ def test_penalty_window_expires():
     # token 7 seen long ago, then two other tokens push it out of the window
     ring, pos = sampling.set_slot_ring(ring, pos, 0, [7, 1, 2])
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0).at[0, 8].set(4.0)
-    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 7  # 7 outside window: unpenalized
 
 
@@ -99,7 +99,7 @@ def test_logit_bias():
     sp, ring, pos, bias, keys = _mk()
     bias = bias.at[0, 42].set(100.0)
     logits = jnp.zeros((2, 64), jnp.float32).at[0, 7].set(5.0)
-    ids, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    ids, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert int(np.asarray(ids)[0]) == 42
 
 
@@ -107,6 +107,24 @@ def test_deterministic_seed():
     sp, ring, pos, bias, keys = _mk()
     sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(temperature=1.5, top_k=0, top_p=1.0))
     logits = jax.random.normal(jax.random.PRNGKey(0), (2, 64)) * 3
-    a, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
-    b, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    a, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
+    b, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys)
     assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mirostat_v2_adapts_mu():
+    sp, ring, pos, bias, keys = _mk()
+    sp = sampling.set_slot(sp, 0, sampling.SamplingParamsHost(
+        temperature=1.0, mirostat=2, mirostat_tau=3.0, mirostat_eta=0.2))
+    mu = sampling.make_mu(2)
+    mu[0] = 6.0
+    logits = jax.random.normal(jax.random.PRNGKey(3), (2, 64)) * 2
+    ids, _, _, new_mu = sampling.sample(logits, sp, ring, pos, bias, keys, mu)
+    new_mu = np.asarray(new_mu)
+    assert 0 <= int(ids[0]) < 64
+    assert new_mu[0] != 6.0          # mu moved toward tau for the miro slot
+    assert new_mu[1] == mu[1]        # non-mirostat slot untouched
+    # a tiny mu forces the argmax candidate (only rank-0 survives the cut)
+    mu[0] = 1e-6
+    ids2, _, _, _ = sampling.sample(logits, sp, ring, pos, bias, keys, mu)
+    assert int(ids2[0]) == int(np.argmax(np.asarray(logits)[0]))
