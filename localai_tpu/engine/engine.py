@@ -554,19 +554,29 @@ class Engine:
     # ---------- grammar-constrained decoding ----------
 
     def _grammar_for(self, text: str):
-        """Compile (cached) + lazily build the vocab mask builder."""
+        """Compile (cached) + lazily build the vocab mask builder.
+
+        Prefers the native C++ runtime (runtime/grammar.cc via
+        functions/grammars/native.py) — a cold mask walk over a 32k vocab
+        costs hundreds of ms in the python automaton vs ~ms native; the
+        python path remains the fallback (and the semantic reference)."""
+        from localai_tpu.functions.grammars import native
         from localai_tpu.functions.grammars.automaton import (
             Grammar, TokenMaskBuilder, token_strings)
 
+        use_native = native.get_lib() is not None
         if self._mask_builder is None:
             self._token_strs = token_strings(self.tokenizer)
-            self._mask_builder = TokenMaskBuilder(
+            builder_cls = (native.NativeMaskBuilder if use_native
+                           else TokenMaskBuilder)
+            self._mask_builder = builder_cls(
                 self._token_strs, self.eos_ids, self.cfg.vocab_size)
         g = self._grammar_cache.get(text)
         if g is None:
             if len(self._grammar_cache) > 64:
                 self._grammar_cache.clear()
-            g = Grammar.from_text(text)
+            cls = native.NativeGrammar if use_native else Grammar
+            g = cls.from_text(text)
             self._grammar_cache[text] = g
         return g
 

@@ -1,0 +1,191 @@
+"""ctypes bindings for the native grammar runtime (runtime/grammar.cc).
+
+The C++ automaton is the production path for constrained decoding at real
+vocab sizes (a cold [32k]-vocab mask walk in pure Python costs hundreds
+of ms; the C++ walk is ~ms) — role parity with llama.cpp's in-C++ grammar
+sampler (reference: grpc-server.cpp:688,1977). The shared library is
+compiled on demand with g++ into a user cache dir and loaded via ctypes
+(no pybind11 in this environment); automaton.py remains the semantic
+reference and the fallback when no compiler is available.
+
+Interface parity with automaton.py: NativeGrammar states are opaque ints
+(instead of frozensets) and NativeMaskBuilder.penalty_row memoizes rows
+per state so the engine's identity-compare fast path keeps working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterable, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "..", "runtime",
+                    "grammar.cc")
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _build_and_load():
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        raise FileNotFoundError(src)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get("LOCALAI_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "localai_tpu", "native")
+    os.makedirs(cache, exist_ok=True)
+    so = os.path.join(cache, f"libgrammar-{digest}.so")
+    if not os.path.exists(so):
+        tmp = so + ".tmp"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, so)
+        log.info("built native grammar runtime: %s", so)
+    lib = ctypes.CDLL(so)
+    lib.ga_grammar_new.restype = ctypes.c_void_p
+    lib.ga_grammar_new.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.ga_grammar_free.argtypes = [ctypes.c_void_p]
+    lib.ga_initial.restype = ctypes.c_int
+    lib.ga_initial.argtypes = [ctypes.c_void_p]
+    lib.ga_advance.restype = ctypes.c_int
+    lib.ga_advance.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                               ctypes.c_char_p, ctypes.c_size_t]
+    lib.ga_accepting.restype = ctypes.c_int
+    lib.ga_accepting.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ga_mask_builder_new.restype = ctypes.c_void_p
+    lib.ga_mask_builder_new.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t, ctypes.c_int32]
+    lib.ga_mask_builder_free.argtypes = [ctypes.c_void_p]
+    lib.ga_penalty_row.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_float)]
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None (no compiler / disabled)."""
+    global _lib, _lib_failed
+    if os.environ.get("LOCALAI_NATIVE_GRAMMAR", "1") == "0":
+        return None
+    with _lib_lock:
+        if _lib is None and not _lib_failed:
+            try:
+                _lib = _build_and_load()
+            except Exception:
+                _lib_failed = True
+                log.warning("native grammar runtime unavailable; using the "
+                            "python automaton", exc_info=True)
+        return _lib
+
+
+def serialize_rules(rules, root_id: int) -> bytes:
+    """Pack parse_gbnf output into the grammar.cc binary layout."""
+    out = [struct.pack("<II", len(rules), root_id)]
+    for rule in rules:
+        out.append(struct.pack("<I", len(rule)))
+        for alt in rule:
+            out.append(struct.pack("<I", len(alt)))
+            for elem in alt:
+                if elem[0] == "c":
+                    _, ranges, negated = elem
+                    out.append(struct.pack("<BBI", 0, 1 if negated else 0,
+                                           len(ranges)))
+                    for lo, hi in ranges:
+                        out.append(struct.pack("<II", lo, hi))
+                else:
+                    out.append(struct.pack("<BI", 1, elem[1]))
+    return b"".join(out)
+
+
+class NativeGrammar:
+    """Opaque-state counterpart of automaton.Grammar."""
+
+    def __init__(self, rules, root_id: int, lib):
+        self._lib = lib
+        blob = serialize_rules(rules, root_id)
+        self._handle = lib.ga_grammar_new(blob, len(blob))
+
+    @staticmethod
+    def from_text(text: str) -> "NativeGrammar":
+        from localai_tpu.functions.grammars.gbnf import parse_gbnf
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native grammar runtime unavailable")
+        rules, root = parse_gbnf(text)
+        return NativeGrammar(rules, root, lib)
+
+    def __del__(self):
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.ga_grammar_free(handle)
+
+    def initial_state(self) -> int:
+        return self._lib.ga_initial(self._handle)
+
+    def advance_string(self, state: int, s: str) -> Optional[int]:
+        b = s.encode("utf-8")
+        nxt = self._lib.ga_advance(self._handle, state, b, len(b))
+        return None if nxt < 0 else nxt
+
+    def is_accepting(self, state: int) -> bool:
+        return bool(self._lib.ga_accepting(self._handle, state))
+
+    def accepts(self, text: str) -> bool:
+        st = self.advance_string(self.initial_state(), text)
+        return st is not None and self.is_accepting(st)
+
+
+class NativeMaskBuilder:
+    """Counterpart of automaton.TokenMaskBuilder over the native trie."""
+
+    def __init__(self, token_strs: list, eos_ids: Iterable[int], vocab_size: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native grammar runtime unavailable")
+        self._lib = lib
+        self.vocab_size = vocab_size
+        parts = []
+        for tid, s in enumerate(token_strs[:vocab_size]):
+            if not s:
+                continue
+            b = s.encode("utf-8")
+            parts.append(struct.pack("<ii", tid, len(b)) + b)
+        blob = b"".join(parts)
+        eos = [e for e in eos_ids if 0 <= e < vocab_size]
+        arr = (ctypes.c_int32 * len(eos))(*eos)
+        self._handle = lib.ga_mask_builder_new(blob, len(blob), arr, len(eos),
+                                               vocab_size)
+        self._penalty_memo: dict = {}
+
+    def __del__(self):
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.ga_mask_builder_free(handle)
+
+    MAX_MEMO = 8192
+
+    def penalty_row(self, grammar: NativeGrammar, state: int) -> np.ndarray:
+        key = (grammar, state)
+        row = self._penalty_memo.get(key)
+        if row is None:
+            if len(self._penalty_memo) >= self.MAX_MEMO:
+                self._penalty_memo.clear()
+            row = np.empty((self.vocab_size,), np.float32)
+            self._lib.ga_penalty_row(
+                self._handle, grammar._handle, state,
+                row.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            self._penalty_memo[key] = row
+        return row

@@ -1,0 +1,97 @@
+"""Native grammar runtime (runtime/grammar.cc) vs the python automaton.
+
+The python automaton is the semantic reference; the C++ runtime must be
+bit-identical on states, acceptance, and vocab masks.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from localai_tpu.functions.grammars import native
+from localai_tpu.functions.grammars.automaton import (
+    Grammar, TokenMaskBuilder, token_strings)
+from localai_tpu.functions.grammars.json_schema import schema_to_grammar
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="no native grammar runtime (g++?)")
+
+
+def _json_grammar():
+    return schema_to_grammar({"type": "object", "properties": {
+        "name": {"type": "string"}, "count": {"type": "integer"}},
+        "required": ["name"]})
+
+
+class _ByteTok:
+    def __init__(self):
+        self.all_special_ids = [256]
+
+    def get_vocab_size(self):
+        return 257
+
+    def decode(self, ids, **kw):
+        return bytes(i for i in ids if i < 256).decode("latin1")
+
+
+def test_acceptance_equivalence():
+    text = _json_grammar()
+    py = Grammar.from_text(text)
+    nat = native.NativeGrammar.from_text(text)
+    cases = [
+        ('{"name": "x"}', True),
+        ('{"name": "x", "count": 42}', True),
+        ('{"count": 1}', False),          # name required first
+        ('{"name": 5}', False),
+        ('{"name": "x"', False),
+        ("[]", False),
+    ]
+    for s, _ in cases:
+        assert py.accepts(s) == nat.accepts(s), s
+    # spot-check expected values too
+    assert nat.accepts('{"name": "ok"}')
+    assert not nat.accepts("nope")
+
+
+def test_incremental_advance_equivalence():
+    text = _json_grammar()
+    py = Grammar.from_text(text)
+    nat = native.NativeGrammar.from_text(text)
+    ps, ns = py.initial_state(), nat.initial_state()
+    for piece in ['{"', "name", '": ', '"ab', 'c"', "}"]:
+        ps = py.advance_string(ps, piece)
+        ns = nat.advance_string(ns, piece)
+        assert (ps is None) == (ns is None), piece
+    assert py.is_accepting(ps) and nat.is_accepting(ns)
+    # rejection agrees
+    assert py.advance_string(py.initial_state(), "x") is None
+    assert nat.advance_string(nat.initial_state(), "x") is None
+
+
+def test_mask_rows_identical():
+    text = _json_grammar()
+    tok = _ByteTok()
+    strs = token_strings(tok)
+    py_b = TokenMaskBuilder(strs, [256], 257)
+    na_b = native.NativeMaskBuilder(strs, [256], 257)
+    py_g = Grammar.from_text(text)
+    na_g = native.NativeGrammar.from_text(text)
+
+    ps, ns = py_g.initial_state(), na_g.initial_state()
+    for step in range(24):
+        pr = py_b.penalty_row(py_g, ps)
+        nr = na_b.penalty_row(na_g, ns)
+        assert np.array_equal(pr, nr), f"row mismatch at step {step}"
+        # identity memoization (engine fast path)
+        assert na_b.penalty_row(na_g, ns) is nr
+        # walk the first allowed byte forward in both automata
+        allowed = np.nonzero(pr == 0.0)[0]
+        if len(allowed) == 0 or allowed[0] == 256:
+            break
+        ch = chr(int(allowed[0]))
+        ps = py_g.advance_string(ps, ch)
+        ns = na_g.advance_string(ns, ch)
+        assert (ps is None) == (ns is None)
+        if ps is None:
+            break
