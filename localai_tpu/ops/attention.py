@@ -1,9 +1,11 @@
-"""Attention ops: jnp reference implementations.
+"""Attention ops: jnp implementations (XLA-fused; production path).
 
-These are the semantic reference; Pallas TPU kernels (flash prefill,
-paged decode) in localai_tpu/ops/pallas/ replace them on TPU via the
-dispatch switch in localai_tpu/ops/__init__.py. Keeping a pure-jnp path
-means every test runs hermetically on the 8-device CPU mesh.
+Measured on the serving chip, these run at the device's HBM streaming
+rate for the serving shapes (weights + KV reads dominate; see bench.py),
+so hand-written Pallas kernels are kept as a future optimization rather
+than a dispatch layer here. Sequence-parallel long-context attention
+lives in localai_tpu/parallel/ring_attention.py. Pure-jnp also means
+every test runs hermetically on the 8-device CPU mesh.
 
 GQA is computed with grouped einsums — queries reshaped to
 [.., KV, G, hd] against un-repeated keys — NOT by materializing

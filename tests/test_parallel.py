@@ -171,3 +171,40 @@ def test_odd_sizes_fall_back_to_replication(mesh8):
         mesh=mesh8)
     # slots still shard on dp (4 % 2 == 0); kv axis replicated (3 % 4 != 0)
     assert e.ck.sharding.spec == P(None, "dp", None, None, None)
+
+
+def test_ring_attention_matches_single_device(mesh8):
+    """sp=8 ring attention == full causal attention (up to fp order)."""
+    from localai_tpu.parallel import ring_attention as ra
+    from localai_tpu.parallel import mesh as meshlib
+    from localai_tpu.ops.attention import causal_attention
+
+    sp_mesh = meshlib.make_mesh(meshlib.MeshPlan(sp=8),
+                                devices=jax.devices()[:8])
+    B, T, H, KV, hd = 2, 64, 8, 4, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, T, H, hd), jnp.float32)
+    k = jax.random.normal(k2, (B, T, KV, hd), jnp.float32)
+    v = jax.random.normal(k3, (B, T, KV, hd), jnp.float32)
+
+    ref = causal_attention(q, k, v, jnp.ones((B, T), bool), H // KV)
+
+    sh = ra.sp_sharding(sp_mesh)
+    qs = jax.device_put(q, sh)
+    ks = jax.device_put(k, jax.sharding.NamedSharding(sp_mesh, P(None, "sp", None, None)))
+    vs = jax.device_put(v, ks.sharding)
+    out = ra.ring_causal_attention(qs, ks, vs, sp_mesh, q_per_kv=H // KV)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_sp1_fallback(mesh8):
+    from localai_tpu.parallel import ring_attention as ra
+    from localai_tpu.parallel import mesh as meshlib
+
+    m1 = meshlib.make_mesh(meshlib.MeshPlan(), devices=jax.devices()[:1])
+    B, T, H, hd = 1, 16, 4, 8
+    q = jax.random.normal(jax.random.PRNGKey(1), (B, T, H, hd))
+    out = ra.ring_causal_attention(q, q, q, m1, q_per_kv=1)
+    assert out.shape == (B, T, H, hd)
