@@ -188,13 +188,22 @@ async def chat_completions(request):
         q = await state.iter_blocking(gen)
         return await sse_response(request, q)
 
-    # non-stream: n choices (reference: ComputeChoices inference.go:11-63)
+    # non-stream: n choices (reference: ComputeChoices inference.go:11-63).
+    # Fanned out CONCURRENTLY: each choice occupies its own engine slot and
+    # the continuous-batching engine decodes them together (the shared
+    # prompt prefix is KV-reused across slots) — the reference loops
+    # serially; slots make parallel the natural shape here.
+    import asyncio
+
     n = int(body.get("n") or 1)
+    chunks = await asyncio.gather(*[
+        state.run_blocking(state.caps.inference, mc, prompt, overrides,
+                           correlation_id)
+        for _ in range(n)
+    ])
     choices = []
     usage_pt, usage_ct = 0, 0
-    for i in range(n):
-        chunk = await state.run_blocking(
-            state.caps.inference, mc, prompt, overrides, correlation_id)
+    for i, chunk in enumerate(chunks):
         usage_pt = chunk.prompt_tokens
         usage_ct += chunk.completion_tokens
         text = chunk.text
@@ -264,10 +273,16 @@ async def completions(request):
         q = await state.iter_blocking(gen)
         return await sse_response(request, q)
 
+    # multi-prompt batches fan out concurrently across engine slots
+    import asyncio
+
+    chunks = await asyncio.gather(*[
+        state.run_blocking(state.caps.inference, mc, render(p), overrides)
+        for p in prompts
+    ])
     choices = []
     usage_pt, usage_ct = 0, 0
-    for i, p in enumerate(prompts):
-        chunk = await state.run_blocking(state.caps.inference, mc, render(p), overrides)
+    for i, chunk in enumerate(chunks):
         usage_pt += chunk.prompt_tokens
         usage_ct += chunk.completion_tokens
         choices.append({"index": i, "text": chunk.text,

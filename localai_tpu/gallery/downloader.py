@@ -62,8 +62,7 @@ def download_file(uri: str, dest: str, sha256: str = "",
         _verify(dest, sha256)
         return dest
     if uri.startswith((OCI_PREFIX, OLLAMA_PREFIX)):
-        raise NotImplementedError(
-            "oci/ollama pulls require a registry client; use huggingface:// or https://")
+        return _pull_registry_blob(uri, dest, sha256, progress)
 
     url = resolve_uri(uri)
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
@@ -89,6 +88,82 @@ def download_file(uri: str, dest: str, sha256: str = "",
                         progress(done, total)
     os.replace(partial, dest)
     _verify(dest, sha256)
+    return dest
+
+
+OLLAMA_REGISTRY = os.environ.get("LOCALAI_OLLAMA_REGISTRY",
+                                 "https://registry.ollama.ai")
+OLLAMA_MODEL_MEDIA_TYPE = "application/vnd.ollama.image.model"
+MANIFEST_ACCEPT = ", ".join((
+    "application/vnd.docker.distribution.manifest.v2+json",
+    "application/vnd.oci.image.manifest.v1+json",
+))
+
+
+def parse_image_ref(uri: str):
+    """ollama://[registry/]repo[:tag] or oci://registry/repo[:tag]
+    -> (registry_base, repository, tag).
+
+    Ollama shorthands mirror the reference (reference: pkg/oci/ollama.go:34-42 —
+    bare names map to library/<name> on registry.ollama.ai, default tag
+    latest)."""
+    if uri.startswith(OLLAMA_PREFIX):
+        ref = uri[len(OLLAMA_PREFIX):]
+        tag = "latest"
+        if ":" in ref.rsplit("/", 1)[-1]:
+            ref, tag = ref.rsplit(":", 1)
+        if "/" not in ref:
+            ref = f"library/{ref}"
+        return OLLAMA_REGISTRY, ref, tag
+    ref = uri[len(OCI_PREFIX):]
+    tag = "latest"
+    if ":" in ref.rsplit("/", 1)[-1]:
+        ref, tag = ref.rsplit(":", 1)
+    host, _, repo = ref.partition("/")
+    if not repo:
+        raise ValueError(f"oci uri needs registry/repository: {uri}")
+    scheme = "http" if host.startswith(("localhost", "127.0.0.1")) else "https"
+    return f"{scheme}://{host}", repo, tag
+
+
+def _pull_registry_blob(uri: str, dest: str, sha256: str,
+                        progress: Optional[Callable]) -> str:
+    """Pull a model blob via the OCI distribution API (reference:
+    pkg/oci/ollama.go — manifest fetch, pick the
+    application/vnd.ollama.image.model layer, download its blob; plain OCI
+    images take the largest layer)."""
+    base, repo, tag = parse_image_ref(uri)
+    with httpx.Client(timeout=120.0, follow_redirects=True) as client:
+        r = client.get(f"{base}/v2/{repo}/manifests/{tag}",
+                       headers={"Accept": MANIFEST_ACCEPT})
+        r.raise_for_status()
+        manifest = r.json()
+        layers = manifest.get("layers") or []
+        if not layers:
+            raise ValueError(f"no layers in manifest for {uri}")
+        model_layers = [l for l in layers
+                        if l.get("mediaType") == OLLAMA_MODEL_MEDIA_TYPE]
+        layer = (model_layers[0] if model_layers
+                 else max(layers, key=lambda l: l.get("size", 0)))
+        digest = layer["digest"]
+        total = int(layer.get("size", 0))
+
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        partial = dest + ".partial"
+        with client.stream("GET", f"{base}/v2/{repo}/blobs/{digest}") as resp:
+            resp.raise_for_status()
+            done = 0
+            with open(partial, "wb") as f:
+                for chunk in resp.iter_bytes(1 << 20):
+                    f.write(chunk)
+                    done += len(chunk)
+                    if progress and total:
+                        progress(done, total)
+    os.replace(partial, dest)
+    # registries address blobs by digest — verify it even without an
+    # explicit sha256 from the gallery entry
+    want = sha256 or (digest.split(":", 1)[1] if digest.startswith("sha256:") else "")
+    _verify(dest, want)
     return dest
 
 

@@ -154,3 +154,68 @@ def test_guess_defaults_fills_templates(tmp_path):
     mc2.template.chat_message = "custom"
     assert not guess_defaults(mc2, str(tmp_path))
     assert mc2.template.chat == "custom"
+
+
+# ---------- oci / ollama acquisition ----------
+
+def _fake_registry(blob: bytes):
+    """Minimal OCI distribution endpoint (manifest + blob)."""
+    import hashlib
+
+    from aiohttp import web
+
+    digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+    manifest = {
+        "schemaVersion": 2,
+        "layers": [
+            {"mediaType": "application/vnd.ollama.image.license",
+             "digest": "sha256:bogus", "size": 3},
+            {"mediaType": "application/vnd.ollama.image.model",
+             "digest": digest, "size": len(blob)},
+        ],
+    }
+
+    async def manifests(request):
+        return web.json_response(manifest)
+
+    async def blobs(request):
+        assert request.match_info["digest"] == digest
+        return web.Response(body=blob)
+
+    app = web.Application()
+    app.router.add_get("/v2/{repo:.*}/manifests/{tag}", manifests)
+    app.router.add_get("/v2/{repo:.*}/blobs/{digest}", blobs)
+    return app
+
+
+def test_parse_image_ref():
+    from localai_tpu.gallery.downloader import parse_image_ref
+
+    base, repo, tag = parse_image_ref("ollama://llama3")
+    assert repo == "library/llama3" and tag == "latest"
+    base, repo, tag = parse_image_ref("ollama://me/model:q4")
+    assert repo == "me/model" and tag == "q4"
+    base, repo, tag = parse_image_ref("oci://localhost:5000/org/model:v1")
+    assert base == "http://localhost:5000" and repo == "org/model" and tag == "v1"
+
+
+def test_ollama_pull_from_registry(tmp_path, monkeypatch):
+    import localai_tpu.gallery.downloader as dl
+
+    blob = b"GGUF-ish model bytes" * 100
+    port = free_port()
+    _run_app_bg(_fake_registry(blob), port)
+    monkeypatch.setattr(dl, "OLLAMA_REGISTRY", f"http://127.0.0.1:{port}")
+
+    seen = []
+    dest = str(tmp_path / "model.bin")
+    out = dl.download_file("ollama://tinymodel", dest,
+                           progress=lambda d, t: seen.append((d, t)))
+    assert out == dest
+    assert open(dest, "rb").read() == blob
+    assert seen and seen[-1][0] == len(blob)
+
+    # oci:// takes the same path with an explicit registry host
+    dest2 = str(tmp_path / "model2.bin")
+    dl.download_file(f"oci://127.0.0.1:{port}/org/model:v1", dest2)
+    assert open(dest2, "rb").read() == blob
