@@ -103,7 +103,10 @@ class EngineServicer(BackendServicer):
         if tp * dp > 1:
             mesh = meshlib.make_mesh(meshlib.MeshPlan(dp=dp, tp=tp),
                                      devices=jax.devices()[: tp * dp])
-        params = weights.load_llama_params(model_dir, cfg, mesh=mesh, dtype=dtype)
+        params = weights.load_llama_params(
+            model_dir, cfg, mesh=mesh, dtype=dtype,
+            quantize=request.quantization or
+            ("int8" if request.dtype == "int8" else ""))
 
         from transformers import AutoTokenizer
 

@@ -29,6 +29,7 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         num_slots=mc.num_slots,
         dtype=mc.dtype,
         kv_cache_dtype=mc.kv_cache_dtype,
+        quantization=mc.quantization,
         mesh_tp=int(mc.mesh.get("tp", app.mesh_tp) or 0),
         mesh_dp=int(mc.mesh.get("dp", app.mesh_dp) or 1),
         prefill_buckets=[int(b) for b in mc.prefill_buckets],
@@ -54,8 +55,12 @@ def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict
         min_p=sp.min_p,
         typical_p=sp.typical_p,
         repeat_penalty=sp.repeat_penalty,
+        repeat_last_n=sp.repeat_last_n,
         presence_penalty=sp.presence_penalty,
         frequency_penalty=sp.frequency_penalty,
+        mirostat=sp.mirostat,
+        mirostat_tau=sp.mirostat_tau,
+        mirostat_eta=sp.mirostat_eta,
         seed=sp.seed,
         stop_sequences=list(o.get("stop") or mc.stopwords or []),
         ignore_eos=bool(o.get("ignore_eos", False)),

@@ -50,6 +50,9 @@ class PredictionParams:
     repeat_last_n: Optional[int] = None
     presence_penalty: Optional[float] = None
     frequency_penalty: Optional[float] = None
+    mirostat: Optional[int] = None
+    mirostat_tau: Optional[float] = None
+    mirostat_eta: Optional[float] = None
     seed: Optional[int] = None
     echo: bool = False
     n: int = 1
@@ -108,6 +111,7 @@ class ModelConfig:
     # TPU-native knobs (replace gpu_layers/tensor_split/low_vram/...)
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"
+    quantization: str = ""            # "" | int8 (weight-only, per-channel)
     num_slots: int = 8                # reference: LLAMACPP_PARALLEL slots
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
@@ -168,8 +172,12 @@ class ModelConfig:
             "min_p": p.min_p if p.min_p is not None else 0.0,
             "typical_p": p.typical_p if p.typical_p is not None else 1.0,
             "repeat_penalty": p.repeat_penalty if p.repeat_penalty is not None else 1.0,
+            "repeat_last_n": p.repeat_last_n if p.repeat_last_n is not None else 64,
             "presence_penalty": p.presence_penalty or 0.0,
             "frequency_penalty": p.frequency_penalty or 0.0,
+            "mirostat": p.mirostat or 0,
+            "mirostat_tau": p.mirostat_tau if p.mirostat_tau is not None else 5.0,
+            "mirostat_eta": p.mirostat_eta if p.mirostat_eta is not None else 0.1,
             "seed": p.seed if p.seed is not None else -1,
             "logit_bias": dict(p.logit_bias or {}),
         }

@@ -283,7 +283,8 @@ class Engine:
     # ---------- jitted step bodies ----------
 
     def _decode_burst_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
-                           bias, keys, slot_params, active, mu, n_steps: int):
+                           bias, keys, slot_params, active, mu, n_steps: int,
+                           flags: tuple = (True, True, True)):
         """n_steps decode+sample steps in ONE dispatch (lax.scan).
 
         Per-dispatch overhead on the serving chip is comparable to one step's
@@ -303,7 +304,9 @@ class Engine:
             logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
                                                write_lengths, ck, cv)
             ids, logprobs, new_keys, new_mu = sampling.sample(
-                logits, slot_params, ring, ring_pos, bias, keys, mu)
+                logits, slot_params, ring, ring_pos, bias, keys, mu,
+                use_penalties=flags[0], use_typical=flags[1],
+                use_mirostat=flags[2])
             # only active slots consume RNG/mirostat state; a prefilling
             # slot's seeded state must not advance with others' decode steps
             keys = jnp.where(active[:, None], new_keys, keys)
@@ -355,13 +358,15 @@ class Engine:
         mu = jnp.asarray(mu).at[slot].set(new_mu)
         return ids, logprobs, ck, cv, keys, mu
 
-    def _get_burst_fn(self, n_steps: int):
-        fn = self._burst_fns.get(n_steps)
+    def _get_burst_fn(self, n_steps: int, flags: tuple = (True, True, True)):
+        key = (n_steps, flags)
+        fn = self._burst_fns.get(key)
         if fn is None:
             fn = jax.jit(
-                lambda *a: self._decode_burst_body(*a, n_steps=n_steps),
+                lambda *a: self._decode_burst_body(*a, n_steps=n_steps,
+                                                   flags=flags),
                 donate_argnums=(2, 3, 8))
-            self._burst_fns[n_steps] = fn
+            self._burst_fns[key] = fn
         return fn
 
     def _get_chunk_fn(self, bucket: int):
@@ -424,11 +429,12 @@ class Engine:
             ks.append(k)
             k *= 2
         for k in ks:
-            fn = self._get_burst_fn(k)
-            _, _, self.ck, self.cv, self.rng_keys, _ = fn(
-                self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
-                self.ring, self.ring_pos, self.bias, self.rng_keys,
-                self.slot_params, self.active_dev, self.mu)
+            for flags in ((False, False, False), (True, True, True)):
+                fn = self._get_burst_fn(k, flags)
+                _, _, self.ck, self.cv, self.rng_keys, _ = fn(
+                    self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
+                    self.ring, self.ring_pos, self.bias, self.rng_keys,
+                    self.slot_params, self.active_dev, self.mu)
         for bucket in self._buckets:
             one = np.ones((1,), np.int32)
             zero = np.zeros((1,), np.int32)
@@ -1038,7 +1044,13 @@ class Engine:
                 # the next burst below
                 self._fold_burst(self._inflight)
         n_steps = self._pick_burst()
-        fn = self._get_burst_fn(n_steps)
+        f = sampling.feature_flags(self.slot_params, self.active_dev)
+        flags = (f["use_penalties"], f["use_typical"], f["use_mirostat"])
+        if any(flags) and flags != (True, True, True):
+            # only the two precompiled variants exist; mixed feature sets
+            # use the full sampler rather than compiling mid-request
+            flags = (True, True, True)
+        fn = self._get_burst_fn(n_steps, flags)
         if self._chain_dirty or self._chain is None:
             # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
             # (observed on the CPU client) — an in-flight dispatch holding

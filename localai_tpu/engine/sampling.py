@@ -215,12 +215,36 @@ def _window_counts(ring, pos, idx, repeat_last_n):
     return jnp.sum(match & in_window[:, None, :], axis=-1).astype(jnp.int32)
 
 
-def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
+def feature_flags(slot_params, active=None) -> dict:
+    """Host-side: which sampler features any (active) slot actually uses.
+
+    Per-op launch overhead dominates small ops on the serving chip, so the
+    engine compiles burst variants with unused feature blocks traced OUT
+    (static flags below) — a temperature/top-k workload skips the penalty
+    window counts, the typical-p double argsort, and the mirostat math.
+    """
+    sel = slice(None) if active is None else active
+    pen = (np.any(slot_params["repeat_penalty"][sel] != 1.0)
+           or np.any(slot_params["presence_penalty"][sel] != 0.0)
+           or np.any(slot_params["frequency_penalty"][sel] != 0.0))
+    return {
+        "use_penalties": bool(pen),
+        "use_typical": bool(np.any(slot_params["typical_p"][sel] < 1.0)),
+        "use_mirostat": bool(np.any(slot_params["mirostat"][sel] > 0)),
+    }
+
+
+def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
+           use_penalties: bool = True, use_typical: bool = True,
+           use_mirostat: bool = True):
     """Sample one token per slot.
 
     logits: [S, V] fp32; ring/ring_pos: penalty state from make_ring;
     logit_bias: [S, V] fp32; rng_keys: [S, 2] uint32 (per-slot PRNG data);
     mu: [S] fp32 mirostat state (None = mirostat disabled everywhere).
+    use_*: STATIC feature gates (see feature_flags) — False traces the
+    block out entirely; semantics are unchanged when the corresponding
+    per-slot parameters are at their neutral values.
     Returns (token_ids [S] int32, logprobs [S] fp32, new_rng_keys, new_mu).
 
     Mirostat (llama.cpp mirostat v2 semantics, sample_token_mirostat_v2:
@@ -230,24 +254,27 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
     """
     S, V = logits.shape
     k = min(SORT_K, V)
+    use_mirostat = use_mirostat and mu is not None
     # the ONLY full-vocab op: bias add fuses into the producing matmul's
     # epilogue; approx_max_k reduces to the candidate window
     top_vals, top_idx = jax.lax.approx_max_k(logits + logit_bias, k)
     top_idx = top_idx.astype(jnp.int32)
 
-    # penalties within the window (llama.cpp last-n semantics)
-    cnt = _window_counts(ring, ring_pos, top_idx, slot_params["repeat_last_n"])
-    seen = cnt > 0
-    rp = slot_params["repeat_penalty"][:, None]
-    penalized = jnp.where(top_vals > 0, top_vals / rp, top_vals * rp)
-    vals = jnp.where(seen, penalized, top_vals)
-    vals = vals - seen * slot_params["presence_penalty"][:, None]
-    vals = vals - cnt.astype(jnp.float32) * slot_params["frequency_penalty"][:, None]
-
-    # penalties can reorder the window: re-sort descending (cheap, [S, k])
-    order = jnp.argsort(-vals, axis=-1)
-    vals = jnp.take_along_axis(vals, order, axis=-1)
-    idx = jnp.take_along_axis(top_idx, order, axis=-1)
+    if use_penalties:
+        # penalties within the window (llama.cpp last-n semantics)
+        cnt = _window_counts(ring, ring_pos, top_idx, slot_params["repeat_last_n"])
+        seen = cnt > 0
+        rp = slot_params["repeat_penalty"][:, None]
+        penalized = jnp.where(top_vals > 0, top_vals / rp, top_vals * rp)
+        vals = jnp.where(seen, penalized, top_vals)
+        vals = vals - seen * slot_params["presence_penalty"][:, None]
+        vals = vals - cnt.astype(jnp.float32) * slot_params["frequency_penalty"][:, None]
+        # penalties can reorder the window: re-sort descending ([S, k])
+        order = jnp.argsort(-vals, axis=-1)
+        vals = jnp.take_along_axis(vals, order, axis=-1)
+        idx = jnp.take_along_axis(top_idx, order, axis=-1)
+    else:
+        vals, idx = top_vals, top_idx
 
     greedy_ids = idx[:, 0]
 
@@ -263,18 +290,21 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
     keep &= (cum - probs) < slot_params["top_p"][:, None]
     # min-p: prob >= min_p * max_prob
     keep &= probs >= slot_params["min_p"][:, None] * probs[:, :1]
-    # typical-p: keep tokens whose -log p is closest to entropy until mass >= tp
     logp = jnp.log(jnp.clip(probs, 1e-20))
-    entropy = -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0), axis=-1, keepdims=True)
-    deviation = jnp.abs(-logp - entropy)
-    tp_enabled = slot_params["typical_p"][:, None] < 1.0
-    dev_order = jnp.argsort(deviation, axis=-1)
-    probs_by_dev = jnp.take_along_axis(probs, dev_order, axis=-1)
-    cum_dev = jnp.cumsum(probs_by_dev, axis=-1)
-    keep_dev_sorted = (cum_dev - probs_by_dev) < slot_params["typical_p"][:, None]
-    inv = jnp.argsort(dev_order, axis=-1)
-    keep_typical = jnp.take_along_axis(keep_dev_sorted, inv, axis=-1)
-    keep = jnp.where(tp_enabled, keep & keep_typical, keep)
+    if use_typical:
+        # typical-p: keep tokens whose -log p is closest to entropy until
+        # mass >= tp
+        entropy = -jnp.sum(jnp.where(probs > 0, probs * logp, 0.0), axis=-1,
+                           keepdims=True)
+        deviation = jnp.abs(-logp - entropy)
+        tp_enabled = slot_params["typical_p"][:, None] < 1.0
+        dev_order = jnp.argsort(deviation, axis=-1)
+        probs_by_dev = jnp.take_along_axis(probs, dev_order, axis=-1)
+        cum_dev = jnp.cumsum(probs_by_dev, axis=-1)
+        keep_dev_sorted = (cum_dev - probs_by_dev) < slot_params["typical_p"][:, None]
+        inv = jnp.argsort(dev_order, axis=-1)
+        keep_typical = jnp.take_along_axis(keep_dev_sorted, inv, axis=-1)
+        keep = jnp.where(tp_enabled, keep & keep_typical, keep)
     # the independent keep-masks can have an empty intersection (typical-p's
     # lowest-deviation tokens need not lie in the top-p prefix); llama.cpp
     # applies samplers sequentially so this cannot happen there — guarantee
@@ -283,8 +313,8 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
 
     # mirostat v2: replace the keep-chain with the surprise-<=-mu cut over
     # the full-window distribution (softmax of scaled, no top-k mask)
-    miro_on = slot_params["mirostat"][:, None] > 0
-    if mu is not None:
+    if use_mirostat:
+        miro_on = slot_params["mirostat"][:, None] > 0
         full_logp = jax.nn.log_softmax(scaled, axis=-1)
         surprise = -full_logp / jnp.float32(np.log(2.0))          # bits
         keep_miro = (surprise <= jnp.asarray(mu)[:, None]) | (rank == 0)
@@ -304,7 +334,7 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
 
     ids = jnp.where(slot_params["greedy"], greedy_ids, sampled_ids).astype(jnp.int32)
 
-    if mu is not None:
+    if use_mirostat:
         # observed surprise under the truncated+renormalized distribution
         lse = jax.nn.logsumexp(masked, axis=-1, keepdims=True)
         chosen_lp = jnp.take_along_axis(masked - lse, choices[:, None], axis=-1)[:, 0]
@@ -314,7 +344,7 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None):
         new_mu = jnp.where(miro_on[:, 0] & ~jnp.asarray(slot_params["greedy"]),
                            new_mu, jnp.asarray(mu))
     else:
-        new_mu = None
+        new_mu = None if mu is None else jnp.asarray(mu)
 
     # logprob of the chosen token under the post-penalty, pre-temperature
     # window distribution (window-normalized; see module docstring)

@@ -50,30 +50,47 @@ def load_llama_params(
     cfg,
     mesh=None,
     dtype=jnp.bfloat16,
+    quantize: str = "",
 ) -> dict:
     """Load HF llama/mistral/qwen2-style weights into the stacked pytree.
 
     When ``mesh`` is given, each leaf is placed with the tensor-parallel
-    sharding from parallel/sharding.py as it is assembled.
+    sharding from parallel/sharding.py as it is assembled. quantize="int8"
+    converts matmul weights to weight-only per-channel int8 at load time
+    (reference parity: quantized GGUF serving).
     """
     tensors = _open_shards(model_dir)
+
+    quant_names = {"embed", "lm_head", "wq", "wk", "wv", "wo",
+                   "w_gate", "w_up", "w_down"}
 
     def get(name: str) -> np.ndarray:
         h = tensors[name]
         return h.get_tensor(name)
 
     def put(arr: np.ndarray, spec_path: Optional[tuple] = None):
-        arr = jnp.asarray(arr, dtype)
+        leaf_name = spec_path[-1]
+        if quantize == "int8" and leaf_name in quant_names:
+            from localai_tpu.models.llama import quantize_params
+
+            leaf = quantize_params({leaf_name: arr})[leaf_name]
+        else:
+            leaf = jnp.asarray(arr, dtype)
         if mesh is not None:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec as P
             from localai_tpu.parallel import sharding as shardlib
 
             specs = shardlib.llama_param_specs(cfg.tie_word_embeddings)
             node = specs
             for k in spec_path:
                 node = node[k]
-            return jax.device_put(arr, NamedSharding(mesh, node))
-        return arr
+            if isinstance(leaf, dict):
+                q = jax.device_put(leaf["q"], NamedSharding(mesh, node))
+                s_spec = P(*([None] * (leaf["s"].ndim - 1) + [node[-1]]))
+                s = jax.device_put(leaf["s"], NamedSharding(mesh, s_spec))
+                return {"q": q, "s": s}
+            return jax.device_put(leaf, NamedSharding(mesh, node))
+        return leaf
 
     L = cfg.num_layers
 

@@ -61,6 +61,24 @@ def to_named(mesh: Mesh, tree):
 
 
 def shard_params(mesh: Mesh, params: dict, tie_word_embeddings: bool = False) -> dict:
-    """Device_put a param pytree onto the mesh with the llama specs."""
-    specs = to_named(mesh, llama_param_specs(tie_word_embeddings))
-    return jax.tree.map(lambda x, s: jax.device_put(x, s), params, specs)
+    """Device_put a param pytree onto the mesh with the llama specs.
+
+    int8-quantized leaves ({"q": int8 weight, "s": per-out-channel scale})
+    shard q with the weight's spec and s with the spec's trailing axes
+    (scales follow the output-channel partitioning)."""
+    specs = llama_param_specs(tie_word_embeddings)
+
+    def put(x, spec):
+        if isinstance(x, dict) and "q" in x:
+            q = jax.device_put(x["q"], NamedSharding(mesh, spec))
+            s_spec = P(*([None] * (x["s"].ndim - 1) + [spec[-1]]))
+            s = jax.device_put(x["s"], NamedSharding(mesh, s_spec))
+            return {"q": q, "s": s}
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    def walk(node, spec):
+        if isinstance(spec, dict):
+            return {k: walk(node[k], spec[k]) for k in spec}
+        return put(node, spec)
+
+    return walk(params, specs)

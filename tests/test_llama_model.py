@@ -89,3 +89,43 @@ def test_hf_config_parsing():
     assert cfg.num_kv_heads == 8
     assert cfg.rope_scaling_type == "llama3"
     assert cfg.rope_scaling_factor == 8.0
+
+
+def test_int8_quantized_model_close_to_fp():
+    """Weight-only int8: logits stay close, greedy path runs end-to-end."""
+    cfg = llama.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=128,
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = llama.quantize_params(params)
+    # dequantized weights reconstruct the originals within half a step
+    w = np.asarray(params["layers"]["w_gate"], np.float32)
+    wq = qparams["layers"]["w_gate"]
+    deq = np.asarray(wq["q"], np.float32) * np.asarray(wq["s"])
+    step = np.asarray(wq["s"])
+    assert np.all(np.abs(deq - w) <= step * 0.51 + 1e-7)
+
+    S, C, T = 2, 32, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (S, T), 0,
+                                cfg.vocab_size, jnp.int32)
+    seq = jnp.full((S,), T, jnp.int32)
+    slots = jnp.arange(S, dtype=jnp.int32)
+    start = jnp.zeros((S,), jnp.int32)
+
+    def run(p):
+        ck, cv = llama.init_cache(cfg, S, C, jnp.float32)
+        logits, ck, cv = llama.prefill(p, cfg, tokens, seq, ck, cv, slots, start)
+        d, ck, cv = llama.decode_step(p, cfg,
+                                      jnp.argmax(logits, -1).astype(jnp.int32),
+                                      seq, ck, cv)
+        return logits, d
+
+    ref_l, ref_d = jax.jit(run)(params)
+    q_l, q_d = jax.jit(run)(qparams)
+    assert np.all(np.isfinite(np.asarray(q_l)))
+    # int8 weight-only is near-lossless: logits track the fp model
+    np.testing.assert_allclose(np.asarray(q_l), np.asarray(ref_l),
+                               atol=0.12, rtol=0.1)
+    np.testing.assert_allclose(np.asarray(q_d), np.asarray(ref_d),
+                               atol=0.12, rtol=0.1)
