@@ -66,22 +66,40 @@ class EmbedServicer(BackendServicer):
             self._fns[bucket] = fn
         return fn
 
+    _BATCH = 16  # padded batch per jitted call for multi-input requests
+
     def Embedding(self, request, context):
         if self.params is None:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
         import jax.numpy as jnp
 
-        ids = self.tokenizer.encode(request.prompt, truncation=True,
-                                    max_length=self.cfg.max_position_embeddings)
-        bucket = next((b for b in _BUCKETS if len(ids) <= b), _BUCKETS[-1])
-        ids = ids[:bucket]
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(ids)] = ids
-        mask = np.zeros((1, bucket), bool)
-        mask[0, : len(ids)] = True
+        texts = list(request.inputs) or [request.prompt]
+        encoded = [self.tokenizer.encode(t, truncation=True,
+                                         max_length=self.cfg.max_position_embeddings)
+                   for t in texts]
+        longest = max(len(e) for e in encoded)
+        bucket = next((b for b in _BUCKETS if longest <= b), _BUCKETS[-1])
+        vecs = []
         with self._lock:
-            vec = self._embed_fn(bucket)(self.params, jnp.asarray(tokens), jnp.asarray(mask))
-        return pb.EmbeddingResult(embeddings=[float(x) for x in np.asarray(vec[0])])
+            for off in range(0, len(encoded), self._BATCH):
+                group = encoded[off: off + self._BATCH]
+                B = 1 if len(group) == 1 else self._BATCH
+                tokens = np.zeros((B, bucket), np.int32)
+                mask = np.zeros((B, bucket), bool)
+                for b, ids in enumerate(group):
+                    ids = ids[:bucket]
+                    tokens[b, : len(ids)] = ids
+                    mask[b, : len(ids)] = True
+                out = self._embed_fn((bucket, B))(
+                    self.params, jnp.asarray(tokens), jnp.asarray(mask))
+                vecs.extend(np.asarray(out)[: len(group)])
+        if not request.inputs:
+            return pb.EmbeddingResult(
+                embeddings=[float(x) for x in vecs[0]],
+                batch=[pb.FloatVector(values=[float(x) for x in vecs[0]])])
+        return pb.EmbeddingResult(
+            embeddings=[float(x) for x in vecs[0]],
+            batch=[pb.FloatVector(values=[float(x) for x in v]) for v in vecs])
 
 
 def main(argv=None):

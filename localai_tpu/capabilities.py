@@ -173,14 +173,22 @@ class Capabilities:
     # ---- embeddings ----
 
     def embeddings(self, mc: ModelConfig, inputs: list) -> list:
-        """(reference: ModelEmbedding embeddings.go)"""
+        """(reference: ModelEmbedding embeddings.go). All inputs go in ONE
+        RPC; the TPU backend pads them into bucketed batches (BASELINE
+        config #4: batched embeddings). Backends without batch support
+        (fakes, external) fall back to per-input calls."""
         lm = self._load(mc)
         lm.mark_busy()
         try:
-            out = []
-            for text in inputs:
-                res = lm.client.embedding(pb.PredictOptions(prompt=str(text)))
-                out.append(list(res.embeddings))
+            res = lm.client.embedding(pb.PredictOptions(
+                prompt=str(inputs[0]) if inputs else "",
+                inputs=[str(t) for t in inputs]))
+            if res.batch:
+                return [list(v.values) for v in res.batch]
+            out = [list(res.embeddings)]
+            for text in inputs[1:]:
+                r = lm.client.embedding(pb.PredictOptions(prompt=str(text)))
+                out.append(list(r.embeddings))
             return out
         finally:
             lm.mark_idle()

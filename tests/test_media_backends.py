@@ -240,3 +240,26 @@ def test_diffusion_servicer(tmp_path):
         positive_prompt="a red square", width=24, height=24, step=3, seed=8,
         dst=dst3), None)
     assert open(dst, "rb").read() != open(dst3, "rb").read()
+
+
+# ---------- batched embeddings ----------
+
+def test_embed_servicer_batches_inputs(tmp_path):
+    from localai_tpu.backend.embed_runner import EmbedServicer
+
+    mdir = str(tmp_path / "bert")
+    _write_tiny_cross_encoder(mdir)  # encoder weights are what embed needs
+    sv = EmbedServicer()
+    res = sv.LoadModel(pb.ModelOptions(model=mdir), None)
+    assert res.success, res.message
+
+    texts = ["alpha beta", "gamma", "delta epsilon zeta", "eta"]
+    out = sv.Embedding(pb.PredictOptions(prompt=texts[0], inputs=texts), None)
+    assert len(out.batch) == len(texts)
+    dims = {len(v.values) for v in out.batch}
+    assert dims == {32}
+    # batched result rows match single-input calls
+    for i, t in enumerate(texts):
+        single = sv.Embedding(pb.PredictOptions(prompt=t), None)
+        np.testing.assert_allclose(list(out.batch[i].values),
+                                   list(single.embeddings), atol=1e-5)
