@@ -118,8 +118,22 @@ class EngineServicer(BackendServicer):
             max_context=request.context_size or min(cfg.max_position_embeddings, 4096),
             prefill_buckets=tuple(request.prefill_buckets) or (32, 128, 512, 2048),
         )
+        draft = None
+        if request.draft_model:
+            ddir = request.draft_model
+            if request.model_path and not os.path.isabs(ddir):
+                ddir = os.path.join(request.model_path, ddir)
+            dcfg = llama.LlamaConfig.from_json(os.path.join(ddir, "config.json"),
+                                               dtype=dtype)
+            dparams = weights.load_llama_params(
+                ddir, dcfg, mesh=mesh, dtype=dtype,
+                quantize=request.quantization or
+                ("int8" if request.dtype == "int8" else ""))
+            draft = (dcfg, dparams)
+
         self.model_cfg = cfg
-        self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh)
+        self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh,
+                                 draft=draft)
         # compile the whole serving surface before accepting traffic (a cold
         # compile mid-request stalls every active slot for 20-40s); skippable
         # for tests that only care about wiring

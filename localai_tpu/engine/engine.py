@@ -55,6 +55,9 @@ class EngineConfig:
     prefill_chunk: int = 512   # max prompt tokens processed between decode steps
     context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
+    # speculative decoding: draft proposals per round (0 disables even
+    # when a draft model is loaded); greedy slots only
+    n_draft: int = 4
     # decode BURST: run up to this many decode steps per device dispatch
     # (lax.scan), amortizing per-dispatch overhead (measured ~3-12 ms on the
     # serving chip — larger than one step's compute). Bursts shrink to 1 when
@@ -161,6 +164,7 @@ class Engine:
         eos_token_ids: Optional[set] = None,
         mesh=None,
         param_shardings=None,
+        draft: Optional[tuple] = None,   # (LlamaConfig, params) draft model
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -171,6 +175,8 @@ class Engine:
         V = model_cfg.vocab_size
 
         self.params = params
+        # speculative decoding (greedy-lossless; see engine/speculative.py)
+        self.draft_cfg, self.draft_params = draft if draft else (None, None)
         self._state_shardings = self._make_state_shardings()
         # device-resident state: big (KV cache), rarely-mutated (bias), or
         # not host-mirrorable (PRNG keys). Everything per-slot and small
@@ -178,6 +184,10 @@ class Engine:
         # writes instead of ~3ms `.at[].set` dispatches, and the arrays ride
         # to the device as ordinary jit args each step.
         self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
+        self.dck = self.dcv = None
+        if self.draft_cfg is not None:
+            self.dck, self.dcv = llama.init_cache(self.draft_cfg, S, C,
+                                                  self.ecfg.cache_dtype)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
@@ -215,6 +225,7 @@ class Engine:
         self._burst_fns: dict[int, Callable] = {}
         self._chunk_fns: dict[int, Callable] = {}
         self._final_fns: dict[tuple, Callable] = {}
+        self._spec_fn = None
 
         # pipelined decode state: device-side burst-to-burst chain of
         # (tokens, lengths, ring, ring_pos), the not-yet-processed burst,
@@ -491,6 +502,10 @@ class Engine:
         V = self.cfg.vocab_size
         self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
                                             self.ecfg.cache_dtype)
+        if self.draft_cfg is not None:
+            self.dck, self.dcv = llama.init_cache(self.draft_cfg, S,
+                                                  self.ecfg.max_context,
+                                                  self.ecfg.cache_dtype)
         self.ring, self.ring_pos = sampling.make_ring(S)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
@@ -647,7 +662,10 @@ class Engine:
                 decoding = any(s is not None and s.phase == "decode"
                                for s in self.slots)
                 if decoding:
-                    self._decode_once()
+                    if self._spec_ready():
+                        self._spec_once()
+                    else:
+                        self._decode_once()
                 else:
                     if self._inflight is not None:
                         # every participant finished during processing of the
@@ -892,6 +910,13 @@ class Engine:
             else:
                 fn = self._get_chunk_fn(bucket)
             self.ck, self.cv = fn(*args)
+            if self.draft_params is not None:
+                # mirror the prompt into the draft cache (speculative
+                # rounds need the same context; see engine/speculative.py)
+                self.dck, self.dcv = self._get_chunk_fn(bucket)(
+                    self.draft_params, tokens, np.array([take], np.int32),
+                    self.dck, self.dcv, np.array([slot], np.int32),
+                    np.array([s.written], np.int32))
             s.pending = s.pending[take:]
             s.written += take
             s.committed = s.written
@@ -937,6 +962,11 @@ class Engine:
         else:
             fn = self._get_final_fn(bucket, B, continued)
         out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
+        if self.draft_params is not None:
+            # draft ingests the same prompt rows (no sampling needed)
+            self.dck, self.dcv = self._get_chunk_fn(bucket)(
+                self.draft_params, tokens, seq_len, self.dck, self.dcv,
+                slots_v, start_v)
         # ASYNC: don't sync here — the result would be serialized behind any
         # in-flight decode burst, idling the device. The group's slots stay
         # in "prefill" phase (and out of decode bursts) until the sampled
@@ -1020,6 +1050,68 @@ class Engine:
         while k * 2 <= cap:
             k *= 2
         return k
+
+    def _get_spec_fn(self):
+        if self._spec_fn is None:
+            from localai_tpu.engine import speculative
+
+            D = self.ecfg.n_draft
+            self._spec_fn = jax.jit(
+                lambda *a: speculative.spec_round(
+                    *a[:2], self.cfg, self.draft_cfg, *a[2:], n_draft=D),
+                donate_argnums=(4, 5, 6, 7))
+        return self._spec_fn
+
+    def _spec_ready(self) -> bool:
+        """Speculate this round? Needs a draft model, every active slot
+        greedy and ungrammared, and D+1 rows of cache headroom."""
+        if self.draft_params is None or self.ecfg.n_draft <= 0:
+            return False
+        D = self.ecfg.n_draft
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "decode":
+                continue
+            if s.grammar is not None or not self.slot_params["greedy"][i]:
+                return False
+            if self.ecfg.max_context - 2 - s.cache_len < D + 1:
+                return False
+        return True
+
+    def _spec_once(self):
+        """One speculative round (no pipelining: rounds advance lengths
+        per-slot, so the burst chain is not reusable)."""
+        if self._inflight is not None:
+            self._process_burst(self._inflight)
+            self._inflight = None
+        fn = self._get_spec_fn()
+        burst_slots = [(i, s) for i, s in enumerate(self.slots)
+                       if s is not None and s.phase == "decode"]
+        out, out_lp, n_out, self.ck, self.cv, self.dck, self.dcv, _ = fn(
+            self.params, self.draft_params, self.cur_tokens.copy(),
+            self.lengths.copy(), self.ck, self.cv, self.dck, self.dcv,
+            self.active_dev.copy())
+        out_np = np.asarray(out)
+        lp_np = np.asarray(out_lp)
+        n_np = np.asarray(n_out)
+        self._chain = None
+        self._chain_dirty = True
+        for i, snap in burst_slots:
+            if not self._live(i, snap):
+                continue
+            n = int(n_np[i])
+            if n <= 0:
+                continue
+            self.cur_tokens[i] = out_np[i, n - 1]
+            self.lengths[i] += n
+            for j in range(n):
+                tok = int(out_np[i, j])
+                self.ring[i, self.ring_pos[i] % sampling.RING_N] = tok
+                self.ring_pos[i] += 1
+            for j in range(n):
+                if not self._live(i, snap):
+                    break
+                snap.committed = min(snap.committed + 1, snap.cache_len)
+                self._emit_token(i, int(out_np[i, j]), float(lp_np[i, j]))
 
     def _decode_once(self):
         """Dispatch one decode burst, PIPELINED: the previous burst's host

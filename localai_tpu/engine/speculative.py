@@ -1,0 +1,85 @@
+"""Speculative decoding: draft-model propose, target-model verify.
+
+Capability parity: the reference plumbs draft-model fields end-to-end
+(reference: backend.proto DraftModel, backend_config.go DraftModel) into
+llama.cpp's speculative sampling. TPU re-design: one ROUND is a single
+compiled program — the draft model autoregressively proposes D tokens
+(lax.scan of decode steps over its own KV cache), then the target model
+scores all D+1 positions in ONE batched forward (prefill with
+return_all_logits) and greedy acceptance keeps the matched prefix plus
+the target's correction/bonus token. Greedy speculation is LOSSLESS: the
+emitted stream is bit-identical to plain greedy decoding of the target
+model, whatever the draft proposes — rejected drafts only waste the
+round's spare compute.
+
+Cache invariant (both models): rows [0, length) hold the accepted
+context, and the CURRENT token (last emitted) is not yet ingested; the
+round ingests it in both models as its first input. Rows written for
+rejected proposals sit above the new length and are masked/overwritten.
+
+The engine uses speculation only when every active slot is greedy and
+ungrammared (stochastic speculative sampling needs rejection-sampling
+acceptance; a documented follow-up) and falls back to normal bursts
+otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.models import llama
+
+
+def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
+               tokens, lengths, ck, cv, dck, dcv, active, n_draft: int):
+    """One speculative round for all slots.
+
+    tokens [S]: current (not yet ingested) token per slot; lengths [S];
+    ck/cv target cache; dck/dcv draft cache; active [S] bool.
+    Returns (out [S, D+1] emitted tokens, n_out [S] valid counts,
+    ck, cv, dck, dcv, lengths_new).
+    """
+    S = tokens.shape[0]
+    D = n_draft
+    C = ck.shape[2]
+    dC = dck.shape[2]
+
+    # 1. draft proposes D tokens (its cache ingests current + proposals)
+    def dstep(carry, _):
+        tok, dl, dck, dcv = carry
+        wl = jnp.where(active, dl, dC)
+        logits, dck, dcv = llama.decode_step(dparams, dcfg, tok, wl, dck, dcv)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, dl + active.astype(jnp.int32), dck, dcv), nxt
+
+    (_, _, dck, dcv), drafts = jax.lax.scan(
+        dstep, (tokens, lengths, dck, dcv), None, length=D)
+    drafts = drafts.T                                   # [S, D]
+
+    # 2. target scores current + proposals in one forward
+    tin = jnp.concatenate([tokens[:, None], drafts], axis=1)   # [S, D+1]
+    seq = jnp.full((S,), D + 1, jnp.int32)
+    start = jnp.where(active, lengths, C)  # inactive rows -> OOB, dropped
+    all_logits, ck, cv = llama.prefill(
+        params, cfg, tin, seq, ck, cv, jnp.arange(S, dtype=jnp.int32), start,
+        continued=True, return_all_logits=True)
+    greedy = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)  # [S, D+1]
+
+    # 3. greedy acceptance: longest prefix where draft matches target
+    match = (drafts == greedy[:, :D]).astype(jnp.int32)         # [S, D]
+    acc_prefix = jnp.cumprod(match, axis=1)
+    k = jnp.sum(acc_prefix, axis=1)                             # [S] accepted
+    bonus = jnp.take_along_axis(greedy, k[:, None], axis=1)[:, 0]
+    pos = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    out = jnp.where(pos < k[:, None], drafts_pad,
+                    jnp.where(pos == k[:, None], bonus[:, None], 0))
+    # matching logprobs for the emitted tokens (target distribution)
+    logp_all = jax.nn.log_softmax(all_logits, axis=-1)
+    out_lp = jnp.take_along_axis(logp_all, out[:, :, None], axis=2)[:, :, 0]
+
+    n_out = (k + 1) * active.astype(jnp.int32)
+    lengths_new = lengths + n_out
+    return out, out_lp, n_out, ck, cv, dck, dcv, lengths_new

@@ -219,6 +219,7 @@ def prefill(
     continued: bool = False,  # STATIC: True when any start_pos may be nonzero
     mm_pos: Optional[jax.Array] = None,   # [B, P] chunk-relative positions
     mm_vec: Optional[jax.Array] = None,   # [B, P, D] injected embeddings
+    return_all_logits: bool = False,      # STATIC: logits for every position
 ):
     """Process full prompts, write KV into the cache slots, return last-token logits.
 
@@ -280,6 +281,10 @@ def prefill(
     (x, cache_k, cache_v), _ = jax.lax.scan(layer_fn, (x, cache_k, cache_v), layers)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     # gather hidden state at the last valid position of each prompt
+    if return_all_logits:
+        # [B, T, V] — used by speculative verification (every draft
+        # position needs the target's next-token distribution)
+        return _unembed(x, params, cfg), cache_k, cache_v
     last = jnp.take_along_axis(x, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
     logits = _unembed(last, params, cfg)[:, 0, :]
     return logits, cache_k, cache_v

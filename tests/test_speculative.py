@@ -1,0 +1,114 @@
+"""Speculative decoding: greedy-lossless draft/verify rounds.
+
+The defining property: whatever the draft model proposes, the emitted
+stream equals plain greedy decoding of the target model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.models import llama
+
+from .conftest import ByteTokenizer
+
+
+def _cfg():
+    return llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=256,
+        dtype=jnp.float32)
+
+
+def _engine(params, draft=None, n_draft=4):
+    e = eng.Engine(
+        _cfg(), params, ByteTokenizer(),
+        eng.EngineConfig(num_slots=2, max_context=128, prefill_buckets=(16, 32),
+                         prefill_chunk=32, cache_dtype=jnp.float32,
+                         n_draft=n_draft),
+        draft=draft)
+    e.start()
+    return e
+
+
+def _greedy(e, text, n=24):
+    req = eng.GenRequest(prompt_ids=ByteTokenizer().encode(text),
+                         params=sampling.SamplingParamsHost(temperature=0.0),
+                         max_new_tokens=n, ignore_eos=True)
+    _, events = e.generate_text(req)
+    return [ev.token_id for ev in events]
+
+
+def test_speculation_matches_plain_greedy():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    e = _engine(params)
+    try:
+        ref = _greedy(e, "speculate on this prompt")
+    finally:
+        e.shutdown()
+
+    # perfect draft (same weights): every proposal accepted, same output
+    e = _engine(params, draft=(cfg, params))
+    try:
+        out_same = _greedy(e, "speculate on this prompt")
+    finally:
+        e.shutdown()
+    assert out_same == ref
+
+    # bad draft (different weights): proposals mostly rejected, SAME output
+    bad = llama.init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    e = _engine(params, draft=(cfg, bad))
+    try:
+        out_bad = _greedy(e, "speculate on this prompt")
+    finally:
+        e.shutdown()
+    assert out_bad == ref
+
+
+def test_speculation_falls_back_for_sampled_requests():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    e = _engine(params, draft=(cfg, params))
+    try:
+        req = eng.GenRequest(
+            prompt_ids=ByteTokenizer().encode("sampled"),
+            params=sampling.SamplingParamsHost(temperature=0.9, seed=7),
+            max_new_tokens=8, ignore_eos=True)
+        _, events = e.generate_text(req)
+        assert len([ev for ev in events]) >= 8
+        assert events[-1].finish_reason == "length"
+    finally:
+        e.shutdown()
+
+
+def test_spec_round_unit():
+    """Direct spec_round check: perfect draft accepts everything."""
+    from localai_tpu.engine.speculative import spec_round
+
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    S, C, D = 2, 64, 3
+    ck, cv = llama.init_cache(cfg, S, C, jnp.float32)
+    dck, dcv = llama.init_cache(cfg, S, C, jnp.float32)
+
+    # ingest a tiny shared context into both caches
+    toks = jnp.array([[5, 6, 7, 8]] * S, jnp.int32)
+    seq = jnp.full((S,), 4, jnp.int32)
+    slots = jnp.arange(S, dtype=jnp.int32)
+    start = jnp.zeros((S,), jnp.int32)
+    logits, ck, cv = llama.prefill(params, cfg, toks, seq, ck, cv, slots, start)
+    _, dck, dcv = llama.prefill(params, cfg, toks, seq, dck, dcv, slots, start)
+    cur = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    out, out_lp, n_out, ck, cv, dck, dcv, lengths = spec_round(
+        params, params, cfg, cfg, cur, seq, ck, cv, dck, dcv,
+        jnp.ones((S,), bool), n_draft=D)
+    n = np.asarray(n_out)
+    assert np.all(n == D + 1)  # perfect draft: all D accepted + bonus
+    assert np.all(np.asarray(lengths) == 4 + D + 1)
+    assert np.all(np.asarray(out) >= 0)
+    assert np.all(np.asarray(out_lp) <= 0)
