@@ -55,16 +55,21 @@ async def index(request):
             f"<tr><td>{html.escape(name)}</td>"
             f"<td>{html.escape(mc.backend or 'auto')}</td>"
             f"<td>{'loaded' if loaded else 'on disk'}</td>"
-            f"<td><button onclick=\"del('{html.escape(name)}')\">delete</button></td></tr>")
+            f"<td><button class=\"del\" data-name=\"{html.escape(name, quote=True)}\">"
+            f"delete</button></td></tr>")
     body = f"""
 <div class="card"><h2>Installed models</h2>
 <table><tr><th>name</th><th>backend</th><th>state</th><th></th></tr>
 {''.join(rows) or '<tr><td colspan=4>no models installed — try Browse</td></tr>'}
 </table></div>
 <script>
-async function del(name){{
-  if(!confirm('Delete '+name+'?'))return;
-  await fetch('/models/delete/'+name,{{method:'POST'}});location.reload();
+for(const b of document.querySelectorAll('button.del')){{
+  b.addEventListener('click', async () => {{
+    const name = b.dataset.name;  // entity-decoded by the parser, not JS
+    if(!confirm('Delete '+name+'?'))return;
+    await fetch('/models/delete/'+encodeURIComponent(name),{{method:'POST'}});
+    location.reload();
+  }});
 }}
 </script>"""
     return _page("Models", body)
@@ -81,9 +86,24 @@ async function load(){
   const items = await r.json();
   const div = document.getElementById('list');
   if(!Array.isArray(items)||!items.length){div.textContent='no gallery models available';return}
-  div.innerHTML = '<table><tr><th>name</th><th>gallery</th><th></th></tr>'+items.map(m=>
-    `<tr><td>${m.name}</td><td>${m.gallery||''}</td>
-     <td><button onclick="install('${m.gallery?m.gallery+'@':''}${m.name}', this)">install</button></td></tr>`).join('')+'</table>';
+  // DOM construction with textContent: gallery manifests are REMOTE
+  // content — names must never reach innerHTML or JS-string context
+  const table = document.createElement('table');
+  table.innerHTML = '<tr><th>name</th><th>gallery</th><th></th></tr>';
+  for(const m of items){
+    const tr = document.createElement('tr');
+    const td1 = document.createElement('td'); td1.textContent = m.name;
+    const td2 = document.createElement('td'); td2.textContent = m.gallery||'';
+    const td3 = document.createElement('td');
+    const btn = document.createElement('button');
+    btn.textContent = 'install';
+    const id = (m.gallery ? m.gallery + '@' : '') + m.name;
+    btn.addEventListener('click', () => install(id, btn));
+    td3.appendChild(btn);
+    tr.append(td1, td2, td3);
+    table.appendChild(tr);
+  }
+  div.replaceChildren(table);
 }
 async function install(id, btn){
   btn.disabled = true;

@@ -387,6 +387,20 @@ class Engine:
             self._chunk_fns[bucket] = fn
         return fn
 
+    def _get_draft_chunk_fn(self, bucket: int):
+        """Draft-model prompt ingestion (the draft has its OWN config —
+        the target-cfg chunk body would mis-shape or mis-parameterize it)."""
+        key = ("draft", bucket)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, t, s, ck, cv, sl, st: llama.prefill(
+                    p, self.draft_cfg, t, s, ck, cv, sl, st,
+                    continued=True)[1:],
+                donate_argnums=(3, 4))
+            self._chunk_fns[key] = fn
+        return fn
+
     def _get_final_fn(self, bucket: int, batch: int, continued: bool):
         key = (bucket, batch, continued)
         fn = self._final_fns.get(key)
@@ -913,7 +927,7 @@ class Engine:
             if self.draft_params is not None:
                 # mirror the prompt into the draft cache (speculative
                 # rounds need the same context; see engine/speculative.py)
-                self.dck, self.dcv = self._get_chunk_fn(bucket)(
+                self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
                     self.draft_params, tokens, np.array([take], np.int32),
                     self.dck, self.dcv, np.array([slot], np.int32),
                     np.array([s.written], np.int32))
@@ -964,7 +978,7 @@ class Engine:
         out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
         if self.draft_params is not None:
             # draft ingests the same prompt rows (no sampling needed)
-            self.dck, self.dcv = self._get_chunk_fn(bucket)(
+            self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
                 self.draft_params, tokens, seq_len, self.dck, self.dcv,
                 slots_v, start_v)
         # ASYNC: don't sync here — the result would be serialized behind any
@@ -996,7 +1010,11 @@ class Engine:
         self._pending_prefill = None
         ids_np = np.asarray(out_ids)
         lps_np = np.asarray(logprobs)
-        self.mu = np.asarray(mu_out).copy()
+        mu_np = np.asarray(mu_out)
+        # scatter ONLY the group's mu entries: other slots may have evolved
+        # (mirostat decode) while this prefill was in flight
+        for gslot, _snap in group:
+            self.mu[gslot] = mu_np[gslot]
         t1 = time.monotonic()
 
         for b, (gslot, snap) in enumerate(group):

@@ -45,7 +45,10 @@ def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
     C = ck.shape[2]
     dC = dck.shape[2]
 
-    # 1. draft proposes D tokens (its cache ingests current + proposals)
+    # 1. draft proposes D tokens (its cache ingests current + ALL proposals:
+    # D+1 steps so the last proposal's KV row exists when fully accepted —
+    # otherwise the draft cache carries a permanent hole inside the
+    # accepted context and acceptance quality decays)
     def dstep(carry, _):
         tok, dl, dck, dcv = carry
         wl = jnp.where(active, dl, dC)
@@ -53,9 +56,9 @@ def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, dl + active.astype(jnp.int32), dck, dcv), nxt
 
-    (_, _, dck, dcv), drafts = jax.lax.scan(
-        dstep, (tokens, lengths, dck, dcv), None, length=D)
-    drafts = drafts.T                                   # [S, D]
+    (_, _, dck, dcv), proposals = jax.lax.scan(
+        dstep, (tokens, lengths, dck, dcv), None, length=D + 1)
+    drafts = proposals[:D].T                            # [S, D]
 
     # 2. target scores current + proposals in one forward
     tin = jnp.concatenate([tokens[:, None], drafts], axis=1)   # [S, D+1]

@@ -57,6 +57,7 @@ class FederatedServer:
                    if k.lower() not in HOP_HEADERS}
         body = await request.read()
         worker.inflight += 1
+        resp = None
         try:
             async with ClientSession(
                 timeout=ClientTimeout(total=self.timeout_s)
@@ -76,7 +77,17 @@ class FederatedServer:
         except Exception as e:
             worker.failed_at = time.monotonic()
             log.warning("worker %s failed: %s", worker.base, e)
-            raise web.HTTPBadGateway(text=f"worker {worker.base} failed: {e}")
+            if resp is None or not resp.prepared:
+                # nothing on the wire yet: a clean 502 is still possible
+                raise web.HTTPBadGateway(
+                    text=f"worker {worker.base} failed: {e}")
+            # headers/partial body already sent: terminate the stream
+            # instead of raising (a second response would corrupt the wire)
+            import contextlib
+
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+            return resp
         finally:
             worker.inflight -= 1
 

@@ -112,3 +112,34 @@ def test_spec_round_unit():
     assert np.all(np.asarray(lengths) == 4 + D + 1)
     assert np.all(np.asarray(out) >= 0)
     assert np.all(np.asarray(out_lp) <= 0)
+
+
+def test_small_draft_model_different_shape():
+    """The whole point of speculation: a SMALLER draft model (different
+    layer/width config) must work and stay lossless (regression: draft
+    prefill once ran through the target config's chunk body)."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    small = llama.LlamaConfig(
+        vocab_size=258, hidden_size=32, intermediate_size=64, num_layers=1,
+        num_heads=2, num_kv_heads=1, max_position_embeddings=256,
+        dtype=jnp.float32)
+    dparams = llama.init_params(small, jax.random.PRNGKey(1), dtype=jnp.float32)
+
+    e = _engine(params)
+    try:
+        ref = _greedy(e, "small draft prompt", n=16)
+    finally:
+        e.shutdown()
+
+    e = eng.Engine(
+        cfg, params, ByteTokenizer(),
+        eng.EngineConfig(num_slots=2, max_context=128, prefill_buckets=(16, 32),
+                         prefill_chunk=32, cache_dtype=jnp.float32, n_draft=3),
+        draft=(small, dparams))
+    e.start()
+    try:
+        out = _greedy(e, "small draft prompt", n=16)
+    finally:
+        e.shutdown()
+    assert out == ref
