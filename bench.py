@@ -66,9 +66,10 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     from localai_tpu.models import llama
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    if os.environ.get("LOCALAI_BENCH_QUANT", "int8") == "int8":
-        # reference parity: llama.cpp serves quantized GGUF by default;
-        # int8 weight-only halves the dominant HBM term on this chip
+    if os.environ.get("LOCALAI_BENCH_QUANT", "") == "int8":
+        # int8 weight-only wins in isolated decode bursts (~1.8x) but the
+        # serving tunnel's per-op/prefill overheads outweigh it end-to-end,
+        # so bf16 is the default headline; int8 remains opt-in
         params = llama.quantize_params(params)
     ecfg = eng.EngineConfig(num_slots=S, max_context=C,
                             prefill_buckets=(prompt_len, 512),
@@ -239,7 +240,7 @@ def main():
     r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
     print(json.dumps({
         "metric": (f"serving_tok_s_per_chip_llama_{preset}_"
-                   f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', 'int8') == 'int8' else 'bf16'}"
+                   f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', '') == 'int8' else 'bf16'}"
                    f"_slots{S}"),
         "value": round(r["tok_s"], 1), "unit": "tok/s",
         "vs_baseline": round(r["tok_s"] / 2000.0, 3),
