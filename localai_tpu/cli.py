@@ -78,6 +78,12 @@ def _add_simple(sub):
     f.add_argument("--load-balancing-strategy", default="random",
                    choices=["random", "least_number_of_requests"])
 
+    x = sub.add_parser("explorer",
+                       help="dashboard over registered federation endpoints")
+    x.add_argument("--address", default="127.0.0.1:8080")
+    x.add_argument("--db-path", default="explorer.json")
+    x.add_argument("--poll-interval", type=float, default=30.0)
+
 
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="localai-tpu")
@@ -202,6 +208,16 @@ def main(argv=None):
         try:
             asyncio.run(fed_serve(workers, args.address,
                                   args.load_balancing_strategy))
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.cmd == "explorer":
+        from localai_tpu.explorer import serve as ex_serve
+
+        try:
+            asyncio.run(ex_serve(args.address, args.db_path,
+                                 args.poll_interval))
         except KeyboardInterrupt:
             pass
         return 0

@@ -1,0 +1,161 @@
+"""Explorer: a public dashboard over registered federation endpoints.
+
+Capability parity with the reference's explorer (reference:
+core/explorer/discovery.go:16-43 + database.go — a JSON-file registry of
+network tokens, a background loop that dials each network, counts its
+workers, and drops entries that fail repeatedly; served as a dashboard).
+The TPU design registers federation-front URLs instead of libp2p tokens
+(discovery is explicit — see federation.py) and polls their
+/federation/status endpoints.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import threading
+import time
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+log = logging.getLogger("localai_tpu.explorer")
+
+FAILURE_LIMIT = 3  # drop an endpoint after this many consecutive failures
+                   # (reference: explorer drops tokens failing 3x,
+                   # discovery.go:116-134)
+
+
+class ExplorerDB:
+    """JSON-file registry of federation endpoints."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.lock = threading.Lock()
+        self.entries: dict = {}   # url -> {"failures": int, "workers": [...],
+                                  #         "last_seen": float}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.entries = json.load(f)
+            except Exception:
+                log.exception("invalid explorer db %s", path)
+
+    def save(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump(self.entries, f)
+
+    def register(self, url: str):
+        with self.lock:
+            self.entries.setdefault(url.rstrip("/"), {
+                "failures": 0, "workers": [], "last_seen": 0.0})
+            self.save()
+
+    def drop(self, url: str):
+        with self.lock:
+            self.entries.pop(url, None)
+            self.save()
+
+
+class Explorer:
+    def __init__(self, db: ExplorerDB, poll_interval_s: float = 30.0):
+        self.db = db
+        self.poll_interval_s = poll_interval_s
+
+    async def poll_once(self):
+        urls = list(self.db.entries)
+        async with ClientSession(timeout=ClientTimeout(total=10)) as session:
+            for url in urls:
+                try:
+                    async with session.get(url + "/federation/status") as r:
+                        r.raise_for_status()
+                        status = await r.json()
+                    with self.db.lock:
+                        e = self.db.entries.get(url)
+                        if e is not None:
+                            e["failures"] = 0
+                            e["workers"] = status.get("workers", [])
+                            e["last_seen"] = time.time()
+                            self.db.save()
+                except Exception:
+                    with self.db.lock:
+                        e = self.db.entries.get(url)
+                        if e is None:
+                            continue
+                        e["failures"] += 1
+                        dead = e["failures"] >= FAILURE_LIMIT
+                    if dead:
+                        log.info("dropping failing network %s", url)
+                        self.db.drop(url)
+
+    async def _poll_loop(self):
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:
+                log.exception("explorer poll failed")
+            await asyncio.sleep(self.poll_interval_s)
+
+    # ---- http ----
+
+    async def register(self, request):
+        body = await request.json()
+        url = (body.get("url") or "").strip()
+        if not url.startswith(("http://", "https://")):
+            raise web.HTTPBadRequest(text="url must be http(s)")
+        self.db.register(url)
+        await self.poll_once()
+        return web.json_response({"registered": url})
+
+    async def networks(self, request):
+        with self.db.lock:
+            data = [{"url": u,
+                     "workers": e.get("workers", []),
+                     "online_workers": sum(1 for w in e.get("workers", [])
+                                           if w.get("online")),
+                     "last_seen": e.get("last_seen", 0.0),
+                     "failures": e.get("failures", 0)}
+                    for u, e in self.db.entries.items()]
+        return web.json_response({"networks": data})
+
+    async def dashboard(self, request):
+        html = """<!doctype html><html><head><meta charset="utf-8">
+<title>LocalAI TPU explorer</title>
+<style>body{font-family:system-ui;margin:24px}td,th{padding:6px 10px;
+border-bottom:1px solid #ddd;text-align:left}</style></head><body>
+<h1>Federated networks</h1><div id="out">loading…</div>
+<script>
+fetch('/networks').then(r=>r.json()).then(j=>{
+  const t = document.createElement('table');
+  t.innerHTML = '<tr><th>network</th><th>workers online</th><th>last seen</th></tr>';
+  for(const n of j.networks){
+    const tr = document.createElement('tr');
+    const a = document.createElement('td'); a.textContent = n.url;
+    const b = document.createElement('td');
+    b.textContent = n.online_workers + ' / ' + n.workers.length;
+    const c = document.createElement('td');
+    c.textContent = n.last_seen ? new Date(n.last_seen*1000).toISOString() : 'never';
+    tr.append(a,b,c); t.appendChild(tr);
+  }
+  document.getElementById('out').replaceChildren(t);
+});
+</script></body></html>"""
+        return web.Response(text=html, content_type="text/html")
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.dashboard)
+        app.router.add_get("/networks", self.networks)
+        app.router.add_post("/register", self.register)
+        return app
+
+
+async def serve(address: str, db_path: str, poll_interval_s: float = 30.0):
+    from localai_tpu.api.app import run_app
+
+    ex = Explorer(ExplorerDB(db_path), poll_interval_s)
+    await run_app(ex.build_app(), address)
+    log.info("explorer listening on %s (db %s)", address, db_path)
+    await ex._poll_loop()

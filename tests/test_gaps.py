@@ -219,3 +219,40 @@ def test_ollama_pull_from_registry(tmp_path, monkeypatch):
     dest2 = str(tmp_path / "model2.bin")
     dl.download_file(f"oci://127.0.0.1:{port}/org/model:v1", dest2)
     assert open(dest2, "rb").read() == blob
+
+
+# ---------- explorer ----------
+
+def test_explorer_registers_polls_and_drops(tmp_path):
+    from localai_tpu.explorer import Explorer, ExplorerDB
+    from localai_tpu.federation import FederatedServer
+
+    pw, pf, pe = free_port(), free_port(), free_port()
+    _run_app_bg(_tiny_worker("w1"), pw)
+    fed = FederatedServer([f"http://127.0.0.1:{pw}"])
+    _run_app_bg(fed.build_app(), pf)
+
+    db = ExplorerDB(str(tmp_path / "explorer.json"))
+    ex = Explorer(db, poll_interval_s=999)
+    _run_app_bg(ex.build_app(), pe)
+
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pe}", timeout=30)
+    r = c.post("/register", json={"url": f"http://127.0.0.1:{pf}"})
+    assert r.status_code == 200
+
+    nets = c.get("/networks").json()["networks"]
+    assert len(nets) == 1
+    assert nets[0]["online_workers"] == 1
+    assert "Federated networks" in c.get("/").text
+
+    # a dead endpoint is dropped after FAILURE_LIMIT polls
+    db.register("http://127.0.0.1:1")
+    for _ in range(3):
+        asyncio.run(ex.poll_once())
+    urls = [n["url"] for n in c.get("/networks").json()["networks"]]
+    assert "http://127.0.0.1:1" not in urls
+    assert f"http://127.0.0.1:{pf}" in urls
+
+    # registry persists across restarts (reference: JSON file DB)
+    db2 = ExplorerDB(str(tmp_path / "explorer.json"))
+    assert f"http://127.0.0.1:{pf}" in db2.entries
