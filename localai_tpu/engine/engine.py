@@ -22,9 +22,9 @@ compilation model:
     equivalent of the reference's KV surgery (llama_kv_cache_seq_rm/add,
     grpc-server.cpp:1832,1916-1927), which XLA's immutable buffers and
     RoPE'd keys make the honest TPU design.
-  * Sampling (full per-slot parameter suite) and the penalty-histogram
-    update are fused INTO the compiled steps — no per-token host round-trip
-    for anything but the sampled ids themselves.
+  * Sampling (full per-slot parameter suite) and the penalty-ring update
+    are fused INTO the compiled steps — no per-token host round-trip for
+    anything but the sampled ids themselves.
   * Admission/stop logic runs host-side on a dedicated engine thread,
     mirroring the reference's queue thread (grpc-server.cpp:2083-2096).
 """
