@@ -157,7 +157,7 @@ send.onclick = async () => {{
       }} catch(e) {{}}
     }}
     buf = buf.slice(buf.lastIndexOf('\\n')+1);
-    out.textContent = out.textContent.replace(/assistant: [^]*$/, 'assistant: '+reply);
+    out.textContent = out.textContent.replace(/assistant: [^]*$/, () => 'assistant: '+reply);
   }}
   out.textContent += '\\n'; hist.push({{role:'assistant', content:reply}});
   st.textContent='';

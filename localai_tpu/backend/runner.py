@@ -11,6 +11,7 @@ Run: python -m localai_tpu.backend.runner --addr 127.0.0.1:PORT
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import os
@@ -94,7 +95,18 @@ class EngineServicer(BackendServicer):
         dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}.get(
             request.dtype or "bfloat16", jnp.bfloat16
         )
-        cfg = llama.LlamaConfig.from_json(os.path.join(model_dir, "config.json"), dtype=dtype)
+        gguf_path = weights.find_gguf(model_dir)
+        if gguf_path is not None:
+            # GGUF checkpoint (ollama://, oci:// or gallery pull): config
+            # and tokenizer come from the file's own metadata
+            from localai_tpu.engine import gguf as gguflib
+
+            cfg = dataclasses.replace(
+                gguflib.config_from_gguf(gguflib.open_gguf(gguf_path)),
+                dtype=dtype)
+        else:
+            cfg = llama.LlamaConfig.from_json(
+                os.path.join(model_dir, "config.json"), dtype=dtype)
 
         n_dev = len(jax.devices())
         tp = request.mesh_tp or n_dev
@@ -108,10 +120,15 @@ class EngineServicer(BackendServicer):
             quantize=request.quantization or
             ("int8" if request.dtype == "int8" else ""))
 
-        from transformers import AutoTokenizer
+        if gguf_path is not None and not request.tokenizer:
+            from localai_tpu.engine import gguf_tokenizer
 
-        tok_dir = request.tokenizer or model_dir
-        self.tokenizer = AutoTokenizer.from_pretrained(tok_dir)
+            self.tokenizer = gguf_tokenizer.from_gguf(gguf_path)
+        else:
+            from transformers import AutoTokenizer
+
+            tok_dir = request.tokenizer or model_dir
+            self.tokenizer = AutoTokenizer.from_pretrained(tok_dir)
 
         ecfg = eng.EngineConfig(
             num_slots=request.num_slots or 8,
@@ -123,8 +140,15 @@ class EngineServicer(BackendServicer):
             ddir = request.draft_model
             if request.model_path and not os.path.isabs(ddir):
                 ddir = os.path.join(request.model_path, ddir)
-            dcfg = llama.LlamaConfig.from_json(os.path.join(ddir, "config.json"),
-                                               dtype=dtype)
+            dgguf = weights.find_gguf(ddir)
+            if dgguf is not None:
+                from localai_tpu.engine import gguf as gguflib
+
+                dcfg = dataclasses.replace(gguflib.config_from_gguf(
+                    gguflib.open_gguf(dgguf)), dtype=dtype)
+            else:
+                dcfg = llama.LlamaConfig.from_json(
+                    os.path.join(ddir, "config.json"), dtype=dtype)
             dparams = weights.load_llama_params(
                 ddir, dcfg, mesh=mesh, dtype=dtype,
                 quantize=request.quantization or
@@ -246,6 +270,10 @@ class EngineServicer(BackendServicer):
                 message=ev.text.encode("utf-8"),
                 token_id=ev.token_id,
                 logprob=ev.logprob,
+                # burst-coalesced chunks: every member token (engine emits
+                # one event per slot per decode burst)
+                token_ids=ev.token_ids or ([ev.token_id] if ev.token_id >= 0 else []),
+                logprobs=ev.logprobs or ([ev.logprob] if ev.token_id >= 0 else []),
                 tokens=ev.completion_tokens,
                 prompt_tokens=ev.prompt_tokens,
                 finish_reason=ev.finish_reason or "",

@@ -104,6 +104,9 @@ class TokenChunk:
     finish_reason: str = ""
     completion_tokens: int = 0
     prompt_tokens: int = 0
+    # every member token of a burst-coalesced chunk (token_id is the last)
+    token_ids: Optional[list] = None
+    logprobs: Optional[list] = None
 
 
 class Capabilities:
@@ -150,6 +153,8 @@ class Capabilities:
                     finish_reason=reply.finish_reason,
                     completion_tokens=reply.tokens,
                     prompt_tokens=reply.prompt_tokens,
+                    token_ids=list(reply.token_ids) or None,
+                    logprobs=list(reply.logprobs) or None,
                 )
         finally:
             lm.mark_idle()

@@ -101,6 +101,34 @@ class StreamEvent:
     completion_tokens: int = 0
     timings: Optional[dict] = None
     error: Optional[str] = None
+    # burst-coalesced events carry every member token (r3: emitting one
+    # queue event per token cost ~0.35 ms/token of host time on the 1-core
+    # serving host — GIL/wakeup churn — and serialized against the next
+    # dispatch; the engine now emits ONE event per slot per processed
+    # burst). token_id/logprob above are the LAST member's.
+    token_ids: Optional[list] = None
+    logprobs: Optional[list] = None
+
+
+def event_ids(events) -> list:
+    """Flatten a stream of (possibly coalesced) events to token ids."""
+    out = []
+    for e in events:
+        if e.token_ids:
+            out.extend(e.token_ids)
+        elif e.token_id >= 0:
+            out.append(e.token_id)
+    return out
+
+
+def _merge_events(evs: list) -> StreamEvent:
+    last = evs[-1]
+    return dataclasses.replace(
+        last,
+        text="".join(e.text for e in evs),
+        token_ids=[e.token_id for e in evs],
+        logprobs=[e.logprob for e in evs],
+    )
 
 
 class _Burst:
@@ -233,8 +261,10 @@ class Engine:
         self._chain = None
         self._chain_dirty = True
         self._inflight: Optional[_Burst] = None
-        # async prefill: one final-prefill group may be awaiting its results
-        self._pending_prefill: Optional[tuple] = None
+        # async prefill: up to TWO final-prefill groups may be in flight
+        # (FIFO) — a second group dispatches while the first computes, so
+        # wave turnover isn't serialized through one pending slot
+        self._pending_prefill: list = []
 
         # effective prefill buckets always include the chunk size; both are
         # clamped to the cache capacity (a bucket larger than max_context
@@ -245,13 +275,33 @@ class Engine:
             + [self._chunk])))
         # fresh final prefills batch up to this many prompts per dispatch
         # (padded by repeating the last entry, so only two compiled batch
-        # sizes exist per bucket: 1 and _final_pad)
-        self._final_pad = 8
+        # sizes exist per bucket: 1 and _final_pad). Sized for the wave-
+        # turnover case (r3 trace: all slots finishing together serialized
+        # 4 groups of 8 through one pending slot, stalling the device ~1s
+        # per wave): one group should swallow half the fleet.
+        self._final_pad = max(8, min(16, self.ecfg.num_slots))
 
         # grammar-constrained decoding (lazy: built on first grammar request)
         self._grammar_cache: dict[str, Any] = {}
         self._mask_builder = None
         self._token_strs: Optional[list] = None
+
+        # loop-phase tracing (LOCALAI_ENGINE_TRACE=1): cumulative seconds
+        # per phase + counts, dumped at shutdown — the tool that found the
+        # r3 serving-vs-kernel gap
+        import os as _os
+
+        self._trace = _os.environ.get("LOCALAI_ENGINE_TRACE", "") == "1"
+        self._tstats: dict = {}
+        # non-None while _process_burst coalesces per-slot events
+        self._sink_buf: Optional[dict] = None
+
+    def _tmark(self, key: str, t0: float):
+        if self._trace:
+            t = time.monotonic()
+            s = self._tstats.setdefault(key, [0.0, 0])
+            s[0] += t - t0
+            s[1] += 1
 
     def _make_state_shardings(self) -> Optional[dict]:
         """NamedShardings for the engine's device state when serving on a
@@ -495,6 +545,16 @@ class Engine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._trace and self._tstats:
+            import sys
+
+            total = sum(v[0] for k, v in self._tstats.items()
+                        if k != "burst_steps")
+            for k, (sec, n) in sorted(self._tstats.items(),
+                                      key=lambda kv: -kv[1][0]):
+                print(f"[engine-trace] {k:14s} {sec:8.2f}s n={n:<7d} "
+                      f"avg={sec/max(n,1)*1e3:7.2f}ms", file=sys.stderr)
+            print(f"[engine-trace] traced total {total:.2f}s", file=sys.stderr)
         # close every consumer: queued requests and still-active slots
         while True:
             try:
@@ -537,7 +597,7 @@ class Engine:
         self._chain = None
         self._chain_dirty = True
         self._inflight = None
-        self._pending_prefill = None
+        self._pending_prefill = []
 
     def submit(self, req: GenRequest) -> "queue.Queue":
         self._queue.put(req)
@@ -670,16 +730,24 @@ class Engine:
         log = logging.getLogger(__name__)
         while not self._stop:
             try:
+                t0 = time.monotonic()
                 admitted = self._admit()
+                self._tmark("admit", t0)
+                t0 = time.monotonic()
                 prefilled = self._prefill_step()
+                self._tmark("prefill", t0)
+                t0 = time.monotonic()
                 finalized = self._maybe_finalize_prefill()
+                self._tmark("finalize", t0)
                 decoding = any(s is not None and s.phase == "decode"
                                for s in self.slots)
                 if decoding:
                     if self._spec_ready():
                         self._spec_once()
                     else:
+                        t0 = time.monotonic()
                         self._decode_once()
+                        self._tmark("decode_once", t0)
                 else:
                     if self._inflight is not None:
                         # every participant finished during processing of the
@@ -687,9 +755,11 @@ class Engine:
                         # tokens can never leak into a re-admitted slot
                         self._process_burst(self._inflight)
                         self._inflight = None
-                    if self._pending_prefill is not None:
+                    if self._pending_prefill:
                         # nothing else to run — block on the prefill result
+                        t0 = time.monotonic()
                         self._maybe_finalize_prefill(block=True)
+                        self._tmark("finalize_block", t0)
                     elif not (admitted or prefilled or finalized):
                         self._wake.wait(timeout=0.05)
                         self._wake.clear()
@@ -887,10 +957,10 @@ class Engine:
         reference packs all prompt chunks into one llama_batch
         (grpc-server.cpp:1671+); per-prompt dispatches cost ~150ms of
         overhead each on the serving tunnel. Long-prompt (chunked) and
-        continued (prefix-reuse) prefills go singly. At most one final
-        group is in flight at a time (see _maybe_finalize_prefill).
+        continued (prefix-reuse) prefills go singly. Up to TWO final
+        groups are in flight at a time (see _maybe_finalize_prefill).
         """
-        if self._pending_prefill is not None:
+        if len(self._pending_prefill) >= 2:
             return False
         while self._prefill_queue:
             slot = self._prefill_queue[0]
@@ -993,28 +1063,34 @@ class Engine:
             gs.written += gtake
             if gslot in self._prefill_queue:
                 self._prefill_queue.remove(gslot)
-        self._pending_prefill = (
+        self._pending_prefill.append((
             [(gslot, self.slots[gslot]) for gslot, _ in group],
-            out_ids, logprobs, mu_out, t0)
+            out_ids, logprobs, mu_out, t0))
         return True
 
     def _maybe_finalize_prefill(self, block: bool = False) -> bool:
-        """Activate a dispatched final-prefill group once its first tokens
-        are available (or immediately when ``block``)."""
-        pp = self._pending_prefill
-        if pp is None:
+        """Activate the oldest dispatched final-prefill group once its first
+        tokens are available (or immediately when ``block``)."""
+        if not self._pending_prefill:
             return False
-        group, out_ids, logprobs, mu_out, t0 = pp
-        if not block and not out_ids.is_ready():
+        group, out_ids, logprobs, mu_out, t0 = self._pending_prefill[0]
+        tr = time.monotonic()
+        ready = out_ids.is_ready()
+        self._tmark("finalize_poll", tr)
+        if not block and not ready:
             return False
-        self._pending_prefill = None
+        self._pending_prefill.pop(0)
+        tr = time.monotonic()
         ids_np = np.asarray(out_ids)
         lps_np = np.asarray(logprobs)
         mu_np = np.asarray(mu_out)
-        # scatter ONLY the group's mu entries: other slots may have evolved
-        # (mirostat decode) while this prefill was in flight
-        for gslot, _snap in group:
-            self.mu[gslot] = mu_np[gslot]
+        self._tmark("finalize_sync", tr)
+        # scatter ONLY the group's mu entries — and only where the slot
+        # still belongs to the dispatched request: a cancel + re-admit while
+        # the prefill was in flight must not inherit the stale mu
+        for gslot, snap in group:
+            if self.slots[gslot] is snap:
+                self.mu[gslot] = mu_np[gslot]
         t1 = time.monotonic()
 
         for b, (gslot, snap) in enumerate(group):
@@ -1161,6 +1237,7 @@ class Engine:
             # use the full sampler rather than compiling mid-request
             flags = (True, True, True)
         fn = self._get_burst_fn(n_steps, flags)
+        t_d = time.monotonic()
         if self._chain_dirty or self._chain is None:
             # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
             # (observed on the CPU client) — an in-flight dispatch holding
@@ -1186,11 +1263,18 @@ class Engine:
             self.active_dev.copy(), mu,
         )
         self._chain_dirty = False
+        self._tmark("dispatch", t_d)
+        if self._trace:
+            s = self._tstats.setdefault("burst_steps", [0.0, 0])
+            s[0] += n_steps
+            s[1] += 1
         prev, self._inflight = self._inflight, _Burst(n_steps, burst_slots,
                                                       ids_all, lps_all,
                                                       self._chain[4])
         if prev is not None:
+            t0 = time.monotonic()
             self._process_burst(prev)
+            self._tmark("process_prev", t0)
         if grammar_sync:
             self._process_burst(self._inflight)
             self._inflight = None
@@ -1204,7 +1288,9 @@ class Engine:
         emission is separate so it can overlap the NEXT dispatch."""
         if b.folded:
             return
+        t0 = time.monotonic()
         b.ids_np = np.asarray(b.ids_all)    # [K, S]
+        self._tmark("burst_sync", t0)
         b.lps_np = np.asarray(b.lps_all)
         mu_np = np.asarray(b.mu_out)
         live_idx = [i for i, snap in b.slots if self._live(i, snap)]
@@ -1219,15 +1305,26 @@ class Engine:
     def _process_burst(self, b: "_Burst"):
         """Fold (if not already) then emit a burst's tokens (emission may
         release slots or trigger context shifts — both mark the device
-        chain dirty)."""
+        chain dirty). Per-slot events are COALESCED into one queue put per
+        burst (see StreamEvent.token_ids)."""
         self._fold_burst(b)
-        for j in range(b.n_steps):
-            for i, snap in b.slots:
-                if not self._live(i, snap):
-                    continue  # finished/shifted/replaced
-                # the step just wrote this slot's previous token's KV row
-                snap.committed = min(snap.committed + 1, snap.cache_len)
-                self._emit_token(i, int(b.ids_np[j, i]), float(b.lps_np[j, i]))
+        t0 = time.monotonic()
+        self._sink_buf = {}
+        try:
+            for j in range(b.n_steps):
+                for i, snap in b.slots:
+                    if not self._live(i, snap):
+                        continue  # finished/shifted/replaced
+                    # the step just wrote this slot's previous token's KV row
+                    snap.committed = min(snap.committed + 1, snap.cache_len)
+                    self._emit_token(i, int(b.ids_np[j, i]), float(b.lps_np[j, i]))
+        finally:
+            buf, self._sink_buf = self._sink_buf, None
+            self._tmark("emit_loop", t0)
+            t0 = time.monotonic()
+            for (_slot, out), evs in buf.items():
+                out.put(evs[0] if len(evs) == 1 else _merge_events(evs))
+            self._tmark("emit_flush", t0)
 
     def _emit_token(self, slot: int, token_id: int, logprob: float):
         s = self.slots[slot]
@@ -1289,6 +1386,7 @@ class Engine:
             finish_reason=finish,
             prompt_tokens=s.prompt_len, completion_tokens=s.n_decoded,
         )
+        buf = self._sink_buf
         if finish:
             dt = time.monotonic() - s.t_first_token
             ev.timings = {
@@ -1297,8 +1395,14 @@ class Engine:
                 "decode_tokens_per_s": (s.n_decoded - 1) / dt if dt > 0 and s.n_decoded > 1 else 0.0,
             }
             self._release_slot(slot)
+            if buf is not None:
+                evs = buf.pop((slot, s.req.out), None)
+                if evs:
+                    s.req.out.put(evs[0] if len(evs) == 1 else _merge_events(evs))
             s.req.out.put(ev)
             s.req.out.put(None)
+        elif buf is not None:
+            buf.setdefault((slot, s.req.out), []).append(ev)
         else:
             s.req.out.put(ev)
 

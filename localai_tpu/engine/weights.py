@@ -45,32 +45,31 @@ def _open_shards(model_dir: str):
     return name_to_file
 
 
-def load_llama_params(
-    model_dir: str,
-    cfg,
-    mesh=None,
-    dtype=jnp.bfloat16,
-    quantize: str = "",
-) -> dict:
-    """Load HF llama/mistral/qwen2-style weights into the stacked pytree.
+def find_gguf(model_dir: str) -> Optional[str]:
+    """Path to the GGUF file a model dir/path refers to, if any: either the
+    path itself or the single *.gguf inside a directory with no safetensors
+    (the shape an ``ollama://`` / gallery pull produces)."""
+    if model_dir.endswith(".gguf") and os.path.isfile(model_dir):
+        return model_dir
+    if os.path.isdir(model_dir):
+        ggufs = sorted(glob.glob(os.path.join(model_dir, "*.gguf")))
+        sts = glob.glob(os.path.join(model_dir, "*.safetensors"))
+        if len(ggufs) == 1 and not sts:
+            return ggufs[0]
+    return None
 
-    When ``mesh`` is given, each leaf is placed with the tensor-parallel
-    sharding from parallel/sharding.py as it is assembled. quantize="int8"
-    converts matmul weights to weight-only per-channel int8 at load time
-    (reference parity: quantized GGUF serving).
-    """
-    tensors = _open_shards(model_dir)
 
-    quant_names = {"embed", "lm_head", "wq", "wk", "wv", "wo",
-                   "w_gate", "w_up", "w_down"}
+_QUANT_NAMES = {"embed", "lm_head", "wq", "wk", "wv", "wo",
+                "w_gate", "w_up", "w_down"}
 
-    def get(name: str) -> np.ndarray:
-        h = tensors[name]
-        return h.get_tensor(name)
 
-    def put(arr: np.ndarray, spec_path: Optional[tuple] = None):
+def _make_put(cfg, mesh, dtype, quantize):
+    """Leaf placer: host array + pytree path -> cast / int8-quantized /
+    mesh-sharded device leaf."""
+
+    def put(arr: np.ndarray, spec_path: tuple):
         leaf_name = spec_path[-1]
-        if quantize == "int8" and leaf_name in quant_names:
+        if quantize == "int8" and leaf_name in _QUANT_NAMES:
             from localai_tpu.models.llama import quantize_params
 
             leaf = quantize_params({leaf_name: arr})[leaf_name]
@@ -91,6 +90,51 @@ def load_llama_params(
                 return {"q": q, "s": s}
             return jax.device_put(leaf, NamedSharding(mesh, node))
         return leaf
+
+    return put
+
+
+def load_llama_params(
+    model_dir: str,
+    cfg,
+    mesh=None,
+    dtype=jnp.bfloat16,
+    quantize: str = "",
+) -> dict:
+    """Load HF llama/mistral/qwen2-style weights into the stacked pytree.
+
+    When ``mesh`` is given, each leaf is placed with the tensor-parallel
+    sharding from parallel/sharding.py as it is assembled. quantize="int8"
+    converts matmul weights to weight-only per-channel int8 at load time
+    (reference parity: quantized GGUF serving).
+
+    GGUF checkpoints (a .gguf path, or a dir holding one — what the
+    ``ollama://``/``oci://`` puller produces) are dequantized host-side by
+    engine/gguf.py and flow through the same cast/quantize/place path.
+    """
+    gguf_path = find_gguf(model_dir)
+    if gguf_path is not None:
+        from localai_tpu.engine import gguf as gguflib
+
+        g = gguflib.open_gguf(gguf_path)
+        put = _make_put(cfg, mesh, dtype, quantize)
+        params: dict = {"layers": {}}
+        # leaf-at-a-time: dequantize (f16 host), place on device, free —
+        # peak host memory is one stacked leaf, not the dense model
+        for spec_path, arr in gguflib.iter_llama_tensors(g, cfg):
+            node = params
+            for k in spec_path[:-1]:
+                node = node[k]
+            node[spec_path[-1]] = put(arr, spec_path)
+            del arr
+        return params
+    tensors = _open_shards(model_dir)
+
+    def get(name: str) -> np.ndarray:
+        h = tensors[name]
+        return h.get_tensor(name)
+
+    put = _make_put(cfg, mesh, dtype, quantize)
 
     L = cfg.num_layers
 

@@ -12,15 +12,78 @@ The TPU design registers federation-front URLs instead of libp2p tokens
 from __future__ import annotations
 
 import asyncio
+import ipaddress
 import json
 import logging
 import os
+import socket
 import threading
 import time
+from urllib.parse import urlsplit
 
-from aiohttp import ClientSession, ClientTimeout, web
+from typing import Optional
+
+from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
+from aiohttp.abc import AbstractResolver
 
 log = logging.getLogger("localai_tpu.explorer")
+
+
+def _is_public_ip(text: str) -> bool:
+    try:
+        addr = ipaddress.ip_address(text)
+    except ValueError:
+        return False
+    return not (addr.is_private or addr.is_loopback or addr.is_link_local
+                or addr.is_reserved or addr.is_multicast)
+
+
+def resolve_public_ip(url: str) -> Optional[str]:
+    """Resolve the URL's host ONCE and return a public IP, or None when it
+    only resolves to private / loopback / link-local addresses (or not at
+    all). The caller must CONNECT TO THE RETURNED IP (pinned) — re-resolving
+    at request time reopens the DNS-rebinding window this exists to close."""
+    host = urlsplit(url).hostname
+    if not host:
+        return None
+    if _is_public_ip(host):
+        return host
+    try:
+        infos = socket.getaddrinfo(host, None)
+    except OSError:
+        return None  # unresolvable: don't poll it
+    for info in infos:
+        if _is_public_ip(info[4][0]):
+            return info[4][0]
+    return None
+
+
+def url_resolves_private(url: str) -> bool:
+    """True when the URL's host resolves ONLY to private / loopback /
+    link-local addresses. Registration makes the explorer issue server-side
+    GETs to the URL every poll — an unauthenticated endpoint accepting
+    arbitrary targets is an SSRF probe of internal networks and metadata
+    services, so private targets are rejected unless explicitly allowed."""
+    return resolve_public_ip(url) is None
+
+
+class _PinnedResolver(AbstractResolver):
+    """aiohttp resolver answering from a prevetted host->IP map, so the
+    connection goes to the address the guard actually checked."""
+
+    def __init__(self, mapping: dict):
+        self.mapping = mapping
+
+    async def resolve(self, host, port=0, family=socket.AF_INET):
+        ip = self.mapping.get(host)
+        if ip is None:
+            raise OSError(f"{host}: not in pinned map")
+        return [{"hostname": host, "host": ip, "port": port,
+                 "family": socket.AF_INET6 if ":" in ip else socket.AF_INET,
+                 "proto": 0, "flags": 0}]
+
+    async def close(self):
+        pass
 
 FAILURE_LIMIT = 3  # drop an endpoint after this many consecutive failures
                    # (reference: explorer drops tokens failing 3x,
@@ -60,16 +123,42 @@ class ExplorerDB:
 
 
 class Explorer:
-    def __init__(self, db: ExplorerDB, poll_interval_s: float = 30.0):
+    def __init__(self, db: ExplorerDB, poll_interval_s: float = 30.0,
+                 token: str = "", allow_private: bool = False):
         self.db = db
         self.poll_interval_s = poll_interval_s
+        # registration guardrails: optional bearer token, and private-range
+        # targets rejected by default (see url_resolves_private)
+        self.token = token
+        self.allow_private = allow_private
 
     async def poll_once(self):
         urls = list(self.db.entries)
-        async with ClientSession(timeout=ClientTimeout(total=10)) as session:
+        # resolve every host ONCE (off the event loop) and pin connections
+        # to the vetted IPs: checking and then letting aiohttp re-resolve
+        # would reopen the DNS-rebinding window (TTL-0 public/private
+        # flip-flop between check and connect); redirects are refused for
+        # the same reason (a public host 302-ing to metadata endpoints)
+        pinned: dict = {}
+        if not self.allow_private:
+            for url in urls:
+                host = urlsplit(url).hostname
+                if host:
+                    ip = await asyncio.to_thread(resolve_public_ip, url)
+                    if ip is not None:
+                        pinned[host] = ip
+            connector = TCPConnector(resolver=_PinnedResolver(pinned))
+        else:
+            connector = None
+        async with ClientSession(timeout=ClientTimeout(total=10),
+                                 connector=connector) as session:
             for url in urls:
                 try:
-                    async with session.get(url + "/federation/status") as r:
+                    if not self.allow_private and \
+                            urlsplit(url).hostname not in pinned:
+                        raise ValueError("resolves private")
+                    async with session.get(url + "/federation/status",
+                                           allow_redirects=self.allow_private) as r:
                         r.raise_for_status()
                         status = await r.json()
                     with self.db.lock:
@@ -102,9 +191,21 @@ class Explorer:
 
     async def register(self, request):
         body = await request.json()
+        if self.token:
+            auth = request.headers.get("Authorization", "")
+            presented = (auth[7:] if auth.startswith("Bearer ")
+                         else body.get("token", ""))
+            if presented != self.token:
+                raise web.HTTPUnauthorized(text="registration token required")
         url = (body.get("url") or "").strip()
         if not url.startswith(("http://", "https://")):
             raise web.HTTPBadRequest(text="url must be http(s)")
+        # getaddrinfo can block for seconds on dead resolvers — keep it off
+        # the event loop
+        if not self.allow_private and await asyncio.to_thread(
+                url_resolves_private, url):
+            raise web.HTTPForbidden(
+                text="url resolves to a private/loopback address")
         self.db.register(url)
         await self.poll_once()
         return web.json_response({"registered": url})
@@ -155,7 +256,11 @@ fetch('/networks').then(r=>r.json()).then(j=>{
 async def serve(address: str, db_path: str, poll_interval_s: float = 30.0):
     from localai_tpu.api.app import run_app
 
-    ex = Explorer(ExplorerDB(db_path), poll_interval_s)
+    ex = Explorer(
+        ExplorerDB(db_path), poll_interval_s,
+        token=os.environ.get("LOCALAI_EXPLORER_TOKEN", ""),
+        allow_private=os.environ.get(
+            "LOCALAI_EXPLORER_ALLOW_PRIVATE", "") == "1")
     await run_app(ex.build_app(), address)
     log.info("explorer listening on %s (db %s)", address, db_path)
     await ex._poll_loop()

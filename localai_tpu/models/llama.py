@@ -36,8 +36,32 @@ from localai_tpu.ops.norms import rms_norm
 from localai_tpu.ops.attention import (
     causal_attention,
     decode_attention,
+    decode_attention_append,
     mixed_prefill_attention,
 )
+
+
+def _decode_attn_mode() -> str:
+    """LOCALAI_DECODE_ATTN: scatter (default, fastest measured on the
+    serving chip) | append | pallas."""
+    import os
+
+    return os.environ.get("LOCALAI_DECODE_ATTN", "scatter")
+
+
+def _pallas_decode() -> bool:
+    """Use the Pallas decode-attention kernel on real TPU backends (the
+    jnp path suffers XLA relayout copies there — see ops/pallas/
+    decode_attention.py). CPU (tests, virtual meshes) uses the jnp
+    reference implementation."""
+    import os
+
+    if os.environ.get("LOCALAI_NO_PALLAS", "") == "1":
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,6 +204,23 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
+def dequantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
+    """Inverse of quantize_params: int8 {q, s} leaves back to dense float
+    (used by the train step — gradients need float leaves)."""
+    def dq(leaf):
+        if isinstance(leaf, dict) and set(leaf) == {"q", "s"}:
+            return _mat(leaf, dtype)
+        return leaf
+
+    out = {}
+    for name, leaf in params.items():
+        if name == "layers":
+            out[name] = {k: dq(v) for k, v in leaf.items()}
+        else:
+            out[name] = dq(leaf)
+    return out
+
+
 def _project_qkv(x, layer, cfg: LlamaConfig):
     """x: [B, T, D] -> q [B,T,H,hd], k/v [B,T,KV,hd]."""
     B, T, _ = x.shape
@@ -254,6 +295,18 @@ def prefill(
         q, k, v = _project_qkv(h, layer, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
+        if continued:
+            # continued prefix: committed keys live in the cache. Rows are
+            # read BEFORE this chunk's scatter (attention combines them
+            # with the in-register chunk keys) — reading the same-step
+            # scattered rows forces XLA to materialize a full layer copy
+            # (measured +8 ms/step at decode; same hazard here).
+            k_rows = ck[li][slot_ids].astype(cfg.dtype)  # [B, C, KV, hd]
+            v_rows = cv[li][slot_ids].astype(cfg.dtype)
+            attn = mixed_prefill_attention(q, k, v, k_rows, v_rows,
+                                           start_pos, seq_lens, cfg.q_per_kv)
+        else:
+            attn = causal_attention(q, k, v, valid, cfg.q_per_kv)
         # write this layer's K/V for all B prompts into their slots with ONE
         # batched scatter (ck[li, slot_ids[b], start_pos[b]+t] = k[b, t]) —
         # a python loop of per-prompt dynamic_update_slices serializes B*2
@@ -263,14 +316,6 @@ def prefill(
         cols = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
         ck = ck.at[li, rows, cols].set(k.astype(ck.dtype), mode="drop")
         cv = cv.at[li, rows, cols].set(v.astype(cv.dtype), mode="drop")
-        if continued:
-            # continued prefix: keys live in the cache; attend over the full
-            # slot rows with absolute-position causal masking.
-            k_rows = ck[li][slot_ids].astype(cfg.dtype)  # [B, C, KV, hd]
-            v_rows = cv[li][slot_ids].astype(cfg.dtype)
-            attn = mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, cfg.q_per_kv)
-        else:
-            attn = causal_attention(q, k, v, valid, cfg.q_per_kv)
         x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), _mat(layer["wo"], x.dtype))
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h, layer)
@@ -321,15 +366,41 @@ def decode_step(
         q, k, v = _project_qkv(h, layer, cfg)  # q [S,1,H,hd], k/v [S,1,KV,hd]
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # scatter new k/v at [slot, lengths[slot]] — single scatter op; an
-        # out-of-range position (lengths==C) is dropped by XLA scatter
-        # semantics, preserving the documented capacity invariant
+        # Decode-attention path selection (r3 benchmark campaign,
+        # scripts/profile_decode*.py on the serving chip):
+        #   * post-scatter einsum (this default): 11.4 ms/step model-only on
+        #     the 1B bench config — the best measured composition despite
+        #     XLA materializing relayouted layer copies around the dot;
+        #   * append-attention (pre-scatter read, jnp or the Pallas kernel
+        #     in ops/pallas/decode_attention.py): semantically identical,
+        #     measured 12.9-14.6 ms/step here — the relayout moves rather
+        #     than disappears. Kept selectable (LOCALAI_DECODE_ATTN=append
+        #     | pallas) because the balance may flip off the axon tunnel.
         slot_idx = jnp.arange(S, dtype=jnp.int32)
-        lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
-        lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+        mode = _decode_attn_mode()
+        if mode == "pallas" and _pallas_decode():
+            from localai_tpu.ops.pallas.decode_attention import (
+                decode_attention_append_pallas)
+
+            attn = decode_attention_append_pallas(
+                q[:, 0], k[:, 0], v[:, 0], ck[li], cv[li], lengths,
+                cfg.q_per_kv)
+            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
+            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+        elif mode == "append":
+            attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0], ck[li],
+                                           cv[li], lengths, cfg.q_per_kv)
+            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
+            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+        else:
+            # scatter new k/v at [slot, lengths[slot]], then attend over the
+            # updated rows ([0, lengths]); out-of-range positions
+            # (lengths==C) are dropped, preserving the capacity invariant
+            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
+            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+            attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)
         ck = ck.at[li].set(lk)
         cv = cv.at[li].set(lv)
-        attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)  # [S,H,hd]
         x = x + jnp.einsum("sh,hd->sd", attn.reshape(S, -1), _mat(layer["wo"], x.dtype))[:, None, :]
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h, layer)

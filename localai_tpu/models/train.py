@@ -22,7 +22,7 @@ def forward_all_logits(params, cfg, tokens, seq_lens):
     B, T = tokens.shape
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
     sin, cos = rope_frequencies(cfg, positions)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    x = llama._embed_rows(params["embed"], tokens, cfg.dtype)
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]
 
     def layer_fn(x, layer):
@@ -32,7 +32,8 @@ def forward_all_logits(params, cfg, tokens, seq_lens):
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         attn = causal_attention(q, k, v, valid, cfg.q_per_kv)
-        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), layer["wo"])
+        x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1),
+                           llama._mat(layer["wo"], x.dtype))
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + llama._mlp(h, layer)
         return x, None
@@ -54,7 +55,15 @@ def loss_fn(params, cfg, tokens, seq_lens):
 
 
 def train_step(params, cfg, tokens, seq_lens, lr: float = 1e-4):
-    """One SGD step; gradients follow the params' sharding (dp-psum by GSPMD)."""
+    """One SGD step; gradients follow the params' sharding (dp-psum by GSPMD).
+
+    int8-quantized pytrees (dict {q, s} leaves) are dequantized first —
+    value_and_grad needs float leaves, and training updates quantized
+    weights as their dense float equivalents."""
+    quantized = isinstance(params.get("embed"), dict) or any(
+        isinstance(l, dict) for l in params.get("layers", {}).values())
+    if quantized:
+        params = llama.dequantize_params(params, cfg.dtype)
     loss, grads = jax.value_and_grad(loss_fn)(params, cfg, tokens, seq_lens)
     new_params = jax.tree.map(lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype), params, grads)
     return loss, new_params

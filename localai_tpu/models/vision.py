@@ -39,6 +39,11 @@ class VisionConfig:
     num_heads: int = 12
     proj_dim: int = 4096           # language model hidden size
     layer_norm_eps: float = 1e-5
+    # which encoder hidden state feeds the projector, HF hidden_states
+    # indexing: -1 = last layer, -2 = penultimate (LLaVA's default —
+    # selecting the final layer instead measurably degrades real LLaVA
+    # checkpoints; ADVICE r2)
+    vision_feature_layer: int = -2
     dtype: Any = jnp.float32
 
     @property
@@ -57,6 +62,7 @@ class VisionConfig:
             num_heads=v.get("num_attention_heads", 12),
             proj_dim=proj_dim or cfg.get("proj_dim", v.get("projection_dim", 4096)),
             layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+            vision_feature_layer=cfg.get("vision_feature_layer", -2),
             dtype=dtype,
         )
 
@@ -135,7 +141,19 @@ def encode(params: dict, cfg: VisionConfig, pixels: jax.Array) -> jax.Array:
         x = x + jnp.einsum("btf,fd->btd", h, ly["w2"]) + ly["b2"]
         return x, None
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    # HF hidden_states = [embeddings] + per-layer outputs; LLaVA projects
+    # hidden_states[vision_feature_layer] (default -2: penultimate layer,
+    # NO post-layernorm), not the final layer output. The selected index is
+    # static, so simply scan only the layers up to it — no [L, B, T, D]
+    # stacking of every hidden state.
+    fl = cfg.vision_feature_layer
+    L = cfg.num_layers
+    end = fl if fl >= 0 else L + fl + 1
+    if end < L:
+        layers_used = jax.tree.map(lambda a: a[:end], params["layers"])
+    else:
+        layers_used = params["layers"]
+    x, _ = jax.lax.scan(layer, x, layers_used)
     patches = x[:, 1:, :]                                # drop CLS (LLaVA)
     h = jax.nn.gelu(jnp.einsum("bnd,de->bne", patches, params["proj_w1"])
                     + params["proj_b1"])

@@ -1,16 +1,22 @@
 """Attention ops: jnp implementations (XLA-fused; production path).
 
-Measured on the serving chip, these run at the device's HBM streaming
-rate for the serving shapes (weights + KV reads dominate; see bench.py),
-so hand-written Pallas kernels are kept as a future optimization rather
-than a dispatch layer here. Sequence-parallel long-context attention
-lives in localai_tpu/parallel/ring_attention.py. Pure-jnp also means
-every test runs hermetically on the 8-device CPU mesh.
+THE load-bearing design rule here (measured on the serving chip, r3):
+attention NEVER reads cache rows written in the same step. Reading the
+freshly-scattered rows forces XLA to materialize the scattered layer as
+a fresh buffer before the read (+~8 ms/step on the 1B bench config —
+2x the whole model's matmul time); attending over the PRE-update rows
+plus the new keys/values held in registers makes the KV scatter fuse
+into the in-place cache update (measured free) and cuts the decode step
+from ~11.5 to ~5 ms. Hence the *_append variants below.
 
 GQA is computed with grouped einsums — queries reshaped to
 [.., KV, G, hd] against un-repeated keys — NOT by materializing
 jnp.repeat(k, G) (which multiplies decode HBM traffic by G; measured 8x
 slowdown on a 1B model at G=8).
+
+Sequence-parallel long-context attention lives in
+localai_tpu/parallel/ring_attention.py. Pure-jnp also means every test
+runs hermetically on the 8-device CPU mesh.
 
 Role parity: this is the attention inside the reference's hot loop
 (llama.cpp's llama_decode, driven from grpc-server.cpp:1941).
@@ -44,13 +50,17 @@ def causal_attention(q, k, v, valid, q_per_kv: int):
     return out.reshape(B, T, H, hd)
 
 
-def mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, q_per_kv: int):
+def mixed_prefill_attention(q, chunk_k, chunk_v, k_rows, v_rows, start_pos,
+                            seq_lens, q_per_kv: int):
     """Continued-prefill attention: queries for a chunk at absolute positions
-    start_pos..start_pos+T attend over full cache rows (prefix + chunk).
+    start_pos..start_pos+T attend over the PRE-update cache rows (the
+    committed prefix) plus the chunk's own keys/values (see module doc —
+    reading the same-step scattered rows costs a full-layer copy).
 
-    q: [B, T, H, hd]; k_rows/v_rows: [B, C, KV, hd]; start_pos, seq_lens: [B].
-    Key position kp is visible to query qi iff kp <= start_pos + qi AND
-    kp < start_pos + seq_lens (excludes garbage keys written by chunk padding).
+    q, chunk_k, chunk_v: [B, T, {H|KV|KV}, hd]; k_rows/v_rows: [B, C, KV, hd]
+    (cache contents BEFORE this chunk's scatter); start_pos, seq_lens: [B].
+    Cache position kp is visible iff kp < start_pos (committed prefix);
+    chunk position t' is visible to query t iff t' <= t AND t' < seq_lens.
     """
     dtype = q.dtype
     B, T, H, hd = q.shape
@@ -58,14 +68,19 @@ def mixed_prefill_attention(q, k_rows, v_rows, start_pos, seq_lens, q_per_kv: in
     KV = k_rows.shape[2]
     qg = q.reshape(B, T, KV, q_per_kv, hd)
     scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k_rows).astype(jnp.float32) * scale
-    abs_q = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]      # [B, T]
-    kp = jnp.arange(C, dtype=jnp.int32)                                        # [C]
-    mask = kp[None, None, :] <= abs_q[:, :, None]                              # [B, T, C]
-    mask &= kp[None, None, :] < (start_pos + seq_lens)[:, None, None]
-    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+    sc_cache = jnp.einsum("btkgd,bskd->bkgts", qg, k_rows).astype(jnp.float32) * scale
+    kp = jnp.arange(C, dtype=jnp.int32)                                       # [C]
+    m_cache = kp[None, None, :] < start_pos[:, None, None]                    # [B, T, C]
+    sc_cache = jnp.where(m_cache[:, None, None, :, :], sc_cache, _NEG_INF)
+    sc_chunk = jnp.einsum("btkgd,bskd->bkgts", qg, chunk_k).astype(jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < seq_lens[:, None]       # [B, T]
+    m_chunk = causal[None, :, :] & valid[:, None, :]                          # [B, T, T]
+    sc_chunk = jnp.where(m_chunk[:, None, None, :, :], sc_chunk, _NEG_INF)
+    scores = jnp.concatenate([sc_cache, sc_chunk], axis=-1)                   # [B,KV,G,T,C+T]
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_rows)
+    out = (jnp.einsum("bkgts,bskd->btkgd", probs[..., :C], v_rows)
+           + jnp.einsum("bkgts,bskd->btkgd", probs[..., C:], chunk_v))
     return out.reshape(B, T, H, hd)
 
 
@@ -86,4 +101,31 @@ def decode_attention(q, cache_k, cache_v, lengths, q_per_kv: int):
     scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     out = jnp.einsum("skgc,sckd->skgd", probs, cache_v)
+    return out.reshape(S, H, hd)
+
+
+def decode_attention_append(q, new_k, new_v, cache_k, cache_v, lengths,
+                            q_per_kv: int):
+    """Decode attention over the PRE-update cache plus the current token's
+    own key/value (which the caller scatters into the cache separately —
+    see module doc for why the read must not see the scatter).
+
+    q, new_k, new_v: [S, {H|KV|KV}, hd]; cache_k/v: [S, C, KV, hd] holding
+    rows [0, lengths[s]) — row lengths[s] is written this step but read
+    from ``new_k``/``new_v`` instead. Returns [S, H, hd].
+    """
+    dtype = q.dtype
+    S, H, hd = q.shape
+    C = cache_k.shape[1]
+    KV = cache_k.shape[2]
+    qg = q.reshape(S, KV, q_per_kv, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    scores = jnp.einsum("skgd,sckd->skgc", qg, cache_k).astype(jnp.float32) * scale
+    mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]  # [S, C]
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    sc_self = jnp.einsum("skgd,skd->skg", qg, new_k).astype(jnp.float32) * scale
+    scores = jnp.concatenate([scores, sc_self[..., None]], axis=-1)    # [S,KV,G,C+1]
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    out = (jnp.einsum("skgc,sckd->skgd", probs[..., :C], cache_v)
+           + probs[..., C] [..., None] * new_v[:, :, None, :])
     return out.reshape(S, H, hd)

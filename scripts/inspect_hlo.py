@@ -1,0 +1,57 @@
+"""Dump optimized HLO for the decode burst and count big copies."""
+
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.engine import sampling
+
+S, C, K = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+ck, cv = llama.init_cache(cfg, S, C)
+tokens = jnp.zeros((S,), jnp.int32)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+
+donate = "--donate" in sys.argv
+
+
+def burst(params, tokens, lengths, ck, cv):
+    def body(carry, _):
+        tokens, lengths, ck, cv = carry
+        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
+        ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (ids, lengths + 1, ck, cv), ids
+    carry, ids = jax.lax.scan(body, (tokens, lengths, ck, cv), None, length=K)
+    return ids, carry[2], carry[3]
+
+
+fn = jax.jit(burst, donate_argnums=(3, 4) if donate else ())
+lowered = fn.lower(params, tokens, lengths, ck, cv)
+compiled = lowered.compile()
+txt = compiled.as_text()
+ca = compiled.cost_analysis()
+if isinstance(ca, list):
+    ca = ca[0]
+print("bytes accessed (GB):", ca.get("bytes accessed", 0) / 1e9)
+print("bytes accessed per step (GB):", ca.get("bytes accessed", 0) / 1e9 / K)
+print("flops (G):", ca.get("flops", 0) / 1e9)
+
+# count ops touching full-cache-layer-sized shapes
+layer_kv = f"bf16[{S},{C},4,64]"
+full = f"bf16[22,{S},{C},4,64]"
+for pat, label in [(rf"copy[^\n]*{re.escape(full)}", "full-cache copy"),
+                   (rf"copy[^\n]*{re.escape(layer_kv)}", "layer copy"),
+                   (rf"fusion[^\n]*{re.escape(full)}", "full-cache fusion"),
+                   (rf"dynamic-update-slice[^\n]*{re.escape(full)}", "DUS full")]:
+    n = len(re.findall(pat, txt))
+    print(f"{label}: {n}")
+open("/tmp/burst_hlo.txt", "w").write(txt)
+print("hlo dumped to /tmp/burst_hlo.txt, lines:", txt.count("\n"))

@@ -1,0 +1,132 @@
+"""Finer decode-step probes: isolate attention / KV-scatter / layout costs."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.engine import sampling
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.utils.jaxtools import enable_compilation_cache
+
+enable_compilation_cache()
+
+S, C, INNER = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+tokens0 = jnp.zeros((S,), jnp.int32)
+lengths0 = jnp.full((S,), C // 2, jnp.int32)
+
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+_NEG_INF = -1e30
+
+
+def make_model(attn_mode, write_mode, layout):
+    """attn_mode: none|full; write_mode: none|scatter; layout: cmajor|kvmajor"""
+
+    def step(params, tokens, lengths, ck, cv):
+        S_ = tokens.shape[0]
+        positions = lengths[:, None]
+        sin, cos = rope_frequencies(cfg, positions)
+        x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+
+        def layer_fn(carry, layer):
+            x, ck, cv = carry
+            li = layer.pop("_idx")
+            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama._project_qkv(h, layer, cfg)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+            slot_idx = jnp.arange(S_, dtype=jnp.int32)
+            if layout == "cmajor":
+                lk, lv = ck[li], cv[li]
+                if write_mode == "scatter":
+                    lk = lk.at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
+                    lv = lv.at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+                    ck = ck.at[li].set(lk)
+                    cv = cv.at[li].set(lv)
+                if attn_mode == "full":
+                    qg = q[:, 0].reshape(S_, KV, G, hd)
+                    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+                    scores = jnp.einsum("skgd,sckd->skgc", qg, lk).astype(jnp.float32) * scale
+                    mask = jnp.arange(C, dtype=jnp.int32)[None, :] < (lengths + 1)[:, None]
+                    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+                    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+                    attn = jnp.einsum("skgc,sckd->skgd", probs, lv).reshape(S_, -1)
+                else:
+                    attn = q[:, 0].reshape(S_, -1)
+            else:  # kvmajor: cache [L, S, KV, C, hd]
+                lk, lv = ck[li], cv[li]
+                if write_mode == "scatter":
+                    lk = lk.at[slot_idx, :, lengths].set(
+                        k[:, 0].astype(ck.dtype), mode="drop")
+                    lv = lv.at[slot_idx, :, lengths].set(
+                        v[:, 0].astype(cv.dtype), mode="drop")
+                    ck = ck.at[li].set(lk)
+                    cv = cv.at[li].set(lv)
+                if attn_mode == "full":
+                    qg = q[:, 0].reshape(S_, KV, G, hd)
+                    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+                    scores = jnp.einsum("skgd,skcd->skgc", qg, lk).astype(jnp.float32) * scale
+                    mask = jnp.arange(C, dtype=jnp.int32)[None, :] < (lengths + 1)[:, None]
+                    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+                    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+                    attn = jnp.einsum("skgc,skcd->skgd", probs, lv).reshape(S_, -1)
+                else:
+                    attn = q[:, 0].reshape(S_, -1)
+            x = x + jnp.einsum("sh,hd->sd", attn,
+                               llama._mat(layer["wo"], x.dtype))[:, None, :]
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+            x = x + llama._mlp(h, layer)
+            return (x, ck, cv), None
+
+        layers = dict(params["layers"])
+        layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (x, ck, cv), _ = jax.lax.scan(layer_fn, (x, ck, cv), layers)
+        ids = jnp.sum(x[:, 0, :], axis=-1).astype(jnp.int32) % cfg.vocab_size
+        return ids, ck, cv
+
+    @jax.jit
+    def burst(params, ck, cv):
+        def body(carry, _):
+            tokens, lengths, ck, cv = carry
+            ids, ck, cv = step(params, tokens, lengths, ck, cv)
+            return (ids, lengths + 1, ck, cv), ids
+        carry, ids = jax.lax.scan(body, (tokens0, lengths0, ck, cv), None, length=INNER)
+        return ids
+
+    return burst
+
+
+def timeit(name, fn, *args, n=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:44s} {dt*1e3/INNER:8.2f} ms/step")
+    return dt
+
+
+shape_c = (cfg.num_layers, S, C, KV, hd)
+shape_k = (cfg.num_layers, S, KV, C, hd)
+ck_c = jnp.zeros(shape_c, cfg.dtype)
+cv_c = jnp.zeros(shape_c, cfg.dtype)
+ck_k = jnp.zeros(shape_k, cfg.dtype)
+cv_k = jnp.zeros(shape_k, cfg.dtype)
+
+timeit("cmajor attn+scatter (current)", make_model("full", "scatter", "cmajor"), params, ck_c, cv_c)
+timeit("cmajor attn, no scatter", make_model("full", "none", "cmajor"), params, ck_c, cv_c)
+timeit("cmajor scatter, no attn", make_model("none", "scatter", "cmajor"), params, ck_c, cv_c)
+timeit("no attn no scatter (matmuls only)", make_model("none", "none", "cmajor"), params, ck_c, cv_c)
+timeit("kvmajor attn+scatter", make_model("full", "scatter", "kvmajor"), params, ck_k, cv_k)
+timeit("kvmajor attn, no scatter", make_model("full", "none", "kvmajor"), params, ck_k, cv_k)

@@ -1,0 +1,111 @@
+"""Probe structural fixes for the scatter+attention cache-copy problem."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.ops.attention import decode_attention_append
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.utils.jaxtools import enable_compilation_cache
+
+enable_compilation_cache()
+
+S, C, INNER = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+tokens0 = jnp.zeros((S,), jnp.int32)
+lengths0 = jnp.full((S,), C // 2, jnp.int32)
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+
+
+def make_step(variant):
+    def step(params, tokens, lengths, ck, cv):
+        S_ = tokens.shape[0]
+        positions = lengths[:, None]
+        sin, cos = rope_frequencies(cfg, positions)
+        x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+        slot_idx = jnp.arange(S_, dtype=jnp.int32)
+
+        def body(x, ck_li, cv_li, layer):
+            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama._project_qkv(h, layer, cfg)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+            attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0],
+                                           ck_li, cv_li, lengths, cfg.q_per_kv)
+            if variant == "forced_order":
+                # data-dependency hack: the scattered value depends on the
+                # attention output, provably ordering the write after the read
+                eps = (jnp.sum(attn).astype(k.dtype) * 0)
+                kw, vw = k[:, 0] + eps, v[:, 0] + eps
+            else:
+                kw, vw = k[:, 0], v[:, 0]
+            ck_li = ck_li.at[slot_idx, lengths].set(kw.astype(ck_li.dtype), mode="drop")
+            cv_li = cv_li.at[slot_idx, lengths].set(vw.astype(cv_li.dtype), mode="drop")
+            x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
+                               llama._mat(layer["wo"], x.dtype))[:, None, :]
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+            x = x + llama._mlp(h, layer)
+            return x, ck_li, cv_li
+
+        if variant in ("carry", "forced_order"):
+            def layer_fn(carry, layer):
+                x, ck, cv = carry
+                li = layer.pop("_idx")
+                x, lk, lv = body(x, ck[li], cv[li], layer)
+                ck = ck.at[li].set(lk)
+                cv = cv.at[li].set(lv)
+                return (x, ck, cv), None
+            layers = dict(params["layers"])
+            layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+            (x, ck, cv), _ = jax.lax.scan(layer_fn, (x, ck, cv), layers)
+        else:  # xs_ys: cache flows through scan as per-layer inputs/outputs
+            def layer_fn(x, inputs):
+                ck_li, cv_li, layer = inputs
+                x, lk, lv = body(x, ck_li, cv_li, layer)
+                return x, (lk, lv)
+            x, (ck, cv) = jax.lax.scan(layer_fn, x,
+                                       (ck, cv, dict(params["layers"])))
+        ids = jnp.sum(x[:, 0, :], axis=-1).astype(jnp.int32) % cfg.vocab_size
+        return ids, ck, cv
+
+    @__import__('functools').partial(jax.jit, donate_argnums=(1, 2))
+    def burst(params, ck, cv):
+        def b(carry, _):
+            tokens, lengths, ck, cv = carry
+            ids, ck, cv = make_fn(params, tokens, lengths, ck, cv)
+            return (ids, lengths + 1, ck, cv), ids
+        make_fn = step
+        carry, ids = jax.lax.scan(b, (tokens0, lengths0, ck, cv), None, length=INNER)
+        return ids, carry[2], carry[3]
+
+    return burst
+
+
+def timeit(name, fn, params, ck, cv, n=5):
+    # donation: thread the returned cache handles burst-to-burst
+    ids, ck, cv = fn(params, ck, cv)
+    jax.block_until_ready(ids)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ids, ck, cv = fn(params, ck, cv)
+        jax.block_until_ready(ids)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:44s} {dt*1e3/INNER:8.2f} ms/step", flush=True)
+
+
+shape = (cfg.num_layers, S, C, KV, hd)
+
+def mk():
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+# burst must RETURN the caches for donation chaining
+ck, cv = mk(); timeit("donated carry", make_step("carry"), params, ck, cv)
+ck, cv = mk(); timeit("donated xs/ys", make_step("xs_ys"), params, ck, cv)

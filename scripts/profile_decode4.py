@@ -1,0 +1,124 @@
+"""Probe the tail-buffer burst decode: big cache read-only inside the scan,
+one batched commit scatter after."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+from localai_tpu.utils.jaxtools import enable_compilation_cache
+
+enable_compilation_cache()
+
+S, C, K = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+L = cfg.num_layers
+_NEG = -1e30
+
+tokens0 = jnp.zeros((S,), jnp.int32)
+lengths0 = jnp.full((S,), C // 2, jnp.int32)
+
+
+def tail_attention(q, new_k, new_v, ck_li, cv_li, tk_li, tv_li, base_len, j):
+    """q,new_k,new_v: [S,{H,KV,KV},hd]; ck/cv_li: [S,C,KV,hd] READ-ONLY
+    (rows < base_len valid); tk/tv_li: [S,K,KV,hd] tail (rows < j valid)."""
+    dtype = q.dtype
+    qg = q.reshape(S, KV, G, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    sc_cache = jnp.einsum("skgd,sckd->skgc", qg, ck_li).astype(jnp.float32) * scale
+    m_cache = jnp.arange(C, dtype=jnp.int32)[None, :] < base_len[:, None]
+    sc_cache = jnp.where(m_cache[:, None, None, :], sc_cache, _NEG)
+    sc_tail = jnp.einsum("skgd,sckd->skgc", qg, tk_li).astype(jnp.float32) * scale
+    m_tail = jnp.arange(K, dtype=jnp.int32)[None, :] < j
+    sc_tail = jnp.where(m_tail[None, :, None, None, :].reshape(1, 1, 1, K), sc_tail, _NEG)
+    sc_self = jnp.einsum("skgd,skd->skg", qg, new_k).astype(jnp.float32) * scale
+    scores = jnp.concatenate([sc_cache, sc_tail, sc_self[..., None]], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    out = (jnp.einsum("skgc,sckd->skgd", probs[..., :C], cv_li)
+           + jnp.einsum("skgc,sckd->skgd", probs[..., C:C + K], tv_li)
+           + probs[..., C + K][..., None] * new_v[:, :, None, :])
+    return out.reshape(S, -1)
+
+
+def step(params, tokens, lengths, ck, cv, tails, j):
+    positions = lengths[:, None]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+    tk, tv = tails  # [L, S, K, KV, hd]
+
+    def layer_fn(carry, inp):
+        x, = carry
+        ck_li, cv_li, tk_li, tv_li, layer = inp
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        attn = tail_attention(q[:, 0], k[:, 0], v[:, 0], ck_li, cv_li,
+                              tk_li, tv_li, lengths, j)
+        x = x + jnp.einsum("sh,hd->sd", attn,
+                           llama._mat(layer["wo"], x.dtype))[:, None, :]
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(h, layer)
+        return (x,), (k[:, 0].astype(tk_li.dtype), v[:, 0].astype(tv_li.dtype))
+
+    (x,), (ks, vs) = jax.lax.scan(layer_fn, (x,),
+                                  (ck, cv, tk, tv, dict(params["layers"])))
+    # write this step's k/v row into the tails (tiny buffers)
+    tk = tk.at[:, :, j].set(ks)
+    tv = tv.at[:, :, j].set(vs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = llama._unembed(x, params, cfg)[:, 0, :]
+    ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return ids, (tk, tv)
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2))
+def burst(params, ck, cv, tokens, lengths):
+    tk = jnp.zeros((L, S, K, KV, hd), cfg.dtype)
+    tv = jnp.zeros((L, S, K, KV, hd), cfg.dtype)
+
+    def b(carry, j):
+        tokens, lengths, tails = carry
+        ids, tails = step(params, tokens, lengths, ck, cv, tails, j)
+        return (ids, lengths + 1, tails), ids
+
+    (tokens, lengths, (tk, tv)), ids = jax.lax.scan(
+        b, (tokens, lengths, (tk, tv)), jnp.arange(K, dtype=jnp.int32))
+    # ONE commit scatter for all K steps, all layers (write-only, donated)
+    base = lengths - K
+    cols = base[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]      # [S, K]
+    l_idx = jnp.arange(L, dtype=jnp.int32)[:, None, None] * jnp.ones((1, S, K), jnp.int32)
+    s_idx = jnp.arange(S, dtype=jnp.int32)[None, :, None] * jnp.ones((L, 1, K), jnp.int32)
+    c_idx = cols[None] * jnp.ones((L, 1, 1), jnp.int32)
+    # tails are [L, S, K, ...] after stacking: transpose ks [K? ...] — tk is [L,S,K,KV,hd]
+    ck = ck.at[l_idx, s_idx, c_idx].set(tk, mode="drop")
+    cv = cv.at[l_idx, s_idx, c_idx].set(tv, mode="drop")
+    return ids, tokens, lengths, ck, cv
+
+
+def timeit(name, n=5):
+    ck = jnp.zeros((L, S, C, KV, hd), cfg.dtype)
+    cv = jnp.zeros((L, S, C, KV, hd), cfg.dtype)
+    tokens, lengths = tokens0, lengths0
+    ids, tokens, lengths, ck, cv = burst(params, ck, cv, tokens, lengths)
+    jax.block_until_ready(ids)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ids, tokens, lengths, ck, cv = burst(params, ck, cv, tokens, lengths)
+        np.asarray(ids)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:44s} {dt*1e3/K:8.2f} ms/step  -> {S*K/dt:7.0f} tok/s",
+          flush=True)
+
+
+timeit("tail-burst decode (greedy, donated)")

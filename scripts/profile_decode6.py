@@ -1,0 +1,93 @@
+"""Probe: cache physically shaped [L, S, KV, hd, C] (C minor) so row-major
+IS the dot-preferred layout — no relayouts at any site."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+
+S, C, K = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+L = cfg.num_layers
+_NEG = -1e30
+
+
+def decode_step(params, tokens, lengths, ck, cv):
+    S_ = tokens.shape[0]
+    positions = lengths[:, None]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+    slot_idx = jnp.arange(S_, dtype=jnp.int32)
+
+    def layer_fn(carry, layer):
+        x, ck, cv = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        lk, lv = ck[li], cv[li]            # [S, KV, hd, C]
+        qg = q[:, 0].reshape(S_, KV, G, hd)
+        scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+        scores = jnp.einsum("skgd,skdc->skgc", qg, lk).astype(jnp.float32) * scale
+        mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]
+        scores = jnp.where(mask[:, None, None, :], scores, _NEG)
+        s_self = jnp.einsum("skgd,skd->skg", qg, k[:, 0]).astype(jnp.float32) * scale
+        scores = jnp.concatenate([scores, s_self[..., None]], axis=-1)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = (jnp.einsum("skgc,skdc->skgd", probs[..., :C], lv)
+                + probs[..., C][..., None] * v[:, 0][:, :, None, :])
+        x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
+                           llama._mat(layer["wo"], x.dtype))[:, None, :]
+        # column write: new k/v at [slot, :, :, lengths[slot]]
+        lk = lk.at[slot_idx, :, :, lengths].set(k[:, 0].astype(lk.dtype), mode="drop")
+        lv = lv.at[slot_idx, :, :, lengths].set(v[:, 0].astype(lv.dtype), mode="drop")
+        ck = ck.at[li].set(lk)
+        cv = cv.at[li].set(lv)
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(h, layer)
+        return (x, ck, cv), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, ck, cv), _ = jax.lax.scan(layer_fn, (x, ck, cv), layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = llama._unembed(x, params, cfg)[:, 0, :]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+
+@jax.jit
+def burst(params, tokens, lengths, ck, cv):
+    def body(carry, _):
+        tokens, lengths, ck, cv = carry
+        ids, ck, cv = decode_step(params, tokens, lengths, ck, cv)
+        return (ids, lengths + 1, ck, cv), ids
+    carry, ids = jax.lax.scan(body, (tokens, lengths, ck, cv), None, length=K)
+    return ids, carry[0], carry[1], carry[2], carry[3]
+
+
+ck = jnp.zeros((L, S, KV, hd, C), cfg.dtype)
+cv = jnp.zeros((L, S, KV, hd, C), cfg.dtype)
+tokens = jnp.zeros((S,), jnp.int32)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+
+ids, tokens, lengths, ck, cv = burst(params, tokens, lengths, ck, cv)
+jax.block_until_ready(ids)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+n = 6
+t0 = time.perf_counter()
+for _ in range(n):
+    ids, tokens, lengths, ck, cv = burst(params, tokens, lengths, ck, cv)
+    np.asarray(ids)
+dt = (time.perf_counter() - t0) / n
+print(f"C-minor cache burst: {dt*1e3/K:8.2f} ms/step -> {S*K/dt:7.0f} tok/s", flush=True)

@@ -1,0 +1,112 @@
+"""Probe: direct 5D cache scatter (no per-layer slice->scatter->DUS chain)
+x {jnp append-attention, pallas kernel, one-hot dense rewrite}."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import llama
+from localai_tpu.ops.attention import decode_attention_append
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+
+S, C, K = 32, 1024, 16
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+L = cfg.num_layers
+
+
+def make_burst(variant):
+    def decode_step(params, tokens, lengths, ck, cv):
+        S_ = tokens.shape[0]
+        positions = lengths[:, None]
+        sin, cos = rope_frequencies(cfg, positions)
+        x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+        slot_idx = jnp.arange(S_, dtype=jnp.int32)
+
+        def layer_fn(carry, layer):
+            x, ck, cv = carry
+            li = layer.pop("_idx")
+            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = llama._project_qkv(h, layer, cfg)
+            q = apply_rope(q, sin, cos)
+            k = apply_rope(k, sin, cos)
+            if variant == "pallas":
+                from localai_tpu.ops.pallas.decode_attention import (
+                    decode_attention_append_pallas)
+                attn = decode_attention_append_pallas(
+                    q[:, 0], k[:, 0], v[:, 0], ck[li], cv[li], lengths, G)
+            elif variant == "pallas_full":
+                from localai_tpu.ops.pallas.decode_attention import (
+                    decode_attention_append_pallas_full)
+                attn = decode_attention_append_pallas_full(
+                    q[:, 0], k[:, 0], v[:, 0], ck, cv, lengths, li, G)
+            else:
+                attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0],
+                                               ck[li], cv[li], lengths, G)
+            x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
+                               llama._mat(layer["wo"], x.dtype))[:, None, :]
+            if variant == "onehot":
+                oh = (jnp.arange(C, dtype=jnp.int32)[None, :]
+                      == lengths[:, None]).astype(ck.dtype)  # [S, C]
+                ohl = oh[None, :, :, None, None]
+                kk = k[:, 0].astype(ck.dtype)[None, :, None, :, :]
+                vv = v[:, 0].astype(cv.dtype)[None, :, None, :, :]
+                li_oh = (jnp.arange(L, dtype=jnp.int32) == li).astype(ck.dtype)[:, None, None, None, None]
+                ck = ck * (1 - ohl * li_oh) + kk * ohl * li_oh
+                cv = cv * (1 - ohl * li_oh) + vv * ohl * li_oh
+            else:
+                # DIRECT 5D scatter on the carry buffer — no ck[li]
+                # slice->scatter->DUS chain
+                li_v = li * jnp.ones((S_,), jnp.int32)
+                ck = ck.at[li_v, slot_idx, lengths].set(
+                    k[:, 0].astype(ck.dtype), mode="drop")
+                cv = cv.at[li_v, slot_idx, lengths].set(
+                    v[:, 0].astype(cv.dtype), mode="drop")
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+            x = x + llama._mlp(h, layer)
+            return (x, ck, cv), None
+
+        layers = dict(params["layers"])
+        layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (x, ck, cv), _ = jax.lax.scan(layer_fn, (x, ck, cv), layers)
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        logits = llama._unembed(x, params, cfg)[:, 0, :]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+    @jax.jit
+    def burst(params, tokens, lengths, ck, cv):
+        def body(carry, _):
+            tokens, lengths, ck, cv = carry
+            ids, ck, cv = decode_step(params, tokens, lengths, ck, cv)
+            return (ids, lengths + 1, ck, cv), ids
+        carry, ids = jax.lax.scan(body, (tokens, lengths, ck, cv), None, length=K)
+        return ids, carry[0], carry[1], carry[2], carry[3]
+
+    return burst
+
+
+def run(name, variant, n=6):
+    burst = make_burst(variant)
+    ck = jnp.zeros((L, S, C, KV, hd), cfg.dtype)
+    cv = jnp.zeros((L, S, C, KV, hd), cfg.dtype)
+    tokens = jnp.zeros((S,), jnp.int32)
+    lengths = jnp.full((S,), C // 2, jnp.int32)
+    ids, tokens, lengths, ck, cv = burst(params, tokens, lengths, ck, cv)
+    jax.block_until_ready(ids)
+    lengths = jnp.full((S,), C // 2, jnp.int32)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ids, tokens, lengths, ck, cv = burst(params, tokens, lengths, ck, cv)
+        np.asarray(ids)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:40s} {dt*1e3/K:8.2f} ms/step -> {S*K/dt:7.0f} tok/s", flush=True)
+
+
+run("5D scatter + pallas FULL-cache kernel", "pallas_full")

@@ -1,0 +1,98 @@
+"""Probe: single paged KV pool for ALL layers + jax paged_attention kernel.
+
+Pool: [KV, L*S*PP, ps, hd]. The page table absorbs layer+slot indexing, so
+no XLA-side cache slicing exists anywhere; writes are plain scatters into
+the pool (in-place on the scan carry); reads happen inside the kernel via
+manual DMA of only the pages below each slot's length."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+
+S, C, K = 32, 1024, 16
+PS = 64                     # page size
+PP = C // PS                # pages per (slot, layer)
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+L = cfg.num_layers
+NP = L * S * PP
+
+
+def decode_step(params, tokens, lengths, kp, vp):
+    S_ = tokens.shape[0]
+    positions = lengths[:, None]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+    slot_idx = jnp.arange(S_, dtype=jnp.int32)
+    page_local = lengths // PS
+    row = lengths % PS
+
+    def layer_fn(carry, layer):
+        x, kp, vp = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # write BEFORE attention (pool is consumed opaquely by the kernel)
+        gpage = li * (S_ * PP) + slot_idx * PP + page_local       # [S]
+        kp = kp.at[:, gpage, row].set(
+            k[:, 0].astype(kp.dtype).transpose(1, 0, 2), mode="drop")
+        vp = vp.at[:, gpage, row].set(
+            v[:, 0].astype(vp.dtype).transpose(1, 0, 2), mode="drop")
+        page_idx = (li * (S_ * PP) + slot_idx[:, None] * PP
+                    + jnp.arange(PP, dtype=jnp.int32)[None, :])   # [S, PP]
+        attn = paged_attention(
+            q[:, 0], kp, vp, lengths + 1, page_idx,
+            pages_per_compute_block=4, inline_seq_dim=False)                            # [S, H, hd]
+        x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
+                           llama._mat(layer["wo"], x.dtype))[:, None, :]
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(h, layer)
+        return (x, kp, vp), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, kp, vp), _ = jax.lax.scan(layer_fn, (x, kp, vp), layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = llama._unembed(x, params, cfg)[:, 0, :]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+
+@jax.jit
+def burst(params, tokens, lengths, kp, vp):
+    def body(carry, _):
+        tokens, lengths, kp, vp = carry
+        ids, kp, vp = decode_step(params, tokens, lengths, kp, vp)
+        return (ids, lengths + 1, kp, vp), ids
+    carry, ids = jax.lax.scan(body, (tokens, lengths, kp, vp), None, length=K)
+    return ids, carry[0], carry[1], carry[2], carry[3]
+
+
+kp = jnp.zeros((KV, NP, PS, hd), cfg.dtype)
+vp = jnp.zeros((KV, NP, PS, hd), cfg.dtype)
+tokens = jnp.zeros((S,), jnp.int32)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+
+ids, tokens, lengths, kp, vp = burst(params, tokens, lengths, kp, vp)
+jax.block_until_ready(ids)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+n = 6
+t0 = time.perf_counter()
+for _ in range(n):
+    ids, tokens, lengths, kp, vp = burst(params, tokens, lengths, kp, vp)
+    np.asarray(ids)
+dt = (time.perf_counter() - t0) / n
+print(f"paged pool burst: {dt*1e3/K:8.2f} ms/step -> {S*K/dt:7.0f} tok/s", flush=True)

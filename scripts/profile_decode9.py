@@ -1,0 +1,95 @@
+"""Probe: ragged_paged_attention (vLLM-TPU kernel) over a single all-layer
+page pool; combined K/V pages; one scatter writes both per layer."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.pallas.ops.tpu.ragged_paged_attention.kernel import (
+    ragged_paged_attention)
+
+from localai_tpu.models import llama
+from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops.rope import apply_rope, rope_frequencies
+
+S, C, K = 32, 1024, 16
+PS = 64
+PP = C // PS
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+    num_layers=22, num_heads=16, num_kv_heads=4, head_dim=128,
+    max_position_embeddings=2048)
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+KV, hd, G = cfg.num_kv_heads, cfg.head_dim_, cfg.q_per_kv
+L = cfg.num_layers
+NP = L * S * PP
+scale = 1.0 / float(np.sqrt(hd))
+
+cu_q = jnp.arange(S + 1, dtype=jnp.int32)      # 1 query per seq
+nseq = jnp.array([S], jnp.int32)
+
+
+def decode_step(params, tokens, lengths, kvp):
+    S_ = tokens.shape[0]
+    positions = lengths[:, None]
+    sin, cos = rope_frequencies(cfg, positions)
+    x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
+    slot_idx = jnp.arange(S_, dtype=jnp.int32)
+    page_local = lengths // PS
+    row = lengths % PS
+
+    def layer_fn(carry, layer):
+        x, kvp = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = llama._project_qkv(h, layer, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # combined [S, 2KV, hd]: K at even, V at odd
+        comb = jnp.stack([k[:, 0], v[:, 0]], axis=2).reshape(S_, 2 * KV, hd)
+        gpage = li * (S_ * PP) + slot_idx * PP + page_local
+        kvp = kvp.at[gpage, row].set(comb.astype(kvp.dtype), mode="drop")
+        page_idx = (li * (S_ * PP) + slot_idx[:, None] * PP
+                    + jnp.arange(PP, dtype=jnp.int32)[None, :])
+        attn = ragged_paged_attention(
+            q[:, 0], kvp, lengths + 1, page_idx, cu_q, nseq,
+            sm_scale=scale)                                  # [S, H, hd]
+        x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
+                           llama._mat(layer["wo"], x.dtype))[:, None, :]
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + llama._mlp(h, layer)
+        return (x, kvp), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, kvp), _ = jax.lax.scan(layer_fn, (x, kvp), layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = llama._unembed(x, params, cfg)[:, 0, :]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), kvp
+
+
+@jax.jit
+def burst(params, tokens, lengths, kvp):
+    def body(carry, _):
+        tokens, lengths, kvp = carry
+        ids, kvp = decode_step(params, tokens, lengths, kvp)
+        return (ids, lengths + 1, kvp), ids
+    carry, ids = jax.lax.scan(body, (tokens, lengths, kvp), None, length=K)
+    return ids, carry[0], carry[1], carry[2]
+
+
+kvp = jnp.zeros((NP, PS, 2 * KV, hd), cfg.dtype)
+tokens = jnp.zeros((S,), jnp.int32)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+
+ids, tokens, lengths, kvp = burst(params, tokens, lengths, kvp)
+jax.block_until_ready(ids)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+n = 6
+t0 = time.perf_counter()
+for _ in range(n):
+    ids, tokens, lengths, kvp = burst(params, tokens, lengths, kvp)
+    np.asarray(ids)
+dt = (time.perf_counter() - t0) / n
+print(f"ragged paged burst: {dt*1e3/K:8.2f} ms/step -> {S*K/dt:7.0f} tok/s", flush=True)

@@ -34,7 +34,7 @@ def test_single_request_greedy(running_engine, byte_tokenizer):
         max_new_tokens=8, ignore_eos=True,
     )
     text, events = running_engine.generate_text(req)
-    assert len(events) == 8
+    assert len(eng.event_ids(events)) == 8
     assert events[-1].finish_reason == "length"
     assert events[-1].completion_tokens == 8
     assert events[-1].prompt_tokens == 5
@@ -45,7 +45,7 @@ def test_single_request_greedy(running_engine, byte_tokenizer):
         max_new_tokens=8, ignore_eos=True,
     )
     _, events2 = running_engine.generate_text(req2)
-    assert [e.token_id for e in events] == [e.token_id for e in events2]
+    assert eng.event_ids(events) == eng.event_ids(events2)
 
 
 def test_concurrent_requests_isolated(running_engine, byte_tokenizer):
@@ -77,7 +77,7 @@ def test_max_new_tokens_respected(running_engine, byte_tokenizer):
         max_new_tokens=3, ignore_eos=True,
     )
     _, events = running_engine.generate_text(req)
-    assert len(events) == 3
+    assert len(eng.event_ids(events)) == 3
     assert events[-1].finish_reason == "length"
 
 
@@ -159,7 +159,7 @@ def test_chunked_prefill_long_prompt(byte_tokenizer):
                 params=sampling.SamplingParamsHost(temperature=0.0),
                 max_new_tokens=6, ignore_eos=True)
             _, events = e.generate_text(req)
-            return [ev.token_id for ev in events], events[-1]
+            return eng.event_ids(events), events[-1]
         finally:
             e.shutdown()
 
@@ -196,7 +196,7 @@ def test_prefix_reuse_across_requests(byte_tokenizer):
                              params=sampling.SamplingParamsHost(temperature=0.0),
                              max_new_tokens=6, ignore_eos=True)
         _, events = e.generate_text(req)
-        return [ev.token_id for ev in events], events[-1]
+        return eng.event_ids(events), events[-1]
 
     e1 = make()
     try:
@@ -407,7 +407,7 @@ def test_mirostat_request_through_engine(byte_tokenizer):
                     mirostat_eta=0.2, seed=11),
                 max_new_tokens=12, ignore_eos=True)
             _, events = e.generate_text(req)
-            return [ev.token_id for ev in events]
+            return eng.event_ids(events)
 
         a, b = run(), run()
         assert len(a) == 12

@@ -233,11 +233,16 @@ def test_explorer_registers_polls_and_drops(tmp_path):
     _run_app_bg(fed.build_app(), pf)
 
     db = ExplorerDB(str(tmp_path / "explorer.json"))
-    ex = Explorer(db, poll_interval_s=999)
+    ex = Explorer(db, poll_interval_s=999, token="s3cret", allow_private=True)
     _run_app_bg(ex.build_app(), pe)
 
     c = httpx.Client(base_url=f"http://127.0.0.1:{pe}", timeout=30)
+    # registration token enforced (ADVICE r2: unauthenticated /register was
+    # an SSRF probe)
     r = c.post("/register", json={"url": f"http://127.0.0.1:{pf}"})
+    assert r.status_code == 401
+    r = c.post("/register", json={"url": f"http://127.0.0.1:{pf}"},
+               headers={"Authorization": "Bearer s3cret"})
     assert r.status_code == 200
 
     nets = c.get("/networks").json()["networks"]
@@ -256,3 +261,20 @@ def test_explorer_registers_polls_and_drops(tmp_path):
     # registry persists across restarts (reference: JSON file DB)
     db2 = ExplorerDB(str(tmp_path / "explorer.json"))
     assert f"http://127.0.0.1:{pf}" in db2.entries
+
+
+def test_explorer_rejects_private_targets_by_default(tmp_path):
+    """Secure default: /register refuses URLs resolving to private /
+    loopback ranges (the explorer polls registered URLs server-side)."""
+    from localai_tpu.explorer import Explorer, ExplorerDB, url_resolves_private
+
+    pe = free_port()
+    ex = Explorer(ExplorerDB(str(tmp_path / "db.json")), poll_interval_s=999)
+    _run_app_bg(ex.build_app(), pe)
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pe}", timeout=30)
+    for bad in ("http://127.0.0.1:9/x", "http://10.0.0.1/",
+                "http://169.254.169.254/latest/meta-data"):
+        assert c.post("/register", json={"url": bad}).status_code == 403
+    assert url_resolves_private("http://192.168.1.1/")
+    assert url_resolves_private("http://[::1]/")
+    assert not url_resolves_private("http://93.184.216.34/")  # literal public IP

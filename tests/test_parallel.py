@@ -134,8 +134,8 @@ def test_engine_serving_on_mesh_matches_single_device(shard_cfg, mesh8,
     finally:
         e_mesh.shutdown()
 
-    ids_ref = [ev.token_id for ev in ev_ref]
-    ids_mesh = [ev.token_id for ev in ev_mesh]
+    ids_ref = eng.event_ids(ev_ref)
+    ids_mesh = eng.event_ids(ev_mesh)
     assert ids_ref == ids_mesh
     assert text_ref == text_mesh
 

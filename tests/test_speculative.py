@@ -38,7 +38,7 @@ def _greedy(e, text, n=24):
                          params=sampling.SamplingParamsHost(temperature=0.0),
                          max_new_tokens=n, ignore_eos=True)
     _, events = e.generate_text(req)
-    return [ev.token_id for ev in events]
+    return eng.event_ids(events)
 
 
 def test_speculation_matches_plain_greedy():
@@ -79,7 +79,7 @@ def test_speculation_falls_back_for_sampled_requests():
             params=sampling.SamplingParamsHost(temperature=0.9, seed=7),
             max_new_tokens=8, ignore_eos=True)
         _, events = e.generate_text(req)
-        assert len([ev for ev in events]) >= 8
+        assert len(eng.event_ids(events)) >= 8
         assert events[-1].finish_reason == "length"
     finally:
         e.shutdown()
