@@ -41,6 +41,9 @@ class _ByteTokenizer:
     def convert_ids_to_tokens(self, ids):
         return [chr(i) if i < 256 else "</s>" for i in ids]
 
+    def get_vocab_size(self):
+        return self.vocab_size
+
 
 PRESETS = {
     # TinyLlama-1.1B shape
@@ -84,6 +87,15 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
              "launched": 0}
     done = threading.Event()
 
+    # constrained-decode mode (LOCALAI_BENCH_GRAMMAR=1): every request
+    # carries a JSON-ish GBNF grammar — measures the speculative
+    # verify+rollback design's cost vs unconstrained serving
+    grammar = ""
+    if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1":
+        # not accepting until 200 digits: EOS stays masked, so requests
+        # run to max_new and the measurement is pure constrained decode
+        grammar = 'root ::= "[" [0-9]{200,400} "]"'
+
     def make_req():
         return eng.GenRequest(
             prompt_ids=rng.integers(0, 255, size=prompt_len).tolist(),
@@ -91,6 +103,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
                 temperature=0.8, top_k=40, top_p=0.95),
             max_new_tokens=max_new,
             ignore_eos=True,
+            grammar=grammar,
         )
 
     def consume():
@@ -255,10 +268,11 @@ def main():
     target = int(os.environ.get("LOCALAI_BENCH_TOKENS", "8192"))
     burst = int(os.environ.get("LOCALAI_BENCH_BURST", "16"))
     r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
+    gtag = "_grammar" if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1" else ""
     print(json.dumps({
         "metric": (f"serving_tok_s_per_chip_llama_{preset}_"
                    f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', '') == 'int8' else 'bf16'}"
-                   f"_slots{S}"),
+                   f"_slots{S}{gtag}"),
         "value": round(r["tok_s"], 1), "unit": "tok/s",
         "vs_baseline": round(r["tok_s"] / 2000.0, 3),
         "p50_ttft_ms": round(r["p50_ttft_ms"], 1),

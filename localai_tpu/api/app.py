@@ -210,6 +210,10 @@ def build_app(caps, app_config, gallery_service=None) -> web.Application:
         from localai_tpu.api import webui
 
         webui.register(app)
+
+    from localai_tpu.api import swagger
+
+    swagger.register(app)
     return app
 
 

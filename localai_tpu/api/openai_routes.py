@@ -190,16 +190,30 @@ async def chat_completions(request):
 
     # non-stream: n choices (reference: ComputeChoices inference.go:11-63).
     # Fanned out CONCURRENTLY: each choice occupies its own engine slot and
-    # the continuous-batching engine decodes them together (the shared
-    # prompt prefix is KV-reused across slots) — the reference loops
-    # serially; slots make parallel the natural shape here.
+    # the continuous-batching engine decodes them together; identical
+    # prompts submitted together prefill ONCE and fork KV rows to the
+    # sibling slots (engine._admit in-flight dedup). Each choice gets a
+    # DISTINCT seed (explicit seed: seed+i; default: per-choice correlation
+    # id feeds the engine's fallback-seed hash) — n identical samples was
+    # ADVICE r2's finding.
     import asyncio
 
     n = int(body.get("n") or 1)
+
+    def _choice_overrides(i):
+        if not i:
+            return overrides
+        o = dict(overrides or {})
+        if o.get("seed") is not None:
+            o["seed"] = int(o["seed"]) + i
+        return o
+
     chunks = await asyncio.gather(*[
-        state.run_blocking(state.caps.inference, mc, prompt, overrides,
-                           correlation_id)
-        for _ in range(n)
+        state.run_blocking(state.caps.inference, mc, prompt,
+                           _choice_overrides(i),
+                           f"{correlation_id}-c{i}" if i and correlation_id
+                           else correlation_id)
+        for i in range(n)
     ])
     choices = []
     usage_pt, usage_ct = 0, 0

@@ -1,0 +1,90 @@
+"""OpenAPI surface at /swagger (VERDICT r2 #10).
+
+Parity with the reference, which serves a generated swagger spec at
+/swagger/* (reference: swagger/docs.go registered in
+core/http/routes/localai.go:20). Instead of a build-time generator, the
+spec is derived from the LIVE aiohttp route table at request time, so it
+can never drift from what is actually registered; summaries come from
+handler docstrings.
+
+Endpoints:
+  /swagger/index.json  — OpenAPI 3.0 document listing every route
+  /swagger (+ /swagger/index.html) — minimal HTML viewer
+"""
+
+from __future__ import annotations
+
+import html as _html
+
+from aiohttp import web
+
+
+def _spec(app: web.Application) -> dict:
+    paths: dict = {}
+    for route in app.router.routes():
+        resource = route.resource
+        if resource is None:
+            continue
+        path = resource.canonical
+        method = route.method.lower()
+        if method in ("head", "options", "*"):
+            continue
+        if path.startswith("/swagger"):
+            continue
+        doc = (route.handler.__doc__ or "").strip().splitlines()
+        summary = doc[0] if doc else ""
+        entry = paths.setdefault(path, {})
+        op = {
+            "summary": summary,
+            "operationId": f"{method}_{path.strip('/').replace('/', '_').replace('{', '').replace('}', '') or 'root'}",
+            "responses": {"200": {"description": "OK"}},
+        }
+        params = [p[1:-1] for p in path.split("/") if p.startswith("{")]
+        if params:
+            op["parameters"] = [
+                {"name": p, "in": "path", "required": True,
+                 "schema": {"type": "string"}} for p in params
+            ]
+        entry[method] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "LocalAI TPU API",
+            "description": "OpenAI-compatible + LocalAI-compatible API "
+                           "served by the TPU-native framework.",
+            "version": "2.0.0",
+        },
+        "paths": dict(sorted(paths.items())),
+    }
+
+
+async def index_json(request: web.Request) -> web.Response:
+    """OpenAPI 3.0 spec generated from the live route table."""
+    return web.json_response(_spec(request.app))
+
+
+async def index_html(request: web.Request) -> web.Response:
+    """Minimal HTML API browser over /swagger/index.json."""
+    spec = _spec(request.app)
+    rows = []
+    for path, methods in spec["paths"].items():
+        for method, op in methods.items():
+            rows.append(
+                f"<tr><td><code>{method.upper()}</code></td>"
+                f"<td><code>{_html.escape(path)}</code></td>"
+                f"<td>{_html.escape(op.get('summary', ''))}</td></tr>")
+    body = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>LocalAI TPU API</title>
+<style>body{{font-family:system-ui;margin:24px}}td,th{{padding:4px 10px;
+border-bottom:1px solid #ddd;text-align:left;font-size:14px}}</style>
+</head><body><h1>LocalAI TPU API</h1>
+<p>{len(rows)} operations — <a href="/swagger/index.json">index.json</a></p>
+<table><tr><th>method</th><th>path</th><th>summary</th></tr>
+{''.join(rows)}</table></body></html>"""
+    return web.Response(text=body, content_type="text/html")
+
+
+def register(app: web.Application):
+    app.router.add_get("/swagger", index_html)
+    app.router.add_get("/swagger/index.html", index_html)
+    app.router.add_get("/swagger/index.json", index_json)

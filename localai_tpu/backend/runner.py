@@ -62,6 +62,7 @@ class EngineServicer(BackendServicer):
         self.model_cfg = None
         self.vision = None
         self.vision_cfg = None
+        self.model_path = ""       # base dir for relative prompt-cache paths
         self._state = pb.StatusResponse.UNINITIALIZED
         self._load_lock = threading.Lock()
         self._embed = False
@@ -115,10 +116,14 @@ class EngineServicer(BackendServicer):
         if tp * dp > 1:
             mesh = meshlib.make_mesh(meshlib.MeshPlan(dp=dp, tp=tp),
                                      devices=jax.devices()[: tp * dp])
+        lora_dir = request.lora_adapter
+        if lora_dir and request.model_path and not os.path.isabs(lora_dir):
+            lora_dir = os.path.join(request.model_path, lora_dir)
         params = weights.load_llama_params(
             model_dir, cfg, mesh=mesh, dtype=dtype,
             quantize=request.quantization or
-            ("int8" if request.dtype == "int8" else ""))
+            ("int8" if request.dtype == "int8" else ""),
+            lora_adapter=lora_dir, lora_scale=request.lora_scale or 1.0)
 
         if gguf_path is not None and not request.tokenizer:
             from localai_tpu.engine import gguf_tokenizer
@@ -156,6 +161,7 @@ class EngineServicer(BackendServicer):
             draft = (dcfg, dparams)
 
         self.model_cfg = cfg
+        self.model_path = request.model_path or os.path.dirname(model_dir)
         self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh,
                                  draft=draft)
         # compile the whole serving surface before accepting traffic (a cold
@@ -221,6 +227,11 @@ class EngineServicer(BackendServicer):
             ids = list(opts.prompt_ids)
         else:
             ids = self.tokenizer.encode(opts.prompt)
+        cache_path = opts.prompt_cache_path
+        if cache_path and not os.path.isabs(cache_path):
+            base = os.path.join(self.model_path or ".", "prompt_cache")
+            os.makedirs(base, exist_ok=True)
+            cache_path = os.path.join(base, cache_path)
         return GenRequest(
             prompt_ids=ids,
             params=_sampling_from_predict(opts),
@@ -231,6 +242,9 @@ class EngineServicer(BackendServicer):
             mm_positions=mm_positions,
             mm_vectors=mm_vectors,
             request_id=opts.correlation_id or "",
+            prompt_cache_path=cache_path,
+            prompt_cache_ro=opts.prompt_cache_ro,
+            prompt_cache_all=opts.prompt_cache_all,
         )
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:

@@ -37,6 +37,9 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         embeddings=mc.embeddings,
         mmproj=mc.mmproj,
         draft_model=mc.draft_model,
+        lora_adapter=mc.lora_adapter,
+        lora_base=mc.lora_base,
+        lora_scale=mc.lora_scale,
     )
 
 
@@ -67,6 +70,9 @@ def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict
         echo=bool(o.get("echo", False)),
         grammar=o.get("grammar", ""),
         correlation_id=correlation_id,
+        prompt_cache_path=mc.prompt_cache_path,
+        prompt_cache_ro=mc.prompt_cache_ro,
+        prompt_cache_all=mc.prompt_cache_all,
     )
     for tok, bias in (sp.logit_bias or {}).items():
         opts.logit_bias[int(tok)] = float(bias)

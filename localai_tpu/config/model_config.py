@@ -124,6 +124,15 @@ class ModelConfig:
     mmproj: str = ""
     # speculative decoding (future)
     draft_model: str = ""
+    # LoRA (reference: backend.proto LoraAdapter/LoraBase/LoraScale)
+    lora_adapter: str = ""
+    lora_base: str = ""
+    lora_scale: float = 0.0           # 0 = default 1.0
+    # prompt-cache persistence (reference: PromptCachePath/RO/All,
+    # options.go:182-191): KV rows + tokens survive restarts on disk
+    prompt_cache_path: str = ""
+    prompt_cache_ro: bool = False
+    prompt_cache_all: bool = False
 
     def validate(self) -> list:
         problems = []

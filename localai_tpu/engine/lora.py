@@ -1,0 +1,103 @@
+"""LoRA adapter merging at weight-load time.
+
+Reference plumbs LoraAdapter/LoraBase/LoraScale end-to-end
+(backend/backend.proto:146-148,207-208) and applies adapters inside
+llama.cpp (grpc-server.cpp:2295-2309). The TPU-native equivalent is
+simpler and free at serve time: JAX params are a plain pytree, so the
+low-rank update ``W += scale * (B @ A)`` is merged into each stacked
+leaf as the checkpoint streams onto the device — no extra HBM, no extra
+matmuls per step.
+
+Adapter layout: HF PEFT — ``adapter_config.json`` (r, lora_alpha,
+target_modules) + ``adapter_model.safetensors`` with tensors named
+``...layers.{i}.self_attn.q_proj.lora_A.weight`` ([r, in]) and
+``....lora_B.weight`` ([out, r]). Effective scale is
+``lora_scale * lora_alpha / r`` (PEFT semantics; lora_scale is the
+user knob, default 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+# our stacked-leaf name -> HF module suffix
+_LEAF_TO_MODULE = {
+    "wq": "self_attn.q_proj",
+    "wk": "self_attn.k_proj",
+    "wv": "self_attn.v_proj",
+    "wo": "self_attn.o_proj",
+    "w_gate": "mlp.gate_proj",
+    "w_up": "mlp.up_proj",
+    "w_down": "mlp.down_proj",
+}
+
+_NAME_RE = re.compile(
+    r"layers\.(\d+)\.(self_attn\.[qkvo]_proj|mlp\.(?:gate|up|down)_proj)"
+    r"\.lora_(A|B)\.weight$")
+
+
+class LoraAdapter:
+    """Parsed adapter: per-(layer, module) A/B matrices + effective scale."""
+
+    def __init__(self, path: str, scale: float = 1.0):
+        from safetensors import safe_open
+
+        cfg_path = os.path.join(path, "adapter_config.json")
+        cfg = {}
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+        r = float(cfg.get("r", 8))
+        alpha = float(cfg.get("lora_alpha", r))
+        self.scale = (scale or 1.0) * alpha / max(r, 1.0)
+
+        st = None
+        for name in ("adapter_model.safetensors", "adapter.safetensors"):
+            p = os.path.join(path, name)
+            if os.path.exists(p):
+                st = p
+                break
+        if st is None:
+            raise FileNotFoundError(
+                f"{path}: no adapter_model.safetensors found")
+        # (module, layer) -> {"A": [r, in], "B": [out, r]}
+        self.mats: dict = {}
+        with safe_open(st, framework="np") as f:
+            for name in f.keys():
+                m = _NAME_RE.search(name)
+                if not m:
+                    continue
+                li, module, ab = int(m.group(1)), m.group(2), m.group(3)
+                self.mats.setdefault((module, li), {})[ab] = f.get_tensor(name)
+
+    def targets_leaf(self, leaf_name: str, num_layers: int) -> bool:
+        module = _LEAF_TO_MODULE.get(leaf_name)
+        if module is None:
+            return False
+        return any((module, i) in self.mats for i in range(num_layers))
+
+    def apply_to_leaf(self, leaf_name: str, num_layers: int,
+                      arr32: np.ndarray) -> None:
+        """Add scale*(B@A).T IN PLACE into the float32 stacked leaf
+        [L, in, out] — per-layer, no full-size delta buffer (a 70B leaf's
+        extra float32 copy is tens of GB; see r3 review)."""
+        module = _LEAF_TO_MODULE[leaf_name]
+        for i in range(num_layers):
+            ab = self.mats.get((module, i))
+            if not ab or "A" not in ab or "B" not in ab:
+                continue
+            A = np.asarray(ab["A"], np.float32)     # [r, in]
+            B = np.asarray(ab["B"], np.float32)     # [out, r]
+            # leaf is [in, out] (transposed HF weight): delta = (B@A).T
+            arr32[i] += (B @ A).T * self.scale
+
+
+def maybe_adapter(path: str, scale: float = 1.0) -> Optional[LoraAdapter]:
+    if not path:
+        return None
+    return LoraAdapter(path, scale)

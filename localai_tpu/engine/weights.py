@@ -63,12 +63,19 @@ _QUANT_NAMES = {"embed", "lm_head", "wq", "wk", "wv", "wo",
                 "w_gate", "w_up", "w_down"}
 
 
-def _make_put(cfg, mesh, dtype, quantize):
-    """Leaf placer: host array + pytree path -> cast / int8-quantized /
-    mesh-sharded device leaf."""
+def _make_put(cfg, mesh, dtype, quantize, adapter=None):
+    """Leaf placer: host array + pytree path -> (LoRA-merged) cast /
+    int8-quantized / mesh-sharded device leaf."""
 
     def put(arr: np.ndarray, spec_path: tuple):
         leaf_name = spec_path[-1]
+        if adapter is not None and spec_path[0] == "layers" \
+                and adapter.targets_leaf(leaf_name, cfg.num_layers):
+            # merge W += scale*(B@A) BEFORE cast/quantization (reference:
+            # LoraAdapter applied at load, grpc-server.cpp:2295-2309);
+            # in-place per layer — no full-leaf delta buffer
+            arr = np.array(arr, np.float32)  # always a fresh writable copy
+            adapter.apply_to_leaf(leaf_name, cfg.num_layers, arr)
         if quantize == "int8" and leaf_name in _QUANT_NAMES:
             from localai_tpu.models.llama import quantize_params
 
@@ -100,24 +107,30 @@ def load_llama_params(
     mesh=None,
     dtype=jnp.bfloat16,
     quantize: str = "",
+    lora_adapter: str = "",
+    lora_scale: float = 1.0,
 ) -> dict:
     """Load HF llama/mistral/qwen2-style weights into the stacked pytree.
 
     When ``mesh`` is given, each leaf is placed with the tensor-parallel
     sharding from parallel/sharding.py as it is assembled. quantize="int8"
     converts matmul weights to weight-only per-channel int8 at load time
-    (reference parity: quantized GGUF serving).
+    (reference parity: quantized GGUF serving). ``lora_adapter`` (a PEFT
+    adapter dir) is merged into the weights as they stream (engine/lora.py).
 
     GGUF checkpoints (a .gguf path, or a dir holding one — what the
     ``ollama://``/``oci://`` puller produces) are dequantized host-side by
     engine/gguf.py and flow through the same cast/quantize/place path.
     """
+    from localai_tpu.engine.lora import maybe_adapter
+
+    adapter = maybe_adapter(lora_adapter, lora_scale)
     gguf_path = find_gguf(model_dir)
     if gguf_path is not None:
         from localai_tpu.engine import gguf as gguflib
 
         g = gguflib.open_gguf(gguf_path)
-        put = _make_put(cfg, mesh, dtype, quantize)
+        put = _make_put(cfg, mesh, dtype, quantize, adapter)
         params: dict = {"layers": {}}
         # leaf-at-a-time: dequantize (f16 host), place on device, free —
         # peak host memory is one stacked leaf, not the dense model
@@ -134,7 +147,7 @@ def load_llama_params(
         h = tensors[name]
         return h.get_tensor(name)
 
-    put = _make_put(cfg, mesh, dtype, quantize)
+    put = _make_put(cfg, mesh, dtype, quantize, adapter)
 
     L = cfg.num_layers
 

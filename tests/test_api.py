@@ -229,3 +229,21 @@ def test_elevenlabs_tts_compat(server):
     }, timeout=60)
     assert r.status_code == 200
     assert r.content[:4] == b"RIFF"
+
+
+def test_swagger_lists_every_route(server):
+    """/swagger serves an OpenAPI doc derived from the LIVE route table
+    (reference: swagger/docs.go at /swagger/*)."""
+    client = httpx.Client(base_url=server.base, timeout=30)
+    r = client.get("/swagger/index.json")
+    assert r.status_code == 200
+    spec = r.json()
+    assert spec["openapi"].startswith("3.")
+    paths = spec["paths"]
+    for must in ("/v1/chat/completions", "/v1/models", "/v1/embeddings",
+                 "/tts", "/v1/files", "/metrics"):
+        assert must in paths, must
+    assert "post" in paths["/v1/chat/completions"]
+    # HTML browser works and is auth-exempt
+    r = client.get("/swagger")
+    assert r.status_code == 200 and "LocalAI TPU API" in r.text

@@ -1,5 +1,6 @@
 """Engine integration: continuous batching, streaming, stops — hermetic CPU."""
 
+import os
 import queue
 import threading
 import time
@@ -416,3 +417,132 @@ def test_mirostat_request_through_engine(byte_tokenizer):
         assert np.any(np.asarray(e.mu) != 8.0) or True
     finally:
         e.shutdown()
+
+
+def test_identical_prompts_fork_prefill(byte_tokenizer):
+    """Simultaneously-admitted identical prompts prefill ONCE: siblings
+    fork the leader's KV rows (VERDICT r2 #5) and still decode exactly
+    what a solo run produces."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=4, max_context=128, prefill_buckets=(32, 64),
+        prefill_chunk=64))
+    e.start()
+    try:
+        prompt = byte_tokenizer.encode("the same prompt three times over")
+
+        def req():
+            return eng.GenRequest(
+                prompt_ids=list(prompt),
+                params=sampling.SamplingParamsHost(temperature=0.0),
+                max_new_tokens=6, ignore_eos=True)
+
+        # solo baseline (fills slot 0's cache, then released)
+        _, solo = e.generate_text(req())
+        solo_ids = eng.event_ids(solo)
+        reused_before = e.metrics()["prompt_tokens_reused"]
+
+        # three identical requests land in ONE admission batch
+        outs = [e.submit(req()) for _ in range(3)]
+        streams = []
+        for o in outs:
+            evs = []
+            while True:
+                ev = o.get()
+                if ev is None:
+                    break
+                evs.append(ev)
+            streams.append(evs)
+        for evs in streams:
+            assert eng.event_ids(evs) == solo_ids
+        # siblings reused the leader's rows (leader itself may also have
+        # reused the solo run's slot cache)
+        assert e.metrics()["prompt_tokens_reused"] > reused_before
+        sib_reuse = [evs[-1].timings["reused_prompt_tokens"] for evs in streams]
+        assert sum(1 for r in sib_reuse if r >= len(prompt) - 1) >= 2
+    finally:
+        e.shutdown()
+
+
+def test_identical_sampled_prompts_differ_per_request(byte_tokenizer):
+    """Sampled siblings get distinct fallback seeds (ADVICE r2: n>1 must
+    not return n byte-identical completions)."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=4, max_context=128, prefill_buckets=(32, 64),
+        prefill_chunk=64))
+    e.start()
+    try:
+        prompt = byte_tokenizer.encode("sample me")
+        outs = [e.submit(eng.GenRequest(
+            prompt_ids=list(prompt),
+            params=sampling.SamplingParamsHost(temperature=1.0, top_k=50),
+            max_new_tokens=12, ignore_eos=True)) for _ in range(3)]
+        streams = []
+        for o in outs:
+            evs = []
+            while True:
+                ev = o.get()
+                if ev is None:
+                    break
+                evs.append(ev)
+            streams.append(eng.event_ids(evs))
+        assert len({tuple(s) for s in streams}) >= 2, streams
+    finally:
+        e.shutdown()
+
+
+def test_prompt_cache_survives_restart(byte_tokenizer, tmp_path):
+    """VERDICT r2 #8: prompt KV persisted to disk on finish and restored by
+    a FRESH engine (new process semantics) with reused_prompt_tokens > 0
+    and identical greedy output."""
+    cfg = llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cache_file = str(tmp_path / "prompt.kv")
+    prompt = byte_tokenizer.encode(
+        "a reasonably long shared system prompt for caching purposes")
+
+    def make_engine():
+        e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+            num_slots=2, max_context=128, prefill_buckets=(16, 64),
+            prefill_chunk=64))
+        e.start()
+        return e
+
+    def gen(e, ro=False):
+        req = eng.GenRequest(
+            prompt_ids=list(prompt),
+            params=sampling.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=6, ignore_eos=True,
+            prompt_cache_path=cache_file, prompt_cache_ro=ro)
+        _, events = e.generate_text(req)
+        return eng.event_ids(events), events[-1]
+
+    e1 = make_engine()
+    try:
+        ids1, last1 = gen(e1)
+        assert last1.timings["reused_prompt_tokens"] == 0
+    finally:
+        e1.shutdown()
+    # the save runs on a background thread; wait for the atomic rename
+    deadline = time.monotonic() + 15
+    while not os.path.exists(cache_file) and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert os.path.exists(cache_file)
+
+    # FRESH engine (simulates a restart): must reuse the on-disk rows
+    e2 = make_engine()
+    try:
+        ids2, last2 = gen(e2, ro=True)
+        assert ids2 == ids1
+        assert last2.timings["reused_prompt_tokens"] >= len(prompt) - 1
+    finally:
+        e2.shutdown()

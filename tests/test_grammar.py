@@ -174,3 +174,72 @@ def test_engine_forces_valid_json_from_random_weights(tiny_llama, byte_tokenizer
         assert not unconstrained_valid
     finally:
         e.shutdown()
+
+
+def test_grammar_slot_keeps_bursts_full(monkeypatch, byte_tokenizer):
+    """r3: a grammar-constrained slot rides FULL decode bursts
+    (speculative verify + rollback) instead of forcing burst=1 for the
+    whole engine; concurrent unconstrained output is token-identical to
+    its solo run, and grammar output stays valid."""
+    import json as _json
+    import os as _os
+
+    import jax as _jax
+
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling as smp
+    from localai_tpu.models import llama as _llama
+
+    monkeypatch.setenv("LOCALAI_ENGINE_TRACE", "1")
+    cfg = _llama.LlamaConfig(
+        vocab_size=258, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, max_position_embeddings=256)
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(0))
+    tok = byte_tokenizer
+
+    def make():
+        e = eng.Engine(cfg, params, tok, eng.EngineConfig(
+            num_slots=2, max_context=128, prefill_buckets=(16, 64),
+            prefill_chunk=64, decode_burst=8))
+        e.start()
+        return e
+
+    def greedy_req(text, n=16):
+        return eng.GenRequest(prompt_ids=tok.encode(text),
+                              params=smp.SamplingParamsHost(temperature=0.0),
+                              max_new_tokens=n, ignore_eos=True)
+
+    # solo baseline for the unconstrained request
+    e = make()
+    try:
+        _, solo = e.generate_text(greedy_req("free text"))
+        solo_ids = eng.event_ids(solo)
+    finally:
+        e.shutdown()
+
+    gbnf = 'root ::= "[" [0-9] ("," [0-9]){0,8} "]"'
+    e = make()
+    try:
+        gout = e.submit(eng.GenRequest(
+            prompt_ids=tok.encode("json:"),
+            params=smp.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=24, grammar=gbnf))
+        fout = e.submit(greedy_req("free text"))
+        gtext, ftext = [], []
+        for out, acc in ((gout, gtext), (fout, ftext)):
+            while True:
+                ev = out.get()
+                if ev is None:
+                    break
+                acc.append(ev)
+        assert eng.event_ids(ftext) == solo_ids
+        text = "".join(e2.text for e2 in gtext)
+        import re as _re
+
+        assert _re.fullmatch(r"\[\d(,\d){0,8}\]", text), text
+        # the engine really did run multi-step bursts while the grammar
+        # slot was active
+        steps, n_bursts = e._tstats.get("burst_steps", [0, 1])
+        assert n_bursts and steps / n_bursts > 1.0
+    finally:
+        e.shutdown()
