@@ -25,15 +25,43 @@ from localai_tpu.backend.service import BackendServicer, make_server
 log = logging.getLogger("localai_tpu.backend.tts_runner")
 
 
+class _VocabTokenizer:
+    """Minimal VITS text frontend when transformers' VitsTokenizer is
+    unavailable: vocab.json char map with the interspersed pad token
+    (VitsTokenizer add_blank semantics)."""
+
+    def __init__(self, model_dir: str):
+        import json
+
+        with open(os.path.join(model_dir, "vocab.json")) as f:
+            self.vocab = json.load(f)
+        self.pad = self.vocab.get("<pad>", self.vocab.get(" ", 0))
+
+    def encode(self, text: str):
+        ids = [self.pad]
+        for ch in text.lower():
+            tid = self.vocab.get(ch)
+            if tid is None:
+                continue
+            ids += [tid, self.pad]
+        return ids
+
+
 class TTSServicer(BackendServicer):
     def __init__(self):
         self.params = None
         self.cfg = None
         self._voice_cache = {}
         self._lock = threading.Lock()
+        # real-checkpoint path (HF VitsModel: facebook/mms-tts-*,
+        # kakao-enterprise/vits-*) — set when config.json says vits
+        self.vits = None       # (cfg, params)
+        self.vits_tokenizer = None
 
     def LoadModel(self, request, context):
         try:
+            import json as _json
+
             import jax
 
             from localai_tpu.models import tts
@@ -41,8 +69,33 @@ class TTSServicer(BackendServicer):
             model_dir = request.model
             if request.model_path and model_dir and not os.path.isabs(model_dir):
                 model_dir = os.path.join(request.model_path, model_dir)
-            if model_dir and os.path.exists(os.path.join(model_dir, "config.json")):
-                self.cfg = tts.TTSConfig.from_json(os.path.join(model_dir, "config.json"))
+            cfg_path = os.path.join(model_dir or "", "config.json")
+            cfg_dict = {}
+            if model_dir and os.path.exists(cfg_path):
+                with open(cfg_path) as f:
+                    cfg_dict = _json.load(f)
+            # a reload must never leave a previous VITS model active
+            self.vits = None
+            self.vits_tokenizer = None
+            if cfg_dict.get("model_type") == "vits":
+                # published VITS/MMS checkpoint: full parity stack
+                from localai_tpu.models import vits as jvits
+
+                vcfg, vparams = jvits.load_params(
+                    model_dir, jvits.VitsConfig.from_dict(cfg_dict))
+                self.vits = (vcfg, vparams)
+                try:
+                    from transformers import AutoTokenizer
+
+                    self.vits_tokenizer = AutoTokenizer.from_pretrained(model_dir)
+                    self.vits_tokenizer("probe")  # some variants need phonemizer
+                except Exception:
+                    self.vits_tokenizer = _VocabTokenizer(model_dir)
+                # keep a toy config for SoundGeneration sample-rate math
+                self.cfg = tts.TTSConfig()
+                self.params = vparams
+            elif cfg_dict:
+                self.cfg = tts.TTSConfig.from_json(cfg_path)
                 self.params = tts.load_params(model_dir, self.cfg)
             else:
                 # no checkpoint: deterministic random voice (see module doc)
@@ -52,6 +105,27 @@ class TTSServicer(BackendServicer):
         except Exception as e:
             log.exception("LoadModel failed")
             return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _vits_synthesize(self, text: str, voice: str = "") -> tuple:
+        from localai_tpu.models import vits as jvits
+
+        vcfg, vparams = self.vits
+        ids = self.vits_tokenizer(text)["input_ids"] \
+            if callable(self.vits_tokenizer) else \
+            self.vits_tokenizer.encode(text)
+        speaker = None
+        if vcfg.num_speakers > 1:
+            try:
+                speaker = int(voice) if voice else 0
+            except ValueError:
+                speaker = 0
+            if not 0 <= speaker < vcfg.num_speakers:
+                # JAX gathers clamp silently; surface the config error
+                raise ValueError(
+                    f"voice {speaker} out of range 0-{vcfg.num_speakers - 1}")
+        wave = jvits.synthesize(vparams, vcfg, np.asarray(ids, np.int32),
+                                speaker_id=speaker, frame_pad_to=64)
+        return wave, vcfg.sampling_rate
 
     def _params_for_voice(self, voice: str):
         if not voice:
@@ -76,6 +150,11 @@ class TTSServicer(BackendServicer):
 
         try:
             with self._lock:
+                if self.vits is not None:
+                    wave, rate = self._vits_synthesize(request.text,
+                                                       request.voice)
+                    tts.write_wav(request.dst, wave, sample_rate=rate)
+                    return pb.Result(success=True, message="ok")
                 wave = tts.synthesize(self._params_for_voice(request.voice),
                                       self.cfg, request.text)
             tts.write_wav(request.dst, wave)
@@ -91,13 +170,17 @@ class TTSServicer(BackendServicer):
 
         try:
             with self._lock:
-                wave = tts.synthesize(self._params_for_voice(""), self.cfg,
-                                      request.text)
+                if self.vits is not None:
+                    wave, rate = self._vits_synthesize(request.text)
+                else:
+                    wave = tts.synthesize(self._params_for_voice(""), self.cfg,
+                                          request.text)
+                    rate = tts.SAMPLE_RATE
             if request.HasField("duration"):
-                want = int(request.duration * tts.SAMPLE_RATE)
+                want = int(request.duration * rate)
                 reps = max(1, -(-want // max(len(wave), 1)))
                 wave = np.tile(wave, reps)[:want]
-            tts.write_wav(request.dst, wave)
+            tts.write_wav(request.dst, wave, sample_rate=rate)
             return pb.Result(success=True, message="ok")
         except Exception as e:
             log.exception("SoundGeneration failed")
