@@ -220,6 +220,11 @@ async def delete_assistant_file(request):
             a["file_ids"].remove(fid)
         if deleted:
             store.save()
+    if not deleted:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": {"message": f"file {fid} not attached",
+                                       "type": "invalid_request_error"}}),
+            content_type="application/json")
     return _json({"id": fid, "object": "assistant.file.deleted",
                   "deleted": deleted})
 

@@ -164,7 +164,7 @@ class _Slot:
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
         "grammar", "gstate", "bias_base", "cur_penalty",
         "phase", "pending", "written", "reused", "cache_len", "committed",
-        "mm_pos", "mm_vec",
+        "mm_pos", "mm_vec", "spec_ok",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -184,6 +184,7 @@ class _Slot:
         self.phase = "prefill"  # "prefill" -> "decode"
         self.mm_pos = None      # np [P] absolute prompt positions (P-bucketed)
         self.mm_vec = None      # np [P, hidden] injected embeddings
+        self.spec_ok = False    # greedy+ungrammared: may join spec rounds
         self.pending: list[int] = []   # prompt tokens not yet prefilled
         self.written = 0        # cache rows already valid for this request
         self.reused = 0         # prefix tokens reused from a previous request
@@ -223,10 +224,10 @@ class Engine:
         # writes instead of ~3ms `.at[].set` dispatches, and the arrays ride
         # to the device as ordinary jit args each step.
         self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
+        # draft cache is allocated LAZILY at the first spec-eligible
+        # admission (r2 allocated it up front, doubling per-slot KV HBM
+        # even when no request could ever speculate)
         self.dck = self.dcv = None
-        if self.draft_cfg is not None:
-            self.dck, self.dcv = llama.init_cache(self.draft_cfg, S, C,
-                                                  self.ecfg.cache_dtype)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
             jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
@@ -265,6 +266,8 @@ class Engine:
         self._chunk_fns: dict[int, Callable] = {}
         self._final_fns: dict[tuple, Callable] = {}
         self._spec_fn = None
+        self._spec_turn = True   # mixed-traffic spec/burst alternation
+        self._last_active_key = None
 
         # pipelined decode state: device-side burst-to-burst chain of
         # (tokens, lengths, ring, ring_pos), the not-yet-processed burst,
@@ -593,10 +596,7 @@ class Engine:
         V = self.cfg.vocab_size
         self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
                                             self.ecfg.cache_dtype)
-        if self.draft_cfg is not None:
-            self.dck, self.dcv = llama.init_cache(self.draft_cfg, S,
-                                                  self.ecfg.max_context,
-                                                  self.ecfg.cache_dtype)
+        self.dck = self.dcv = None   # re-ensured at the next spec admission
         self.ring, self.ring_pos = sampling.make_ring(S)
         self.bias = jnp.zeros((S, V), jnp.float32)
         self.rng_keys = jax.vmap(jax.random.key_data)(
@@ -818,8 +818,25 @@ class Engine:
                 decoding = any(s is not None and s.phase == "decode"
                                for s in self.slots)
                 if decoding:
-                    if self._spec_ready():
-                        self._spec_once()
+                    eligible = self._spec_eligible()
+                    others = any(
+                        s is not None and s.phase == "decode"
+                        and not eligible[i]
+                        for i, s in enumerate(self.slots))
+                    if eligible.any() and not others:
+                        self._spec_once(eligible)
+                    elif eligible.any():
+                        # MIXED traffic: alternate spec rounds (eligible
+                        # slots) with normal bursts (the rest) — r2
+                        # disabled speculation fleet-wide the moment one
+                        # sampled request was active
+                        if self._spec_turn:
+                            self._spec_once(eligible)
+                        else:
+                            t0 = time.monotonic()
+                            self._decode_once(exclude=eligible)
+                            self._tmark("decode_once", t0)
+                        self._spec_turn = not self._spec_turn
                     else:
                         t0 = time.monotonic()
                         self._decode_once()
@@ -1025,6 +1042,23 @@ class Engine:
         s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
         s.cur_penalty = penalty0
         s.mm_pos, s.mm_vec = mm_pos, mm_vec
+        # per-SLOT speculation eligibility (r3; r2 was fleet-wide). Gates:
+        #   * greedy, ungrammared, no logit_bias and no penalties — the
+        #     spec verify accepts via raw argmax (speculative.py), so any
+        #     logit shaping would silently diverge from the burst sampler;
+        #   * no reused prefix (common == 0) — reused/restored rows exist
+        #     only in the MAIN cache; the draft would attend over zeros
+        #     for the prefix and every proposal would be garbage.
+        sp = req.params
+        s.spec_ok = (self.draft_params is not None and self.ecfg.n_draft > 0
+                     and sp.temperature <= 0 and not req.grammar
+                     and mm_pos is None and common == 0
+                     and not sp.logit_bias
+                     and sp.repeat_penalty in (0.0, 1.0)
+                     and sp.presence_penalty == 0.0
+                     and sp.frequency_penalty == 0.0)
+        if s.spec_ok:
+            self._ensure_draft_cache()
         s.pending = ids[common:]
         s.written = common
         s.reused = common
@@ -1098,7 +1132,17 @@ class Engine:
                 n = len(ids) - 1
                 self.ck, self.cv = self._get_fork_fn("main")(
                     self.ck, self.cv, leader_slot, sib, n)
-                if self.draft_params is not None:
+                # a sibling inherits spec eligibility only when the leader's
+                # draft rows exist to fork and its own request qualifies
+                # under the same admission gates (see _start_request)
+                sp = s.req.params
+                s.spec_ok = (lsnap.spec_ok and self.dck is not None
+                             and sp.temperature <= 0 and not s.req.grammar
+                             and not sp.logit_bias
+                             and sp.repeat_penalty in (0.0, 1.0)
+                             and sp.presence_penalty == 0.0
+                             and sp.frequency_penalty == 0.0)
+                if self.dck is not None and lsnap.spec_ok:
                     self.dck, self.dcv = self._get_fork_fn("draft")(
                         self.dck, self.dcv, leader_slot, sib, n)
                 s.pending = [ids[-1]]
@@ -1265,7 +1309,7 @@ class Engine:
             else:
                 fn = self._get_chunk_fn(bucket)
             self.ck, self.cv = fn(*args)
-            if self.draft_params is not None:
+            if self.dck is not None and s.spec_ok:
                 # mirror the prompt into the draft cache (speculative
                 # rounds need the same context; see engine/speculative.py)
                 self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
@@ -1317,8 +1361,10 @@ class Engine:
         else:
             fn = self._get_final_fn(bucket, B, continued)
         out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
-        if self.draft_params is not None:
-            # draft ingests the same prompt rows (no sampling needed)
+        if self.dck is not None and any(
+                self.slots[g].spec_ok for g, _ in group):
+            # draft ingests the same prompt rows (no sampling needed);
+            # padded/ineligible rows are harmless duplicates
             self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
                 self.draft_params, tokens, seq_len, self.dck, self.dcv,
                 slots_v, start_v)
@@ -1422,6 +1468,12 @@ class Engine:
             k *= 2
         return k
 
+    def _ensure_draft_cache(self):
+        if self.dck is None and self.draft_cfg is not None:
+            self.dck, self.dcv = llama.init_cache(
+                self.draft_cfg, self.ecfg.num_slots, self.ecfg.max_context,
+                self.ecfg.cache_dtype)
+
     def _get_spec_fn(self):
         if self._spec_fn is None:
             from localai_tpu.engine import speculative
@@ -1433,34 +1485,37 @@ class Engine:
                 donate_argnums=(4, 5, 6, 7))
         return self._spec_fn
 
-    def _spec_ready(self) -> bool:
-        """Speculate this round? Needs a draft model, every active slot
-        greedy and ungrammared, and D+1 rows of cache headroom."""
-        if self.draft_params is None or self.ecfg.n_draft <= 0:
-            return False
+    def _spec_eligible(self) -> "np.ndarray":
+        """Per-SLOT speculation mask (r3; the r2 design was all-or-nothing
+        across the fleet): a slot joins spec rounds iff it admitted as
+        spec_ok (greedy, ungrammared, draft-mirrored prompt) and has D+1
+        rows of headroom."""
+        S = self.ecfg.num_slots
+        mask = np.zeros((S,), np.bool_)
+        if self.dck is None or self.ecfg.n_draft <= 0:
+            return mask
         D = self.ecfg.n_draft
         for i, s in enumerate(self.slots):
-            if s is None or s.phase != "decode":
-                continue
-            if s.grammar is not None or not self.slot_params["greedy"][i]:
-                return False
-            if self.ecfg.max_context - 2 - s.cache_len < D + 1:
-                return False
-        return True
+            if (s is not None and s.phase == "decode" and s.spec_ok
+                    and self.ecfg.max_context - 2 - s.cache_len >= D + 1):
+                mask[i] = True
+        return mask
 
-    def _spec_once(self):
-        """One speculative round (no pipelining: rounds advance lengths
-        per-slot, so the burst chain is not reusable)."""
+    def _spec_once(self, eligible: "np.ndarray"):
+        """One speculative round for the ELIGIBLE slots only (no
+        pipelining: rounds advance lengths per-slot, so the burst chain is
+        not reusable)."""
         if self._inflight is not None:
             self._process_burst(self._inflight)
             self._inflight = None
         fn = self._get_spec_fn()
         burst_slots = [(i, s) for i, s in enumerate(self.slots)
-                       if s is not None and s.phase == "decode"]
+                       if s is not None and s.phase == "decode"
+                       and eligible[i]]
         out, out_lp, n_out, self.ck, self.cv, self.dck, self.dcv, _ = fn(
             self.params, self.draft_params, self.cur_tokens.copy(),
             self.lengths.copy(), self.ck, self.cv, self.dck, self.dcv,
-            self.active_dev.copy())
+            self.active_dev.copy() & eligible)
         out_np = np.asarray(out)
         lp_np = np.asarray(out_lp)
         n_np = np.asarray(n_out)
@@ -1484,14 +1539,22 @@ class Engine:
                 snap.committed = min(snap.committed + 1, snap.cache_len)
                 self._emit_token(i, int(out_np[i, j]), float(lp_np[i, j]))
 
-    def _decode_once(self):
+    def _decode_once(self, exclude: Optional["np.ndarray"] = None):
         """Dispatch one decode burst, PIPELINED: the previous burst's host
         processing (sync, detok, stop-scan, queue puts) happens while this
         burst runs on the device. Burst-to-burst state (tokens/lengths/ring)
         chains device-side; whenever host events (admission, release,
         context shift) invalidate the chain, the burst is fed from the host
         mirrors instead — which requires the previous burst's results to be
-        folded into the mirrors first."""
+        folded into the mirrors first. ``exclude`` masks out slots that are
+        advancing through spec rounds instead (mixed-traffic alternation)."""
+        active = self.active_dev.copy()
+        if exclude is not None:
+            active &= ~exclude
+        key = active.tobytes()
+        if key != getattr(self, "_last_active_key", None):
+            self._chain_dirty = True
+            self._last_active_key = key
         if self._inflight is not None and self._chain_dirty:
             # dispatching from mirrors requires the previous burst
             # folded in first — but only the FOLD (sync + mirror
@@ -1527,12 +1590,13 @@ class Engine:
         # released and re-admitted while this burst is in flight, and the
         # new occupant must never receive the stale burst's tokens
         burst_slots = [(i, s) for i, s in enumerate(self.slots)
-                       if s is not None and s.phase == "decode"]
+                       if s is not None and s.phase == "decode"
+                       and (exclude is None or not exclude[i])]
         ids_all, lps_all, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, tokens, self.ck, self.cv, lengths,
             ring, rpos, self.bias, self.rng_keys,
             jax.tree.map(np.array, self.slot_params),
-            self.active_dev.copy(), mu,
+            active, mu,
         )
         self._chain_dirty = False
         self._tmark("dispatch", t_d)

@@ -43,6 +43,16 @@ class FederatedServer:
         self.workers = [Worker(w) for w in workers]
         self.strategy = strategy
         self.timeout_s = timeout_s
+        self._session = None   # shared, created lazily on the serving loop
+
+    def _get_session(self):
+        """One shared ClientSession (connection pool) for all proxied
+        requests — a fresh session per request paid TCP(+TLS) setup on the
+        hot path (r2 review). Lazy: must be created on the running loop."""
+        if self._session is None or self._session.closed:
+            self._session = ClientSession(
+                timeout=ClientTimeout(total=self.timeout_s))
+        return self._session
 
     def pick(self):
         candidates = [w for w in self.workers if w.online()] or self.workers
@@ -59,21 +69,19 @@ class FederatedServer:
         worker.inflight += 1
         resp = None
         try:
-            async with ClientSession(
-                timeout=ClientTimeout(total=self.timeout_s)
-            ) as session:
-                async with session.request(request.method, url, data=body,
-                                           headers=headers) as upstream:
-                    resp = web.StreamResponse(status=upstream.status)
-                    for k, v in upstream.headers.items():
-                        if k.lower() not in HOP_HEADERS:
-                            resp.headers[k] = v
-                    await resp.prepare(request)
-                    # stream chunks through (SSE token streams stay live)
-                    async for chunk in upstream.content.iter_any():
-                        await resp.write(chunk)
-                    await resp.write_eof()
-                    return resp
+            session = self._get_session()
+            async with session.request(request.method, url, data=body,
+                                       headers=headers) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                # stream chunks through (SSE token streams stay live)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
         except Exception as e:
             worker.failed_at = time.monotonic()
             log.warning("worker %s failed: %s", worker.base, e)
@@ -102,6 +110,12 @@ class FederatedServer:
         app = web.Application()
         app.router.add_get("/federation/status", self.status)
         app.router.add_route("*", "/{path:.*}", self.proxy)
+
+        async def _close_session(_app):
+            if self._session is not None and not self._session.closed:
+                await self._session.close()
+
+        app.on_cleanup.append(_close_session)
         return app
 
 

