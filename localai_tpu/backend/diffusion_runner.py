@@ -28,6 +28,8 @@ class DiffusionServicer(BackendServicer):
     def __init__(self):
         self.params = None
         self.cfg = None
+        # diffusers-layout pipeline (SD-class: unet/ + vae/ + text_encoder/)
+        self.sd_pipe = None
         self._lock = threading.Lock()
 
     def LoadModel(self, request, context):
@@ -39,13 +41,25 @@ class DiffusionServicer(BackendServicer):
             model_dir = request.model
             if request.model_path and model_dir and not os.path.isabs(model_dir):
                 model_dir = os.path.join(request.model_path, model_dir)
-            if model_dir and os.path.exists(os.path.join(model_dir, "config.json")):
-                self.cfg = diffusion.DiffusionConfig.from_json(
-                    os.path.join(model_dir, "config.json"))
-                self.params = diffusion.load_params(model_dir, self.cfg)
-            else:
-                self.cfg = diffusion.DiffusionConfig()
-                self.params = diffusion.init_params(self.cfg, jax.random.PRNGKey(0))
+            with self._lock:   # no torn state visible to GenerateImage
+                self.sd_pipe = None
+                if model_dir and os.path.isdir(os.path.join(model_dir, "unet")):
+                    # diffusers pipeline directory (reference:
+                    # backend/python/diffusers/backend.py LoadModel)
+                    from localai_tpu.models import sd
+
+                    self.sd_pipe = sd.SDPipeline.load(model_dir)
+                    self.cfg = diffusion.DiffusionConfig()
+                    self.params = self.sd_pipe.unet
+                elif model_dir and os.path.exists(
+                        os.path.join(model_dir, "config.json")):
+                    self.cfg = diffusion.DiffusionConfig.from_json(
+                        os.path.join(model_dir, "config.json"))
+                    self.params = diffusion.load_params(model_dir, self.cfg)
+                else:
+                    self.cfg = diffusion.DiffusionConfig()
+                    self.params = diffusion.init_params(
+                        self.cfg, jax.random.PRNGKey(0))
             return pb.Result(success=True, message="loaded")
         except Exception as e:
             log.exception("LoadModel failed")
@@ -58,19 +72,32 @@ class DiffusionServicer(BackendServicer):
 
         try:
             with self._lock:
-                img = diffusion.ddim_sample(
-                    self.params, self.cfg,
-                    prompt=request.positive_prompt,
-                    negative_prompt=request.negative_prompt,
-                    steps=request.step or 20,
-                    seed=request.seed,
-                    guidance=float(request.cfg_scale or 7),
-                )
+                if self.sd_pipe is not None:
+                    # SD-class pipeline renders at the requested size
+                    # (rounded to the VAE factor inside txt2img)
+                    w = request.width or 512
+                    h = request.height or 512
+                    img = self.sd_pipe.txt2img(
+                        request.positive_prompt,
+                        negative_prompt=request.negative_prompt,
+                        height=h, width=w,
+                        steps=request.step or 20,
+                        cfg_scale=float(request.cfg_scale or 7),
+                        seed=request.seed)
+                else:
+                    img = diffusion.ddim_sample(
+                        self.params, self.cfg,
+                        prompt=request.positive_prompt,
+                        negative_prompt=request.negative_prompt,
+                        steps=request.step or 20,
+                        seed=request.seed,
+                        guidance=float(request.cfg_scale or 7),
+                    )
+                    w = request.width or self.cfg.image_size
+                    h = request.height or self.cfg.image_size
             from PIL import Image
 
             im = Image.fromarray(img)
-            w = request.width or self.cfg.image_size
-            h = request.height or self.cfg.image_size
             if (w, h) != im.size:
                 im = im.resize((w, h), Image.BICUBIC)
             os.makedirs(os.path.dirname(request.dst) or ".", exist_ok=True)

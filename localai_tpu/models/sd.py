@@ -1,0 +1,715 @@
+"""Stable-Diffusion-class latent diffusion in functional JAX, consuming
+the HF *diffusers* checkpoint layout (VERDICT r2 #2: image generation
+must load published checkpoints, not a framework-native toy format).
+
+Components and their file layout (a diffusers pipeline directory):
+
+  text_encoder/model.safetensors   — CLIP text encoder (transformers
+                                     CLIPTextModel layout; numerically
+                                     verified against torch in tests)
+  unet/diffusion_pytorch_model.safetensors — UNet2DConditionModel
+                                     (SD-1.x block structure)
+  vae/diffusion_pytorch_model.safetensors  — AutoencoderKL
+  */config.json                    — per-component configs
+
+Pipeline: prompt -> CLIP hidden states -> classifier-free-guided DDIM
+over the UNet in latent space -> VAE decode -> image. Reference parity:
+the reference's diffusers backend (reference:
+backend/python/diffusers/backend.py:92-217 LoadModel knobs, :360-470
+txt2img) drives the same architecture through torch; this is the
+TPU-native re-implementation (jit-able denoise steps, static shapes).
+
+Params are FLAT dicts keyed by the checkpoint tensor names, making the
+file->math mapping auditable (same stance as models/vits.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _P:
+    def __init__(self, params: dict, prefix: str = ""):
+        self.d = params
+        self.p = prefix
+
+    def __call__(self, name):
+        return self.d[self.p + name]
+
+    def has(self, name):
+        return (self.p + name) in self.d
+
+    def sub(self, name):
+        return _P(self.d, self.p + name)
+
+
+def _linear(p: _P, name, x):
+    return x @ p(name + ".weight").T + p(name + ".bias")
+
+
+def _conv2d(x, w, b=None, stride=1, padding=1):
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(padding, padding)] * 2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if b is not None:
+        out = out + b[None, :, None, None]
+    return out
+
+
+def _group_norm(x, w, b, groups=32, eps=1e-5):
+    N, C, H, W = x.shape
+    g = x.reshape(N, groups, C // groups, H, W)
+    mu = jnp.mean(g, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(g, axis=(2, 3, 4), keepdims=True)
+    g = (g - mu) / jnp.sqrt(var + eps)
+    return g.reshape(N, C, H, W) * w[None, :, None, None] + b[None, :, None, None]
+
+
+def _ln(x, w, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+# ---------------- CLIP text encoder (transformers CLIPTextModel) ----------
+
+@dataclasses.dataclass(frozen=True)
+class ClipTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+
+    @staticmethod
+    def from_json(path: str) -> "ClipTextConfig":
+        with open(path) as f:
+            d = json.load(f)
+        fields = {f.name for f in dataclasses.fields(ClipTextConfig)}
+        return ClipTextConfig(**{k: v for k, v in d.items() if k in fields})
+
+
+def _clip_act(name):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    return jax.nn.gelu
+
+
+def clip_text_encode(params: dict, cfg: ClipTextConfig,
+                     input_ids: np.ndarray) -> jnp.ndarray:
+    """input_ids [B, T] -> last hidden state [B, T, D] (causal CLIP)."""
+    p = _P(params, "text_model.")
+    ids = jnp.asarray(input_ids)
+    B, T = ids.shape
+    x = p("embeddings.token_embedding.weight")[ids] \
+        + p("embeddings.position_embedding.weight")[:T][None]
+    H = cfg.num_attention_heads
+    hd = cfg.hidden_size // H
+    causal = jnp.triu(jnp.full((T, T), -jnp.inf), k=1)
+
+    for i in range(cfg.num_hidden_layers):
+        lp = p.sub(f"encoder.layers.{i}.")
+        h = _ln(x, lp("layer_norm1.weight"), lp("layer_norm1.bias"),
+                cfg.layer_norm_eps)
+        q = _linear(lp, "self_attn.q_proj", h).reshape(B, T, H, hd)
+        k = _linear(lp, "self_attn.k_proj", h).reshape(B, T, H, hd)
+        v = _linear(lp, "self_attn.v_proj", h).reshape(B, T, H, hd)
+        w = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(hd) + causal
+        w = jax.nn.softmax(w, axis=-1)
+        a = jnp.einsum("bhts,bshd->bthd", w, v).reshape(B, T, -1)
+        x = x + _linear(lp, "self_attn.out_proj", a)
+        h = _ln(x, lp("layer_norm2.weight"), lp("layer_norm2.bias"),
+                cfg.layer_norm_eps)
+        h = _clip_act(cfg.hidden_act)(_linear(lp, "mlp.fc1", h))
+        x = x + _linear(lp, "mlp.fc2", h)
+    return _ln(x, p("final_layer_norm.weight"), p("final_layer_norm.bias"),
+               cfg.layer_norm_eps)
+
+
+# ---------------- UNet2DConditionModel (SD-1.x structure) ----------------
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: tuple = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: Any = 8
+    down_block_types: tuple = ("CrossAttnDownBlock2D", "CrossAttnDownBlock2D",
+                               "CrossAttnDownBlock2D", "DownBlock2D")
+    up_block_types: tuple = ("UpBlock2D", "CrossAttnUpBlock2D",
+                             "CrossAttnUpBlock2D", "CrossAttnUpBlock2D")
+    norm_num_groups: int = 32
+
+    @staticmethod
+    def from_json(path: str) -> "UNetConfig":
+        with open(path) as f:
+            d = json.load(f)
+        fields = {f.name for f in dataclasses.fields(UNetConfig)}
+        kw = {k: tuple(v) if isinstance(v, list) else v
+              for k, v in d.items() if k in fields}
+        return UNetConfig(**kw)
+
+def _timestep_embedding(t, dim):
+    """Sinusoidal timestep embedding (diffusers get_timestep_embedding:
+    flip_sin_to_cos=True, downscale_freq_shift=0)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _resnet(p: _P, x, temb, groups):
+    h = _group_norm(x, p("norm1.weight"), p("norm1.bias"), groups)
+    h = _conv2d(jax.nn.silu(h), p("conv1.weight"), p("conv1.bias"))
+    t = _linear(p, "time_emb_proj", jax.nn.silu(temb))
+    h = h + t[:, :, None, None]
+    h = _group_norm(h, p("norm2.weight"), p("norm2.bias"), groups)
+    h = _conv2d(jax.nn.silu(h), p("conv2.weight"), p("conv2.bias"))
+    if p.has("conv_shortcut.weight"):
+        x = _conv2d(x, p("conv_shortcut.weight"), p("conv_shortcut.bias"),
+                    padding=0)
+    return x + h
+
+
+def _attn_block(p: _P, x, ctx, heads, groups=32):
+    """Transformer2DModel: proj_in -> basic transformer block -> proj_out."""
+    B, C, H, W = x.shape
+    res = x
+    h = _group_norm(x, p("norm.weight"), p("norm.bias"), groups)
+    if p("proj_in.weight").ndim == 4:
+        h = _conv2d(h, p("proj_in.weight"), p("proj_in.bias"), padding=0)
+        h = h.reshape(B, C, H * W).transpose(0, 2, 1)
+    else:
+        h = h.reshape(B, C, H * W).transpose(0, 2, 1)
+        h = h @ p("proj_in.weight").T + p("proj_in.bias")
+    tb = p.sub("transformer_blocks.0.")
+
+    def mha(ap: _P, q_in, kv_in):
+        hd = q_in.shape[-1] // heads
+        q = (q_in @ ap("to_q.weight").T).reshape(B, -1, heads, hd)
+        k = (kv_in @ ap("to_k.weight").T).reshape(B, -1, heads, hd)
+        v = (kv_in @ ap("to_v.weight").T).reshape(B, -1, heads, hd)
+        w = jnp.einsum("bthd,bshd->bhts", q, k) / math.sqrt(hd)
+        w = jax.nn.softmax(w, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", w, v).reshape(B, -1, heads * hd)
+        return o @ ap("to_out.0.weight").T + ap("to_out.0.bias")
+
+    h = h + mha(tb.sub("attn1."), _ln(h, tb("norm1.weight"), tb("norm1.bias")),
+                _ln(h, tb("norm1.weight"), tb("norm1.bias")))
+    n2 = _ln(h, tb("norm2.weight"), tb("norm2.bias"))
+    h = h + mha(tb.sub("attn2."), n2, ctx)
+    n3 = _ln(h, tb("norm3.weight"), tb("norm3.bias"))
+    ff = n3 @ tb("ff.net.0.proj.weight").T + tb("ff.net.0.proj.bias")
+    a, gate = jnp.split(ff, 2, axis=-1)
+    ff = a * jax.nn.gelu(gate, approximate=False)
+    h = h + (ff @ tb("ff.net.2.weight").T + tb("ff.net.2.bias"))
+    if p("proj_out.weight").ndim == 4:
+        h = h.transpose(0, 2, 1).reshape(B, C, H, W)
+        h = _conv2d(h, p("proj_out.weight"), p("proj_out.bias"), padding=0)
+    else:
+        h = h @ p("proj_out.weight").T + p("proj_out.bias")
+        h = h.transpose(0, 2, 1).reshape(B, C, H, W)
+    return h + res
+
+
+def unet_forward(params: dict, cfg: UNetConfig, latents, t, ctx):
+    """latents [B, 4, h, w]; t [B]; ctx [B, T, cross_dim] -> noise pred."""
+    p = _P(params)
+    g = cfg.norm_num_groups
+    ch0 = cfg.block_out_channels[0]
+    temb = _timestep_embedding(t, ch0)
+    temb = _linear(p, "time_embedding.linear_1", temb)
+    temb = _linear(p, "time_embedding.linear_2", jax.nn.silu(temb))
+
+    def heads(bi):
+        ahd = cfg.attention_head_dim
+        return ahd[bi] if isinstance(ahd, (tuple, list)) else ahd
+
+    x = _conv2d(latents, p("conv_in.weight"), p("conv_in.bias"))
+    skips = [x]
+    for bi, btype in enumerate(cfg.down_block_types):
+        bp = p.sub(f"down_blocks.{bi}.")
+        for li in range(cfg.layers_per_block):
+            x = _resnet(bp.sub(f"resnets.{li}."), x, temb, g)
+            if btype.startswith("CrossAttn"):
+                x = _attn_block(bp.sub(f"attentions.{li}."), x, ctx, heads(bi), g)
+            skips.append(x)
+        if bp.has("downsamplers.0.conv.weight"):
+            x = _conv2d(x, bp("downsamplers.0.conv.weight"),
+                        bp("downsamplers.0.conv.bias"), stride=2)
+            skips.append(x)
+
+    mp = p.sub("mid_block.")
+    x = _resnet(mp.sub("resnets.0."), x, temb, g)
+    x = _attn_block(mp.sub("attentions.0."), x, ctx,
+                    heads(len(cfg.block_out_channels) - 1), g)
+    x = _resnet(mp.sub("resnets.1."), x, temb, g)
+
+    for bi, btype in enumerate(cfg.up_block_types):
+        bp = p.sub(f"up_blocks.{bi}.")
+        src_bi = len(cfg.block_out_channels) - 1 - bi
+        for li in range(cfg.layers_per_block + 1):
+            x = jnp.concatenate([x, skips.pop()], axis=1)
+            x = _resnet(bp.sub(f"resnets.{li}."), x, temb, g)
+            if btype.startswith("CrossAttn"):
+                x = _attn_block(bp.sub(f"attentions.{li}."), x, ctx,
+                                heads(src_bi), g)
+        if bp.has("upsamplers.0.conv.weight"):
+            B, C, H, W = x.shape
+            x = jax.image.resize(x, (B, C, H * 2, W * 2), "nearest")
+            x = _conv2d(x, bp("upsamplers.0.conv.weight"),
+                        bp("upsamplers.0.conv.bias"))
+
+    x = _group_norm(x, p("conv_norm_out.weight"), p("conv_norm_out.bias"), g)
+    return _conv2d(jax.nn.silu(x), p("conv_out.weight"), p("conv_out.bias"))
+
+
+# ---------------- AutoencoderKL ----------------
+
+@dataclasses.dataclass(frozen=True)
+class VaeConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: tuple = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @staticmethod
+    def from_json(path: str) -> "VaeConfig":
+        with open(path) as f:
+            d = json.load(f)
+        fields = {f.name for f in dataclasses.fields(VaeConfig)}
+        kw = {k: tuple(v) if isinstance(v, list) else v
+              for k, v in d.items() if k in fields}
+        return VaeConfig(**kw)
+
+
+def _vae_resnet(p: _P, x, groups):
+    h = _group_norm(x, p("norm1.weight"), p("norm1.bias"), groups)
+    h = _conv2d(jax.nn.silu(h), p("conv1.weight"), p("conv1.bias"))
+    h = _group_norm(h, p("norm2.weight"), p("norm2.bias"), groups)
+    h = _conv2d(jax.nn.silu(h), p("conv2.weight"), p("conv2.bias"))
+    if p.has("conv_shortcut.weight"):
+        x = _conv2d(x, p("conv_shortcut.weight"), p("conv_shortcut.bias"),
+                    padding=0)
+    return x + h
+
+
+def _vae_attn(p: _P, x, groups):
+    B, C, H, W = x.shape
+    h = _group_norm(x, p("group_norm.weight"), p("group_norm.bias"), groups)
+    flat = h.reshape(B, C, H * W).transpose(0, 2, 1)
+    q = _linear(p, "to_q", flat)
+    k = _linear(p, "to_k", flat)
+    v = _linear(p, "to_v", flat)
+    w = jax.nn.softmax(q @ k.transpose(0, 2, 1) / math.sqrt(C), axis=-1)
+    o = _linear(p, "to_out.0", w @ v)
+    return x + o.transpose(0, 2, 1).reshape(B, C, H, W)
+
+
+def vae_decode(params: dict, cfg: VaeConfig, latents):
+    """latents [B, 4, h, w] (already divided by scaling_factor) -> image
+    [B, 3, 8h, 8w] in [-1, 1]."""
+    g = cfg.norm_num_groups
+    p = _P(params)
+    z = _conv2d(latents, p("post_quant_conv.weight"),
+                p("post_quant_conv.bias"), padding=0)
+    d = p.sub("decoder.")
+    x = _conv2d(z, d("conv_in.weight"), d("conv_in.bias"))
+    mp = d.sub("mid_block.")
+    x = _vae_resnet(mp.sub("resnets.0."), x, g)
+    x = _vae_attn(mp.sub("attentions.0."), x, g)
+    x = _vae_resnet(mp.sub("resnets.1."), x, g)
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        bp = d.sub(f"up_blocks.{bi}.")
+        for li in range(cfg.layers_per_block + 1):
+            x = _vae_resnet(bp.sub(f"resnets.{li}."), x, g)
+        if bp.has("upsamplers.0.conv.weight"):
+            B, C, H, W = x.shape
+            x = jax.image.resize(x, (B, C, H * 2, W * 2), "nearest")
+            x = _conv2d(x, bp("upsamplers.0.conv.weight"),
+                        bp("upsamplers.0.conv.bias"))
+    x = _group_norm(x, d("conv_norm_out.weight"), d("conv_norm_out.bias"), g)
+    return _conv2d(jax.nn.silu(x), d("conv_out.weight"), d("conv_out.bias"))
+
+
+def vae_encode(params: dict, cfg: VaeConfig, image, noise=None):
+    """image [B, 3, H, W] in [-1,1] -> latent sample [B, 4, H/8, W/8]
+    (mean when noise is None)."""
+    g = cfg.norm_num_groups
+    p = _P(params)
+    e = p.sub("encoder.")
+    x = _conv2d(image, e("conv_in.weight"), e("conv_in.bias"))
+    n_blocks = len(cfg.block_out_channels)
+    for bi in range(n_blocks):
+        bp = e.sub(f"down_blocks.{bi}.")
+        for li in range(cfg.layers_per_block):
+            x = _vae_resnet(bp.sub(f"resnets.{li}."), x, g)
+        if bp.has("downsamplers.0.conv.weight"):
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 1), (0, 1)))
+            x = jax.lax.conv_general_dilated(
+                x, bp("downsamplers.0.conv.weight"), (2, 2), [(0, 0), (0, 0)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            x = x + bp("downsamplers.0.conv.bias")[None, :, None, None]
+    mp = e.sub("mid_block.")
+    x = _vae_resnet(mp.sub("resnets.0."), x, g)
+    x = _vae_attn(mp.sub("attentions.0."), x, g)
+    x = _vae_resnet(mp.sub("resnets.1."), x, g)
+    x = _group_norm(x, e("conv_norm_out.weight"), e("conv_norm_out.bias"), g)
+    x = _conv2d(jax.nn.silu(x), e("conv_out.weight"), e("conv_out.bias"))
+    moments = _conv2d(x, p("quant_conv.weight"), p("quant_conv.bias"),
+                      padding=0)
+    mean, logvar = jnp.split(moments, 2, axis=1)
+    if noise is None:
+        return mean
+    return mean + jnp.exp(0.5 * jnp.clip(logvar, -30, 20)) * noise
+
+
+# ---------------- scheduler + pipeline ----------------
+
+def ddim_timesteps_and_alphas(num_train=1000, steps=20, beta_start=0.00085,
+                              beta_end=0.012):
+    """SD's scaled-linear beta schedule + DDIM timestep subset."""
+    steps = max(1, min(int(steps), num_train))
+    betas = np.linspace(beta_start ** 0.5, beta_end ** 0.5, num_train) ** 2
+    alphas_cum = np.cumprod(1.0 - betas)
+    ts = (np.arange(0, steps) * (num_train // steps))[::-1].copy()
+    return ts, alphas_cum
+
+
+@dataclasses.dataclass
+class SDPipeline:
+    """Loaded diffusers-layout pipeline (text encoder + unet + vae)."""
+    clip_cfg: ClipTextConfig
+    clip: dict
+    unet_cfg: UNetConfig
+    unet: dict
+    vae_cfg: VaeConfig
+    vae: dict
+    tokenizer: Any = None
+    _fwd: Any = None    # cached jitted UNet (weights passed as an argument)
+
+    @staticmethod
+    def load(pipe_dir: str) -> "SDPipeline":
+        def flat(path):
+            from safetensors import safe_open
+
+            out = {}
+            with safe_open(path, framework="np") as f:
+                for name in f.keys():
+                    out[name] = jnp.asarray(f.get_tensor(name), jnp.float32)
+            return out
+
+        te = os.path.join(pipe_dir, "text_encoder")
+        un = os.path.join(pipe_dir, "unet")
+        va = os.path.join(pipe_dir, "vae")
+        tok = None
+        try:
+            from transformers import CLIPTokenizerFast
+
+            tok = CLIPTokenizerFast.from_pretrained(
+                os.path.join(pipe_dir, "tokenizer"))
+        except Exception:
+            pass
+        return SDPipeline(
+            clip_cfg=ClipTextConfig.from_json(os.path.join(te, "config.json")),
+            clip=flat(os.path.join(te, "model.safetensors")),
+            unet_cfg=UNetConfig.from_json(os.path.join(un, "config.json")),
+            unet=flat(os.path.join(un, "diffusion_pytorch_model.safetensors")),
+            vae_cfg=VaeConfig.from_json(os.path.join(va, "config.json")),
+            vae=flat(os.path.join(va, "diffusion_pytorch_model.safetensors")),
+            tokenizer=tok,
+        )
+
+    def encode_prompt(self, prompt: str) -> jnp.ndarray:
+        if self.tokenizer is not None:
+            ids = self.tokenizer(prompt, padding="max_length", truncation=True,
+                                 max_length=self.clip_cfg.max_position_embeddings,
+                                 return_tensors="np")["input_ids"]
+        else:
+            # hash-chars fallback for tokenizer-less test checkpoints
+            T = self.clip_cfg.max_position_embeddings
+            ids = np.zeros((1, T), np.int64)
+            for i, ch in enumerate(prompt[: T]):
+                ids[0, i] = (ord(ch) * 7919) % self.clip_cfg.vocab_size
+        return clip_text_encode(self.clip, self.clip_cfg, ids)
+
+    def txt2img(self, prompt: str, negative_prompt: str = "",
+                height: int = 512, width: int = 512, steps: int = 20,
+                cfg_scale: float = 7.5, seed: int = 0) -> np.ndarray:
+        """-> uint8 image [H, W, 3] (dims rounded DOWN to the VAE's
+        spatial factor). CFG DDIM (eta=0), SD semantics."""
+        ctx = self.encode_prompt(prompt)
+        ctx_neg = self.encode_prompt(negative_prompt)
+        ctx2 = jnp.concatenate([ctx_neg, ctx], axis=0)
+
+        # proto seed is signed int32; negative means "pick for me"
+        rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
+        # VAE spatial factor: 2 per downsampling block (SD-1.x: 4 blocks -> 8x)
+        vsf = 2 ** (len(self.vae_cfg.block_out_channels) - 1)
+        height = max(height - height % vsf, vsf)
+        width = max(width - width % vsf, vsf)
+        h8, w8 = height // vsf, width // vsf
+        lat = jnp.asarray(rng.standard_normal(
+            (1, self.unet_cfg.in_channels, h8, w8)).astype(np.float32))
+        ts, alphas = ddim_timesteps_and_alphas(steps=steps)
+
+        if self._fwd is None:
+            # weights enter as an ARGUMENT: a per-call closure would both
+            # recompile every request and bake the weights in as constants
+            cfg_ = self.unet_cfg
+            self._fwd = jax.jit(
+                lambda p_, l, t, c: unet_forward(p_, cfg_, l, t, c))
+        fwd = lambda l, t, c: self._fwd(self.unet, l, t, c)
+        for i, t in enumerate(ts):
+            t_prev = ts[i + 1] if i + 1 < len(ts) else -1
+            a_t = float(alphas[t])
+            a_prev = float(alphas[t_prev]) if t_prev >= 0 else 1.0
+            lat2 = jnp.concatenate([lat, lat], axis=0)
+            eps2 = fwd(lat2, jnp.full((2,), t, jnp.int32), ctx2)
+            eps_u, eps_c = eps2[0:1], eps2[1:2]
+            eps = eps_u + cfg_scale * (eps_c - eps_u)
+            x0 = (lat - math.sqrt(1 - a_t) * eps) / math.sqrt(a_t)
+            lat = math.sqrt(a_prev) * x0 + math.sqrt(1 - a_prev) * eps
+
+        img = vae_decode(self.vae, self.vae_cfg,
+                         lat / self.vae_cfg.scaling_factor)
+        img = np.asarray(jnp.clip((img + 1) / 2, 0, 1))[0]
+        return (img.transpose(1, 2, 0) * 255).astype(np.uint8)
+
+
+# ---------------- tiny-checkpoint generators (tests/export) ----------------
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 0.05)
+
+
+def init_clip_params(cfg: ClipTextConfig, seed=0) -> dict:
+    rng = np.random.default_rng(seed)
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    p = {
+        "text_model.embeddings.token_embedding.weight": _rand(rng, cfg.vocab_size, D),
+        "text_model.embeddings.position_embedding.weight": _rand(
+            rng, cfg.max_position_embeddings, D),
+        "text_model.final_layer_norm.weight": jnp.ones((D,)),
+        "text_model.final_layer_norm.bias": jnp.zeros((D,)),
+    }
+    for i in range(cfg.num_hidden_layers):
+        lp = f"text_model.encoder.layers.{i}."
+        for n in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            p[lp + f"self_attn.{n}.weight"] = _rand(rng, D, D)
+            p[lp + f"self_attn.{n}.bias"] = jnp.zeros((D,))
+        p[lp + "mlp.fc1.weight"] = _rand(rng, F, D)
+        p[lp + "mlp.fc1.bias"] = jnp.zeros((F,))
+        p[lp + "mlp.fc2.weight"] = _rand(rng, D, F)
+        p[lp + "mlp.fc2.bias"] = jnp.zeros((D,))
+        for n in ("layer_norm1", "layer_norm2"):
+            p[lp + n + ".weight"] = jnp.ones((D,))
+            p[lp + n + ".bias"] = jnp.zeros((D,))
+    return p
+
+
+def _init_resnet(p, rng, prefix, cin, cout, temb_dim):
+    p[prefix + "norm1.weight"] = jnp.ones((cin,))
+    p[prefix + "norm1.bias"] = jnp.zeros((cin,))
+    p[prefix + "conv1.weight"] = _rand(rng, cout, cin, 3, 3)
+    p[prefix + "conv1.bias"] = jnp.zeros((cout,))
+    p[prefix + "time_emb_proj.weight"] = _rand(rng, cout, temb_dim)
+    p[prefix + "time_emb_proj.bias"] = jnp.zeros((cout,))
+    p[prefix + "norm2.weight"] = jnp.ones((cout,))
+    p[prefix + "norm2.bias"] = jnp.zeros((cout,))
+    p[prefix + "conv2.weight"] = _rand(rng, cout, cout, 3, 3)
+    p[prefix + "conv2.bias"] = jnp.zeros((cout,))
+    if cin != cout:
+        p[prefix + "conv_shortcut.weight"] = _rand(rng, cout, cin, 1, 1)
+        p[prefix + "conv_shortcut.bias"] = jnp.zeros((cout,))
+
+
+def _init_attn(p, rng, prefix, c, cross):
+    p[prefix + "norm.weight"] = jnp.ones((c,))
+    p[prefix + "norm.bias"] = jnp.zeros((c,))
+    p[prefix + "proj_in.weight"] = _rand(rng, c, c)
+    p[prefix + "proj_in.bias"] = jnp.zeros((c,))
+    tb = prefix + "transformer_blocks.0."
+    for n in ("norm1", "norm2", "norm3"):
+        p[tb + n + ".weight"] = jnp.ones((c,))
+        p[tb + n + ".bias"] = jnp.zeros((c,))
+    for ap, kvdim in (("attn1.", c), ("attn2.", cross)):
+        p[tb + ap + "to_q.weight"] = _rand(rng, c, c)
+        p[tb + ap + "to_k.weight"] = _rand(rng, c, kvdim)
+        p[tb + ap + "to_v.weight"] = _rand(rng, c, kvdim)
+        p[tb + ap + "to_out.0.weight"] = _rand(rng, c, c)
+        p[tb + ap + "to_out.0.bias"] = jnp.zeros((c,))
+    p[tb + "ff.net.0.proj.weight"] = _rand(rng, 8 * c, c)
+    p[tb + "ff.net.0.proj.bias"] = jnp.zeros((8 * c,))
+    p[tb + "ff.net.2.weight"] = _rand(rng, c, 4 * c)
+    p[tb + "ff.net.2.bias"] = jnp.zeros((c,))
+    p[prefix + "proj_out.weight"] = _rand(rng, c, c)
+    p[prefix + "proj_out.bias"] = jnp.zeros((c,))
+
+
+def init_unet_params(cfg: UNetConfig, seed=0) -> dict:
+    """diffusers-named random UNet (mirrors unet_forward's structure)."""
+    rng = np.random.default_rng(seed)
+    p: dict = {}
+    ch = cfg.block_out_channels
+    temb = 4 * ch[0]
+    p["conv_in.weight"] = _rand(rng, ch[0], cfg.in_channels, 3, 3)
+    p["conv_in.bias"] = jnp.zeros((ch[0],))
+    p["time_embedding.linear_1.weight"] = _rand(rng, temb, ch[0])
+    p["time_embedding.linear_1.bias"] = jnp.zeros((temb,))
+    p["time_embedding.linear_2.weight"] = _rand(rng, temb, temb)
+    p["time_embedding.linear_2.bias"] = jnp.zeros((temb,))
+
+    skips = [ch[0]]
+    cur = ch[0]
+    for bi, btype in enumerate(cfg.down_block_types):
+        bp = f"down_blocks.{bi}."
+        for li in range(cfg.layers_per_block):
+            _init_resnet(p, rng, bp + f"resnets.{li}.", cur, ch[bi], temb)
+            cur = ch[bi]
+            if btype.startswith("CrossAttn"):
+                _init_attn(p, rng, bp + f"attentions.{li}.", cur,
+                           cfg.cross_attention_dim)
+            skips.append(cur)
+        if bi < len(ch) - 1:
+            p[bp + "downsamplers.0.conv.weight"] = _rand(rng, cur, cur, 3, 3)
+            p[bp + "downsamplers.0.conv.bias"] = jnp.zeros((cur,))
+            skips.append(cur)
+
+    _init_resnet(p, rng, "mid_block.resnets.0.", cur, cur, temb)
+    _init_attn(p, rng, "mid_block.attentions.0.", cur, cfg.cross_attention_dim)
+    _init_resnet(p, rng, "mid_block.resnets.1.", cur, cur, temb)
+
+    for bi, btype in enumerate(cfg.up_block_types):
+        bp = f"up_blocks.{bi}."
+        out_c = ch[len(ch) - 1 - bi]
+        for li in range(cfg.layers_per_block + 1):
+            skip_c = skips.pop()
+            _init_resnet(p, rng, bp + f"resnets.{li}.", cur + skip_c, out_c, temb)
+            cur = out_c
+            if btype.startswith("CrossAttn"):
+                _init_attn(p, rng, bp + f"attentions.{li}.", cur,
+                           cfg.cross_attention_dim)
+        if bi < len(ch) - 1:
+            p[bp + "upsamplers.0.conv.weight"] = _rand(rng, cur, cur, 3, 3)
+            p[bp + "upsamplers.0.conv.bias"] = jnp.zeros((cur,))
+
+    p["conv_norm_out.weight"] = jnp.ones((cur,))
+    p["conv_norm_out.bias"] = jnp.zeros((cur,))
+    p["conv_out.weight"] = _rand(rng, cfg.out_channels, cur, 3, 3)
+    p["conv_out.bias"] = jnp.zeros((cfg.out_channels,))
+    return p
+
+
+def init_vae_params(cfg: VaeConfig, seed=0) -> dict:
+    rng = np.random.default_rng(seed)
+    p: dict = {}
+    ch = cfg.block_out_channels
+    lc = cfg.latent_channels
+
+    def res(prefix, cin, cout):
+        p[prefix + "norm1.weight"] = jnp.ones((cin,))
+        p[prefix + "norm1.bias"] = jnp.zeros((cin,))
+        p[prefix + "conv1.weight"] = _rand(rng, cout, cin, 3, 3)
+        p[prefix + "conv1.bias"] = jnp.zeros((cout,))
+        p[prefix + "norm2.weight"] = jnp.ones((cout,))
+        p[prefix + "norm2.bias"] = jnp.zeros((cout,))
+        p[prefix + "conv2.weight"] = _rand(rng, cout, cout, 3, 3)
+        p[prefix + "conv2.bias"] = jnp.zeros((cout,))
+        if cin != cout:
+            p[prefix + "conv_shortcut.weight"] = _rand(rng, cout, cin, 1, 1)
+            p[prefix + "conv_shortcut.bias"] = jnp.zeros((cout,))
+
+    def attn(prefix, c):
+        p[prefix + "group_norm.weight"] = jnp.ones((c,))
+        p[prefix + "group_norm.bias"] = jnp.zeros((c,))
+        for n in ("to_q", "to_k", "to_v", "to_out.0"):
+            p[prefix + n + ".weight"] = _rand(rng, c, c)
+            p[prefix + n + ".bias"] = jnp.zeros((c,))
+
+    # encoder
+    p["encoder.conv_in.weight"] = _rand(rng, ch[0], cfg.in_channels, 3, 3)
+    p["encoder.conv_in.bias"] = jnp.zeros((ch[0],))
+    cur = ch[0]
+    for bi in range(len(ch)):
+        bp = f"encoder.down_blocks.{bi}."
+        for li in range(cfg.layers_per_block):
+            res(bp + f"resnets.{li}.", cur, ch[bi])
+            cur = ch[bi]
+        if bi < len(ch) - 1:
+            p[bp + "downsamplers.0.conv.weight"] = _rand(rng, cur, cur, 3, 3)
+            p[bp + "downsamplers.0.conv.bias"] = jnp.zeros((cur,))
+    res("encoder.mid_block.resnets.0.", cur, cur)
+    attn("encoder.mid_block.attentions.0.", cur)
+    res("encoder.mid_block.resnets.1.", cur, cur)
+    p["encoder.conv_norm_out.weight"] = jnp.ones((cur,))
+    p["encoder.conv_norm_out.bias"] = jnp.zeros((cur,))
+    p["encoder.conv_out.weight"] = _rand(rng, 2 * lc, cur, 3, 3)
+    p["encoder.conv_out.bias"] = jnp.zeros((2 * lc,))
+    p["quant_conv.weight"] = _rand(rng, 2 * lc, 2 * lc, 1, 1)
+    p["quant_conv.bias"] = jnp.zeros((2 * lc,))
+
+    # decoder
+    p["post_quant_conv.weight"] = _rand(rng, lc, lc, 1, 1)
+    p["post_quant_conv.bias"] = jnp.zeros((lc,))
+    top = ch[-1]
+    p["decoder.conv_in.weight"] = _rand(rng, top, lc, 3, 3)
+    p["decoder.conv_in.bias"] = jnp.zeros((top,))
+    res("decoder.mid_block.resnets.0.", top, top)
+    attn("decoder.mid_block.attentions.0.", top)
+    res("decoder.mid_block.resnets.1.", top, top)
+    cur = top
+    rev = list(reversed(ch))
+    for bi in range(len(ch)):
+        bp = f"decoder.up_blocks.{bi}."
+        for li in range(cfg.layers_per_block + 1):
+            res(bp + f"resnets.{li}.", cur, rev[bi])
+            cur = rev[bi]
+        if bi < len(ch) - 1:
+            p[bp + "upsamplers.0.conv.weight"] = _rand(rng, cur, cur, 3, 3)
+            p[bp + "upsamplers.0.conv.bias"] = jnp.zeros((cur,))
+    p["decoder.conv_norm_out.weight"] = jnp.ones((cur,))
+    p["decoder.conv_norm_out.bias"] = jnp.zeros((cur,))
+    p["decoder.conv_out.weight"] = _rand(rng, cfg.out_channels, cur, 3, 3)
+    p["decoder.conv_out.bias"] = jnp.zeros((cfg.out_channels,))
+    return p
+
+
+def save_tiny_pipeline(pipe_dir: str, clip_cfg: ClipTextConfig,
+                       unet_cfg: UNetConfig, vae_cfg: VaeConfig, seed=0):
+    """Write a complete diffusers-LAYOUT pipeline directory (tests)."""
+    from safetensors.numpy import save_file
+
+    def dump(sub, cfg_obj, params, fname):
+        d = os.path.join(pipe_dir, sub)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump({k: (list(v) if isinstance(v, tuple) else v)
+                       for k, v in dataclasses.asdict(cfg_obj).items()}, f)
+        save_file({k: np.asarray(v) for k, v in params.items()},
+                  os.path.join(d, fname))
+
+    dump("text_encoder", clip_cfg, init_clip_params(clip_cfg, seed),
+         "model.safetensors")
+    dump("unet", unet_cfg, init_unet_params(unet_cfg, seed + 1),
+         "diffusion_pytorch_model.safetensors")
+    dump("vae", vae_cfg, init_vae_params(vae_cfg, seed + 2),
+         "diffusion_pytorch_model.safetensors")
