@@ -96,23 +96,32 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         # run to max_new and the measurement is pure constrained decode
         grammar = 'root ::= "[" [0-9]{200,400} "]"'
 
-    def make_req():
+    def make_req(n_new=None):
         return eng.GenRequest(
             prompt_ids=rng.integers(0, 255, size=prompt_len).tolist(),
             params=sampling.SamplingParamsHost(
                 temperature=0.8, top_k=40, top_p=0.95),
-            max_new_tokens=max_new,
+            max_new_tokens=n_new or max_new,
             ignore_eos=True,
             grammar=grammar,
         )
 
-    def consume():
+    def consume(tid):
+        first = True
         while True:
             with lock:
                 if state["stop"]:
                     return
                 state["launched"] += 1
-            r = make_req()
+            # STAGGER each consumer's first request: the closed loop
+            # launches all S consumers at t0, which phase-locks completions
+            # into waves of S (half the fleet idles while the other half
+            # prefilled) — an artifact of the harness, not of serving.
+            # Spreading first-request lengths desyncs the fleet so the
+            # measurement reflects steady-state load.
+            n_new = max(8, max_new - (tid * max_new) // S) if first else None
+            first = False
+            r = make_req(n_new)
             t_submit = time.monotonic()
             out = engine.submit(r)
             ttft = None
@@ -151,7 +160,8 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
                 pass
 
     t0 = time.monotonic()
-    threads = [threading.Thread(target=consume, daemon=True) for _ in range(S)]
+    threads = [threading.Thread(target=consume, args=(i,), daemon=True)
+               for i in range(S)]
     for t in threads:
         t.start()
     done.wait()

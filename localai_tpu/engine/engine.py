@@ -65,6 +65,11 @@ class EngineConfig:
     # slots ride bursts speculatively (verify + free rollback at processing
     # time); bursts clamp to cache-capacity conditions, see _pick_burst.
     decode_burst: int = 16
+    # decode bursts kept in flight on the device (r4): with depth 2 the
+    # host's sync of burst N overlaps burst N+1's compute, so host-side
+    # processing never idles the device. Deeper than 2 buys nothing (the
+    # host work fits easily inside one burst) and worsens admission lag.
+    pipeline_depth: int = 2
 
 
 @dataclasses.dataclass
@@ -139,23 +144,54 @@ def _merge_events(evs: list) -> StreamEvent:
 
 
 class _Burst:
-    """A dispatched decode burst awaiting host processing."""
-    __slots__ = ("n_steps", "slots", "ids_all", "lps_all", "mu_out", "ids_np",
-                 "lps_np", "folded", "skip_slots")
+    """A dispatched decode burst awaiting host processing. Its packed
+    results are synced by the engine's SYNC WORKER thread (one thread,
+    device dispatch order — concurrent np.asarray calls from two threads
+    convoy on the client's transfer path and can invert completion
+    order, which metastably collapsed serving throughput ~7x)."""
+    __slots__ = ("n_steps", "slots", "pack", "group", "t_dispatch",
+                 "pack_np", "ids_np", "lps_np", "first_ids", "first_lps",
+                 "folded", "skip_slots", "ready", "err")
 
-    def __init__(self, n_steps, slots, ids_all, lps_all, mu_out):
+    def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0):
         self.n_steps = n_steps
         self.slots = slots          # [(index, _Slot snapshot), ...]
-        self.ids_all = ids_all      # device [K, S]
-        self.lps_all = lps_all
-        self.mu_out = mu_out        # device [S] mirostat state after the burst
+        self.pack = pack            # device [2K+1(+2), S] f32
+        self.group = list(group)    # fused-admission slots (subset of slots)
+        self.t_dispatch = t_dispatch
+        self.pack_np = None
         self.ids_np = None
         self.lps_np = None
+        self.first_ids = None       # [S] np (fused groups only)
+        self.first_lps = None
         self.folded = False
+        self.ready = threading.Event()
+        self.err = None
         # slots whose host state was rolled back AFTER this burst was
         # dispatched (grammar rollback): the burst's tokens for them are
         # conditioned on a discarded token and must be dropped wholesale
         self.skip_slots: set = set()
+
+
+class _PendingPrefill:
+    """A dispatched final-prefill group awaiting its device results.
+
+    The sampled-first-token sync runs on the engine's SYNC WORKER thread
+    (np.asarray releases the GIL during the device wait), so the serving
+    loop never blocks on a prefill that is still queued behind in-flight
+    decode bursts — r3 polled is_ready(), which lies on this platform."""
+    __slots__ = ("group", "out_ids", "logprobs", "mu_out", "t0",
+                 "ids_np", "lps_np", "mu_np", "ready", "err")
+
+    def __init__(self, group, out_ids, logprobs, mu_out, t0):
+        self.group = group
+        self.out_ids = out_ids
+        self.logprobs = logprobs
+        self.mu_out = mu_out
+        self.t0 = t0
+        self.ids_np = self.lps_np = self.mu_np = None
+        self.ready = threading.Event()
+        self.err = None
 
 
 class _Slot:
@@ -267,18 +303,29 @@ class Engine:
         self._final_fns: dict[tuple, Callable] = {}
         self._spec_fn = None
         self._spec_turn = True   # mixed-traffic spec/burst alternation
-        self._last_active_key = None
 
-        # pipelined decode state: device-side burst-to-burst chain of
-        # (tokens, lengths, ring, ring_pos), the not-yet-processed burst,
-        # and whether host events invalidated the chain
-        self._chain = None
-        self._chain_dirty = True
-        self._inflight: Optional[_Burst] = None
-        # async prefill: up to TWO final-prefill groups may be in flight
-        # (FIFO) — a second group dispatches while the first computes, so
-        # wave turnover isn't serialized through one pending slot
-        self._pending_prefill: list = []
+        # pipelined decode state (r4 redesign): bursts chain device-side
+        # through (tokens, lengths, ring, ring_pos, mu) output handles, and
+        # host events (admission, release, context shift, rollback) no
+        # longer invalidate the whole chain — each dispatch composes the
+        # chain with per-slot OVERRIDE rows taken from the host mirrors
+        # (see _decode_burst_body), so dispatch NEVER waits on a device
+        # sync. Dispatched work (decode bursts + final-prefill groups)
+        # lives in one FIFO mirroring the device's execution order; the
+        # loop keeps up to pipeline_depth bursts in flight and only
+        # block-syncs the FIFO head, which by then is (nearly) computed —
+        # this replaces r3's is_ready() polling, which lies on this
+        # platform (a "ready" prefill result still blocked ~640 ms).
+        import collections
+
+        self._chain = None                    # device handles or None
+        self._override: set = set()           # slots whose chain rows are stale
+        self._fifo = collections.deque()      # _Burst | _PendingPrefill
+        self._burst_ms_ema = 0.0   # plain-burst dispatch->processed latency
+        self._sync_q: "queue.Queue" = queue.Queue()
+        self._sync_thread = threading.Thread(
+            target=self._sync_worker, name="engine-sync", daemon=True)
+        self._sync_thread.start()
 
         # effective prefill buckets always include the chunk size; both are
         # clamped to the cache capacity (a bucket larger than max_context
@@ -315,6 +362,28 @@ class Engine:
         self._fork_fns: dict = {}
         # grammar slots whose mask row changed since the last device flush
         self._gbias_flush: set = set()
+
+    def _sync_worker(self):
+        """ALL device->host syncs run here, one at a time, in dispatch
+        (= device execution) order: each np.asarray then blocks only
+        until its own item finishes computing. The serving loop never
+        issues a transfer itself — it dispatches, and consumes results
+        whose ``ready`` event has fired."""
+        while True:
+            item = self._sync_q.get()
+            if item is None:
+                return
+            try:
+                if isinstance(item, _Burst):
+                    item.pack_np = np.asarray(item.pack)
+                else:
+                    item.ids_np = np.asarray(item.out_ids)
+                    item.lps_np = np.asarray(item.logprobs)
+                    item.mu_np = np.asarray(item.mu_out)
+            except Exception as e:  # surfaced when the item is processed
+                item.err = e
+            item.ready.set()
+            self._wake.set()
 
     def _tmark(self, key: str, t0: float):
         if self._trace:
@@ -364,23 +433,56 @@ class Engine:
     # ---------- jitted step bodies ----------
 
     def _decode_burst_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
-                           bias, keys, slot_params, active, mu, n_steps: int,
+                           bias, keys, slot_params, active, mu,
+                           ov_mask, ov_tokens, ov_lengths, ov_ring, ov_rpos,
+                           ov_mu, n_steps: int,
                            flags: tuple = (True, True, True)):
         """n_steps decode+sample steps in ONE dispatch (lax.scan).
 
         Per-dispatch overhead on the serving chip is comparable to one step's
         compute, so bursts are the single biggest serving-throughput lever.
-        bias/slot_params/active are constant across the burst (the engine
-        forces n_steps=1 whenever a grammar slot needs per-token bias).
-        """
+        bias/slot_params/active are constant across the burst.
+
+        tokens/lengths/ring/ring_pos/mu arrive as the previous burst's
+        DEVICE output handles (the chain); ov_* are host rows composed in
+        for the slots in ``ov_mask`` — newly activated / rolled-back /
+        re-admitted slots — so host events never force a chain rebuild
+        (and therefore never force the host to wait on an in-flight burst
+        before it can dispatch the next one)."""
+        tokens = jnp.where(ov_mask, ov_tokens, tokens)
+        lengths = jnp.where(ov_mask, ov_lengths, lengths)
+        ring = jnp.where(ov_mask[:, None], ov_ring, jnp.asarray(ring))
+        ring_pos = jnp.where(ov_mask, ov_rpos, jnp.asarray(ring_pos))
+        mu = jnp.where(ov_mask, ov_mu, jnp.asarray(mu))
+
+        step = self._make_scan_step(params, slot_params, bias, active, flags)
+        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
+        carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None, length=n_steps)
+        tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
+        # tokens/lengths/ring/mu are returned as DEVICE handles so the next
+        # burst can chain off them without a host round-trip (pipelined
+        # decode). Everything the host needs (ids, logprobs, post-burst mu)
+        # is PACKED into one [2K+1, S] float32 array: on the serving tunnel
+        # each device->host transfer costs ~60-100 ms of pure latency, so
+        # three separate tiny syncs per burst were the loop bottleneck.
+        # float32 holds token ids exactly (vocab << 2^24).
+        pack = jnp.concatenate(
+            [ids_all.astype(jnp.float32), lps_all, mu[None, :]], axis=0)
+        return pack, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
+
+    def _make_scan_step(self, params, slot_params, bias, active, flags):
+        """The shared decode+sample scan step for plain and fused bursts.
+
+        Inactive slots (free / mid-prefill) must NOT write KV: their write
+        position is forced to C so the scatter's mode="drop" discards it —
+        otherwise every decode step would clobber row 0 of slots holding
+        reusable prefixes or in-flight prefill chunks. Only active slots
+        consume RNG/mirostat/ring state: a prefilling slot's seeded state
+        must not advance with others' decode steps."""
         C = self.ecfg.max_context
 
         def step(carry, _):
             tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
-            # inactive slots (free / mid-prefill) must NOT write KV: force
-            # their write position to C so the scatter's mode="drop" discards
-            # it — otherwise every decode step would clobber row 0 of slots
-            # holding reusable prefixes or in-flight prefill chunks
             write_lengths = jnp.where(active, lengths, C)
             logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
                                                write_lengths, ck, cv)
@@ -388,8 +490,6 @@ class Engine:
                 logits, slot_params, ring, ring_pos, bias, keys, mu,
                 use_penalties=flags[0], use_typical=flags[1],
                 use_mirostat=flags[2])
-            # only active slots consume RNG/mirostat state; a prefilling
-            # slot's seeded state must not advance with others' decode steps
             keys = jnp.where(active[:, None], new_keys, keys)
             mu = jnp.where(active, new_mu, mu)
             ring, ring_pos = sampling.update_ring(ring, ring_pos, ids, active)
@@ -397,15 +497,7 @@ class Engine:
             tokens = jnp.where(active, ids, tokens)
             return (tokens, ck, cv, lengths, ring, ring_pos, keys, mu), (ids, logprobs)
 
-        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
-        carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None, length=n_steps)
-        tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
-        # tokens/lengths/ring/mu are returned as DEVICE handles so the next
-        # burst can chain off them without a host round-trip (pipelined
-        # decode); the host separately mirrors the same evolution from the
-        # emitted ids for use whenever admissions/releases reset slot state
-        # (mu is device-only knowledge: it is folded back from this output)
-        return ids_all, lps_all, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
+        return step
 
     def _prefill_chunk_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
                             mm_pos=None, mm_vec=None):
@@ -415,6 +507,80 @@ class Engine:
                                   start_pos, continued=True,
                                   mm_pos=mm_pos, mm_vec=mm_vec)
         return ck, cv
+
+    def _fused_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
+                    bias, keys, slot_params, active, mu,
+                    ov_mask, ov_tokens, ov_lengths, ov_ring, ov_rpos, ov_mu,
+                    p_tokens, p_seq, p_slots, p_start,
+                    n_steps: int):
+        """FUSED admission: final-prefill a batch of B fresh prompts,
+        sample their first tokens, and run the decode burst with those
+        slots already active — all in ONE dispatch.
+
+        r4 measurement: separate dispatches cost ~30 ms of device overhead
+        each on the serving tunnel, and the prefill->host->activate
+        round-trip idled the admitted slots for 100-300 ms more. Fusing
+        collapses both, and makes singleton admissions as cheap as batched
+        ones, so admission never holds requests back to form groups.
+        (The reference packs prompt chunks and decode tokens into one
+        llama_batch for the same reason — grpc-server.cpp:1671+.)
+
+        Duplicate p_slots entries (pow2 batch padding repeats the last
+        prompt) stay idempotent: every per-slot update is a .set() of
+        identical values (same inputs -> same sampled id)."""
+        tokens = jnp.where(ov_mask, ov_tokens, tokens)
+        lengths = jnp.where(ov_mask, ov_lengths, lengths)
+        ring = jnp.where(ov_mask[:, None], ov_ring, jnp.asarray(ring))
+        ring_pos = jnp.where(ov_mask, ov_rpos, jnp.asarray(ring_pos))
+        mu = jnp.where(ov_mask, ov_mu, jnp.asarray(mu))
+
+        logits, ck, cv = llama.prefill(params, self.cfg, p_tokens, p_seq, ck,
+                                       cv, p_slots, p_start, continued=False)
+        sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), p_slots,
+                                                  axis=0), slot_params)
+        rpos_rows = jnp.take(ring_pos, p_slots, axis=0)
+        ids_f, lps_f, new_keys, new_mu = sampling.sample(
+            logits, sp_rows,
+            jnp.take(ring, p_slots, axis=0), rpos_rows,
+            jnp.take(bias, p_slots, axis=0),
+            jnp.take(keys, p_slots, axis=0),
+            jnp.take(mu, p_slots, axis=0))
+        keys = keys.at[p_slots].set(new_keys)
+        mu = mu.at[p_slots].set(new_mu)
+        lengths = lengths.at[p_slots].set(p_start + p_seq)
+        tokens = tokens.at[p_slots].set(ids_f)
+        # the sampled first token enters the penalty ring (idempotent form)
+        ring = ring.at[p_slots, rpos_rows % sampling.RING_N].set(ids_f)
+        ring_pos = ring_pos.at[p_slots].set(rpos_rows + 1)
+        active = jnp.asarray(active).at[p_slots].set(True)
+
+        # fused bursts always run the full sampler (one compiled variant
+        # per (bucket, B); a flags dimension would double the precompile
+        # set for a small sampler saving)
+        step = self._make_scan_step(params, slot_params, bias, active,
+                                    (True, True, True))
+        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
+        carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None,
+                                                 length=n_steps)
+        tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
+        S = self.ecfg.num_slots
+        first_ids = jnp.zeros((S,), jnp.float32).at[p_slots].set(
+            ids_f.astype(jnp.float32))
+        first_lps = jnp.zeros((S,), jnp.float32).at[p_slots].set(lps_f)
+        pack = jnp.concatenate(
+            [ids_all.astype(jnp.float32), lps_all, mu[None, :],
+             first_ids[None, :], first_lps[None, :]], axis=0)
+        return pack, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
+
+    def _get_fused_fn(self, bucket: int, batch: int):
+        key = ("fused", bucket, batch)
+        fn = self._burst_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._fused_body(*a, n_steps=self.ecfg.decode_burst),
+                donate_argnums=(2, 3, 8))
+            self._burst_fns[key] = fn
+        return fn
 
     def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
                             ring, ring_pos, bias, keys, slot_params, mu,
@@ -443,6 +609,8 @@ class Engine:
         key = (n_steps, flags)
         fn = self._burst_fns.get(key)
         if fn is None:
+            # donate the cache + keys; chain inputs stay undonated (they are
+            # tiny, and mirror-fed dispatches pass host numpy for them)
             fn = jax.jit(
                 lambda *a: self._decode_burst_body(*a, n_steps=n_steps,
                                                    flags=flags),
@@ -523,13 +691,16 @@ class Engine:
         while k <= self.ecfg.decode_burst:
             ks.append(k)
             k *= 2
+        S = self.ecfg.num_slots
+        no_ov = (np.zeros((S,), np.bool_), self.cur_tokens, self.lengths,
+                 self.ring, self.ring_pos, self.mu)
         for k in ks:
             for flags in ((False, False, False), (True, True, True)):
                 fn = self._get_burst_fn(k, flags)
-                _, _, self.ck, self.cv, self.rng_keys, _ = fn(
+                _, self.ck, self.cv, self.rng_keys, _ = fn(
                     self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
-                    self.slot_params, self.active_dev, self.mu)
+                    self.slot_params, self.active_dev, self.mu, *no_ov)
         for bucket in self._buckets:
             one = np.ones((1,), np.int32)
             zero = np.zeros((1,), np.int32)
@@ -538,8 +709,12 @@ class Engine:
                 # non-final chunks always use the full chunk bucket
                 self.ck, self.cv = self._get_chunk_fn(bucket)(
                     self.params, tokens, one, self.ck, self.cv, zero, zero)
-            for batch, continued in ((1, False), (1, True),
-                                     (self._final_pad, False)):
+            finals = [(1, False), (1, True)]
+            fb = 2
+            while fb <= self._final_pad:
+                finals.append((fb, False))
+                fb *= 2
+            for batch, continued in finals:
                 if batch == 1:
                     tb, sb = tokens, one
                     slotb = startb = zero
@@ -552,6 +727,21 @@ class Engine:
                     self.params, tb, sb, self.ck, self.cv, slotb, startb,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
                     self.slot_params, self.mu)
+            # fused admission variants (prefill+first-token+burst)
+            Bs = [1]
+            fb = 2
+            while fb <= self._final_pad:
+                Bs.append(fb)
+                fb *= 2
+            for B in Bs:
+                fn = self._get_fused_fn(bucket, B)
+                _, self.ck, self.cv, self.rng_keys, _ = fn(
+                    self.params, self.cur_tokens, self.ck, self.cv,
+                    self.lengths, self.ring, self.ring_pos, self.bias,
+                    self.rng_keys, self.slot_params, self.active_dev,
+                    self.mu, *no_ov,
+                    np.zeros((B, bucket), np.int32), np.ones((B,), np.int32),
+                    np.zeros((B,), np.int32), np.zeros((B,), np.int32))
         jax.block_until_ready(self.ck)
 
     def start(self, precompile: bool = False):
@@ -565,6 +755,7 @@ class Engine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=10)
+        self._sync_q.put(None)
         if self._trace and self._tstats:
             import sys
 
@@ -612,9 +803,8 @@ class Engine:
         self._cache_tokens = [[] for _ in range(S)]
         self._prefill_queue = []
         self._chain = None
-        self._chain_dirty = True
-        self._inflight = None
-        self._pending_prefill = []
+        self._override = set()
+        self._fifo.clear()
         self._fork_waiters = {}
         self._gbias_flush = set()
 
@@ -758,15 +948,17 @@ class Engine:
         self.cur_tokens[slot] = toks[-1] if toks else 0
         self.ring, self.ring_pos = sampling.set_slot_ring(
             self.ring, self.ring_pos, slot, toks)
-        # ensure the next dispatch carries this state's mask
+        # ensure the next dispatch carries this state's mask + the
+        # corrected mirrors (chain override)
         self._gbias_flush.add(slot)
-        self._chain_dirty = True
-        # the PIPELINED in-flight burst (dispatched before this rollback
+        self._override.add(slot)
+        # every PIPELINED in-flight burst (dispatched before this rollback
         # was known) sampled its tokens conditioned on the discarded one —
-        # drop this slot from it wholesale: neither its fold nor its
-        # emission may touch the corrected mirrors (r3 review finding)
-        if self._inflight is not None:
-            self._inflight.skip_slots.add(slot)
+        # drop this slot from them wholesale: neither their folds nor
+        # their emissions may touch the corrected mirrors
+        for b in self._fifo:
+            if isinstance(b, _Burst):
+                b.skip_slots.add(slot)
         return False
 
     # ---------- engine loop ----------
@@ -801,6 +993,13 @@ class Engine:
         return best, min(best_key[0], len(ids) - 1)
 
     def _run(self):
+        """The engine loop (r4): every iteration dispatches first (prefill
+        chunks/finals, then up to pipeline_depth decode bursts — all
+        async), and only then block-syncs the OLDEST dispatched item,
+        which by FIFO execution order is already (nearly) computed. The
+        device therefore always has at least one dispatch queued behind
+        the one it is executing; host-side syncs, detok, stop-scans and
+        queue puts all overlap device compute."""
         import logging
 
         log = logging.getLogger(__name__)
@@ -812,50 +1011,11 @@ class Engine:
                 t0 = time.monotonic()
                 prefilled = self._prefill_step()
                 self._tmark("prefill", t0)
-                t0 = time.monotonic()
-                finalized = self._maybe_finalize_prefill()
-                self._tmark("finalize", t0)
-                decoding = any(s is not None and s.phase == "decode"
-                               for s in self.slots)
-                if decoding:
-                    eligible = self._spec_eligible()
-                    others = any(
-                        s is not None and s.phase == "decode"
-                        and not eligible[i]
-                        for i, s in enumerate(self.slots))
-                    if eligible.any() and not others:
-                        self._spec_once(eligible)
-                    elif eligible.any():
-                        # MIXED traffic: alternate spec rounds (eligible
-                        # slots) with normal bursts (the rest) — r2
-                        # disabled speculation fleet-wide the moment one
-                        # sampled request was active
-                        if self._spec_turn:
-                            self._spec_once(eligible)
-                        else:
-                            t0 = time.monotonic()
-                            self._decode_once(exclude=eligible)
-                            self._tmark("decode_once", t0)
-                        self._spec_turn = not self._spec_turn
-                    else:
-                        t0 = time.monotonic()
-                        self._decode_once()
-                        self._tmark("decode_once", t0)
-                else:
-                    if self._inflight is not None:
-                        # every participant finished during processing of the
-                        # prior burst; fold/drop the stale burst now so its
-                        # tokens can never leak into a re-admitted slot
-                        self._process_burst(self._inflight)
-                        self._inflight = None
-                    if self._pending_prefill:
-                        # nothing else to run — block on the prefill result
-                        t0 = time.monotonic()
-                        self._maybe_finalize_prefill(block=True)
-                        self._tmark("finalize_block", t0)
-                    elif not (admitted or prefilled or finalized):
-                        self._wake.wait(timeout=0.05)
-                        self._wake.clear()
+                dispatched = self._dispatch_decode()
+                drained = self._drain_fifo(can_feed=dispatched or prefilled)
+                if not (admitted or prefilled or dispatched or drained):
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
             except Exception as e:  # never let the loop die: fail active requests
                 log.exception("engine step failed")
                 for i, s in enumerate(self.slots):
@@ -885,15 +1045,19 @@ class Engine:
         if self._queue.empty() or self._free_count() == 0:
             return False
         qn = self._queue.qsize()
-        if qn >= min(self._final_pad // 2, self._free_count()):
+        if qn >= min(4, self._free_count()):
             return True
         n_decoding = sum(1 for s in self.slots
                          if s is not None and s.phase == "decode")
         if n_decoding < self.ecfg.num_slots // 2:
             return True  # light load: completions won't clump; admit now
+        # under steady (desynced) load completions trickle 1-2 per burst;
+        # holding longer than ~a burst period idles the freed slots for
+        # more than the batched-prefill dispatch saves (r4 measurement:
+        # the r3 0.35 s hold cost ~15% occupancy at steady state)
         now = time.monotonic()
         oldest = getattr(self, "_oldest_queued_t", None)
-        return oldest is not None and (now - oldest) > 0.35
+        return oldest is not None and (now - oldest) > 0.15
 
     def _admit(self) -> bool:
         self._reap_cancelled()
@@ -1273,9 +1437,9 @@ class Engine:
         (grpc-server.cpp:1671+); per-prompt dispatches cost ~150ms of
         overhead each on the serving tunnel. Long-prompt (chunked) and
         continued (prefix-reuse) prefills go singly. Up to TWO final
-        groups are in flight at a time (see _maybe_finalize_prefill).
+        groups are in flight at a time (see _process_prefill).
         """
-        if len(self._pending_prefill) >= 2:
+        if sum(1 for x in self._fifo if not isinstance(x, _Burst)) >= 2:
             return False
         while self._prefill_queue:
             slot = self._prefill_queue[0]
@@ -1335,7 +1499,25 @@ class Engine:
                 of, ot, ob, oc = self._prefill_plan(other)
                 if of and not oc and ob == bucket:
                     group.append((other, ot))
-        B = 1 if len(group) == 1 else self._final_pad
+        # FUSED admission (r4): when the pipeline has room and a full-size
+        # burst is runnable, prefill+first-token+decode-burst go out as ONE
+        # dispatch (see _fused_body) — no separate prefill dispatch, no
+        # activation round-trip, and no reason to hold admissions back
+        if (not continued and s.mm_pos is None
+                and self._n_inflight_bursts() < self.ecfg.pipeline_depth
+                and self._pick_burst(
+                    extra=[(t, self.slots[g].req.max_new_tokens)
+                           for g, t in group]) == self.ecfg.decode_burst):
+            return self._dispatch_fused(group, bucket)
+        # pad to the next power of two (each size is precompiled): r3
+        # padded every group straight to _final_pad, so a typical group of
+        # ~7 prompts burned 2x its prefill compute on repeated padding rows
+        if len(group) == 1:
+            B = 1
+        else:
+            B = 2
+            while B < len(group):
+                B *= 2
 
         tokens = np.zeros((B, bucket), np.int32)
         seq_len = np.ones((B,), np.int32)
@@ -1350,7 +1532,7 @@ class Engine:
             start_v[b] = gs.written
 
         # ring/ring_pos/slot_params copied: see the aliasing note in
-        # _decode_once (in-flight dispatches must not see host mutations)
+        # _dispatch_decode (in-flight dispatches must not see host mutations)
         args = (self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
                 self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
                 jax.tree.map(np.array, self.slot_params), self.mu.copy())
@@ -1369,39 +1551,128 @@ class Engine:
                 self.draft_params, tokens, seq_len, self.dck, self.dcv,
                 slots_v, start_v)
         # ASYNC: don't sync here — the result would be serialized behind any
-        # in-flight decode burst, idling the device. The group's slots stay
-        # in "prefill" phase (and out of decode bursts) until the sampled
-        # first tokens arrive; _maybe_finalize_prefill polls readiness each
-        # loop iteration. Bookkeeping (pending/written) is advanced NOW so a
-        # second dispatch can't double-prefill the same slots.
+        # in-flight decode burst, idling the device. The group rides the
+        # dispatch FIFO; _drain_fifo block-syncs it when it reaches the
+        # head (all device work dispatched before it has then been synced,
+        # so the wait is just this prefill's own remaining compute — the
+        # r3 design polled is_ready(), which LIES on this platform and
+        # turned "ready" results into ~640 ms stalls). Bookkeeping
+        # (pending/written) is advanced NOW so a second dispatch can't
+        # double-prefill the same slots.
         for gslot, gtake in group:
             gs = self.slots[gslot]
             gs.pending = []
             gs.written += gtake
             if gslot in self._prefill_queue:
                 self._prefill_queue.remove(gslot)
-        self._pending_prefill.append((
+        item = _PendingPrefill(
             [(gslot, self.slots[gslot]) for gslot, _ in group],
-            out_ids, logprobs, mu_out, t0))
+            out_ids, logprobs, mu_out, t0)
+        self._fifo.append(item)
+        self._sync_q.put(item)
         return True
 
-    def _maybe_finalize_prefill(self, block: bool = False) -> bool:
-        """Activate the oldest dispatched final-prefill group once its first
-        tokens are available (or immediately when ``block``)."""
-        if not self._pending_prefill:
-            return False
-        group, out_ids, logprobs, mu_out, t0 = self._pending_prefill[0]
-        tr = time.monotonic()
-        ready = out_ids.is_ready()
-        self._tmark("finalize_poll", tr)
-        if not block and not ready:
-            return False
-        self._pending_prefill.pop(0)
-        tr = time.monotonic()
-        ids_np = np.asarray(out_ids)
-        lps_np = np.asarray(logprobs)
-        mu_np = np.asarray(mu_out)
-        self._tmark("finalize_sync", tr)
+    def _dispatch_fused(self, group, bucket: int) -> bool:
+        """Dispatch final-prefill + first-token sampling + a full decode
+        burst for ``group`` (fresh, non-multimodal prompts) in ONE device
+        call. The group's slots flip to decode phase NOW; their first
+        tokens come back in the burst's packed results."""
+        t_d = time.monotonic()
+        S = self.ecfg.num_slots
+        K = self.ecfg.decode_burst
+        if len(group) == 1:
+            B = 1
+        else:
+            B = 2
+            while B < len(group):
+                B *= 2
+        p_tokens = np.zeros((B, bucket), np.int32)
+        p_seq = np.ones((B,), np.int32)
+        p_slots = np.zeros((B,), np.int32)
+        p_start = np.zeros((B,), np.int32)
+        for b in range(B):
+            gslot, gtake = group[min(b, len(group) - 1)]  # pad = repeat last
+            gs = self.slots[gslot]
+            p_tokens[b, :gtake] = gs.pending[:gtake]
+            p_seq[b] = gtake
+            p_slots[b] = gslot
+            p_start[b] = gs.written
+        group_snaps = []
+        for gslot, gtake in group:
+            gs = self.slots[gslot]
+            gs.pending = []
+            gs.written += gtake
+            gs.phase = "decode"
+            # cache_len must reflect the prompt rows NOW: _pick_burst and
+            # _spec_eligible cost capacity as cache_len + inflight decode
+            # steps, and the fused burst is in flight from this moment
+            gs.cache_len = gs.written
+            self.lengths[gslot] = gs.written
+            self.active_dev[gslot] = True
+            self._override.add(gslot)
+            if gslot in self._prefill_queue:
+                self._prefill_queue.remove(gslot)
+            group_snaps.append((gslot, gs))
+        # budget-mask other decoding slots exactly like _dispatch_decode
+        active = self.active_dev.copy()
+        included = list(group_snaps)
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "decode" or any(g == i for g, _ in group_snaps):
+                continue
+            if (s.req.max_new_tokens - s.n_decoded
+                    - self._inflight_steps(i) <= 0):
+                active[i] = False
+                continue
+            included.append((i, s))
+        ov_mask = np.zeros((S,), np.bool_)
+        if self._chain is None:
+            chain = (self.cur_tokens.copy(), self.lengths.copy(),
+                     self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
+        else:
+            chain = self._chain
+            for i in self._override:
+                ov_mask[i] = True
+        self._override.clear()
+        ov = (ov_mask, self.cur_tokens.copy(), self.lengths.copy(),
+              self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
+        fn = self._get_fused_fn(bucket, B)
+        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+            self.params, chain[0], self.ck, self.cv, chain[1],
+            chain[2], chain[3], self.bias, self.rng_keys,
+            jax.tree.map(np.array, self.slot_params),
+            active, chain[4], *ov,
+            p_tokens, p_seq, p_slots, p_start,
+        )
+        if self.dck is not None and any(s.spec_ok for _, s in group_snaps):
+            self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
+                self.draft_params, p_tokens, p_seq, self.dck, self.dcv,
+                p_slots, p_start)
+        self._tmark("dispatch_fused", t_d)
+        if self._trace:
+            s_ = self._tstats.setdefault("burst_steps", [0.0, 0])
+            s_[0] += K
+            s_[1] += 1
+            occ = self._tstats.setdefault("active_slots", [0.0, 0])
+            occ[0] += len(included)
+            occ[1] += 1
+        b = _Burst(K, included, pack, group=group_snaps, t_dispatch=t_d)
+        self._fifo.append(b)
+        self._sync_q.put(b)
+        return True
+
+    def _process_prefill(self, item: "_PendingPrefill"):
+        """Activate a dispatched final-prefill group (its results already
+        synced by the worker): flip the slots to decode phase and mark
+        them as chain OVERRIDES so the next burst dispatch picks their
+        state from the host mirrors without a chain rebuild."""
+        if not item.ready.is_set():
+            tr = time.monotonic()
+            item.ready.wait()
+            self._tmark("finalize_sync", tr)
+        if item.err is not None:
+            raise item.err
+        group = item.group
+        ids_np, lps_np, mu_np, t0 = item.ids_np, item.lps_np, item.mu_np, item.t0
         # scatter ONLY the group's mu entries — and only where the slot
         # still belongs to the dispatched request: a cancel + re-admit while
         # the prefill was in flight must not inherit the stale mu
@@ -1422,7 +1693,7 @@ class Engine:
             self.lengths[gslot] = gs.written
             self.cur_tokens[gslot] = first_id
             self.active_dev[gslot] = True
-            self._chain_dirty = True
+            self._override.add(gslot)
             # mirror the sampled token into the penalty ring
             self.ring[gslot, self.ring_pos[gslot] % sampling.RING_N] = first_id
             self.ring_pos[gslot] += 1
@@ -1436,9 +1707,66 @@ class Engine:
         for gslot, _snap in group:
             self._process_fork_waiters(gslot)
         self._flush_grammar_bias()
-        return True
 
-    def _pick_burst(self) -> int:
+    def _n_inflight_bursts(self) -> int:
+        return sum(1 for x in self._fifo if isinstance(x, _Burst))
+
+    def _inflight_steps(self, slot: int) -> int:
+        """Decode tokens already dispatched (unprocessed) for a slot."""
+        n = 0
+        for b in self._fifo:
+            if not isinstance(b, _Burst) or slot in b.skip_slots:
+                continue
+            if any(i == slot for i, _ in b.slots):
+                n += b.n_steps
+                if any(i == slot for i, _ in b.group):
+                    n += 1   # the fused first token
+        return n
+
+    def _drain_fifo(self, can_feed: bool = False) -> bool:
+        """Process dispatched work. Prefill groups activate as soon as the
+        sync worker flags them ready (any position in the FIFO — safe:
+        a prefill group's slots are disjoint from every in-flight burst's
+        participants, since they were mid-prefill at those dispatches).
+        The oldest burst is block-synced only when the pipeline is already
+        full or nothing more can be dispatched (``can_feed`` False) — and
+        at most one per call, so the loop refills the pipeline between
+        syncs and the device always has work queued."""
+        progressed = False
+        for item in [x for x in self._fifo
+                     if not isinstance(x, _Burst) and x.ready.is_set()]:
+            self._fifo.remove(item)
+            t0 = time.monotonic()
+            self._process_prefill(item)
+            self._tmark("finalize", t0)
+            progressed = True
+        for idx, item in enumerate(self._fifo):
+            if not isinstance(item, _Burst):
+                continue   # a not-yet-ready prefill ahead; bursts may pass it
+            if not item.ready.is_set() and can_feed and \
+                    self._n_inflight_bursts() < self.ecfg.pipeline_depth:
+                break
+            del self._fifo[idx]
+            t0 = time.monotonic()
+            self._process_burst(item)
+            self._tmark("process_burst", t0)
+            progressed = True
+            break
+        return progressed
+
+    def _drain_all(self):
+        """Sync + process every dispatched item (spec rounds and device
+        resets need the host mirrors fully caught up). Bursts first in
+        device order, then any remaining prefill groups (waiting on the
+        sync worker where needed)."""
+        while self._fifo:
+            head = self._fifo.popleft()
+            if isinstance(head, _Burst):
+                self._process_burst(head)
+            else:
+                self._process_prefill(head)
+
+    def _pick_burst(self, extra=None) -> int:
         """Burst length for this dispatch: a power of two <= decode_burst,
         clamped so no slot crosses its context-shift threshold mid-burst
         (tokens past the threshold would be silently position-less).
@@ -1449,19 +1777,20 @@ class Engine:
         Slots that finish mid-burst (EOS/stop/budget) simply ride out the
         burst; their tail tokens are discarded host-side — cheaper than
         clamping every slot to the smallest remaining budget. Host mirrors
-        lag by any in-flight (pipelined) burst, so its steps count against
-        the capacity clamp too."""
+        lag by every in-flight (pipelined) burst, so those steps count
+        against the capacity clamp too."""
         cap = self.ecfg.decode_burst
         budget = 1
-        infl = self._inflight
-        inflight_k = infl.n_steps if infl is not None else 0
-        inflight_slots = {i for i, _ in infl.slots} if infl is not None else ()
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode":
                 continue
-            used = s.cache_len + (inflight_k if i in inflight_slots else 0)
+            infl = self._inflight_steps(i)
+            used = s.cache_len + infl
             cap = min(cap, max(1, self.ecfg.max_context - 2 - used))
-            budget = max(budget, s.req.max_new_tokens - s.n_decoded)
+            budget = max(budget, s.req.max_new_tokens - s.n_decoded - infl)
+        for take, max_new in (extra or ()):
+            cap = min(cap, max(1, self.ecfg.max_context - 2 - take))
+            budget = max(budget, max_new - 1)  # first token sampled in-fn
         cap = min(cap, budget)
         k = 1
         while k * 2 <= cap:
@@ -1504,10 +1833,8 @@ class Engine:
     def _spec_once(self, eligible: "np.ndarray"):
         """One speculative round for the ELIGIBLE slots only (no
         pipelining: rounds advance lengths per-slot, so the burst chain is
-        not reusable)."""
-        if self._inflight is not None:
-            self._process_burst(self._inflight)
-            self._inflight = None
+        not reusable). The caller drains the dispatch FIFO first."""
+        assert not self._fifo, "_spec_once requires a drained FIFO"
         fn = self._get_spec_fn()
         burst_slots = [(i, s) for i, s in enumerate(self.slots)
                        if s is not None and s.phase == "decode"
@@ -1520,7 +1847,7 @@ class Engine:
         lp_np = np.asarray(out_lp)
         n_np = np.asarray(n_out)
         self._chain = None
-        self._chain_dirty = True
+        self._override.clear()
         for i, snap in burst_slots:
             if not self._live(i, snap):
                 continue
@@ -1539,31 +1866,55 @@ class Engine:
                 snap.committed = min(snap.committed + 1, snap.cache_len)
                 self._emit_token(i, int(out_np[i, j]), float(lp_np[i, j]))
 
-    def _decode_once(self, exclude: Optional["np.ndarray"] = None):
-        """Dispatch one decode burst, PIPELINED: the previous burst's host
-        processing (sync, detok, stop-scan, queue puts) happens while this
-        burst runs on the device. Burst-to-burst state (tokens/lengths/ring)
-        chains device-side; whenever host events (admission, release,
-        context shift) invalidate the chain, the burst is fed from the host
-        mirrors instead — which requires the previous burst's results to be
-        folded into the mirrors first. ``exclude`` masks out slots that are
-        advancing through spec rounds instead (mixed-traffic alternation)."""
+    def _dispatch_decode(self) -> bool:
+        """Dispatch the next decode burst (or run a spec round) if the
+        pipeline has room and some decoding slot still has budget beyond
+        the steps already in flight. Never blocks: burst-to-burst state
+        (tokens/lengths/ring/mu) chains device-side, and host events are
+        composed in as per-slot overrides (see _decode_burst_body)."""
+        if self._n_inflight_bursts() >= self.ecfg.pipeline_depth:
+            return False
+        decoding = [i for i, s in enumerate(self.slots)
+                    if s is not None and s.phase == "decode"]
+        if not decoding:
+            return False
+        exclude = None
+        eligible = self._spec_eligible()
+        if eligible.any():
+            others = any(not eligible[i] for i in decoding)
+            if not others or self._spec_turn:
+                # spec rounds advance per-slot lengths outside the chain;
+                # catch the mirrors up fully, then run synchronously
+                self._drain_all()
+                self._spec_once(eligible)
+                self._spec_turn = False
+                return True
+            # MIXED traffic: alternate spec rounds (eligible slots) with
+            # normal bursts (the rest)
+            self._spec_turn = True
+            exclude = eligible
         active = self.active_dev.copy()
         if exclude is not None:
             active &= ~exclude
-        key = active.tobytes()
-        if key != getattr(self, "_last_active_key", None):
-            self._chain_dirty = True
-            self._last_active_key = key
-        if self._inflight is not None and self._chain_dirty:
-            # dispatching from mirrors requires the previous burst
-            # folded in first — but only the FOLD (sync + mirror
-            # arithmetic, ~1ms); the expensive emission still overlaps
-            # the next burst below. (Grammar slots no longer force a sync
-            # here: their tokens are VERIFIED at processing time and the
-            # slot rolls back on the first invalid one, so a stale mask
-            # costs throughput on that slot only, never correctness.)
-            self._fold_burst(self._inflight)
+        included = []
+        for i in decoding:
+            if exclude is not None and exclude[i]:
+                continue
+            s = self.slots[i]
+            if (s.req.max_new_tokens - s.n_decoded
+                    - self._inflight_steps(i) <= 0):
+                # in-flight steps already cover this slot's budget: mask it
+                # out so it doesn't ride the new burst as garbage compute
+                # (with depth-2 pipelining that waste measured ~30% of all
+                # dispatched slot-steps on the wave-shaped bench). Release
+                # happens when the in-flight results are emitted; grammar
+                # rollbacks recover budget and simply re-include the slot
+                # on a later dispatch.
+                active[i] = False
+                continue
+            included.append(i)
+        if not included:
+            return False
         n_steps = self._pick_burst()
         f = sampling.feature_flags(self.slot_params, self.active_dev)
         flags = (f["use_penalties"], f["use_typical"], f["use_mirostat"])
@@ -1573,59 +1924,72 @@ class Engine:
             flags = (True, True, True)
         fn = self._get_burst_fn(n_steps, flags)
         t_d = time.monotonic()
-        if self._chain_dirty or self._chain is None:
-            # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
-            # (observed on the CPU client) — an in-flight dispatch holding
-            # the live mirror arrays would see later in-place host mutations
-            # (admission/finalize/release) and e.g. decode an activating
-            # slot with lengths still 0, clobbering its prefilled KV rows
-            tokens, lengths, ring, rpos, mu = (self.cur_tokens.copy(),
-                                               self.lengths.copy(),
-                                               self.ring.copy(),
-                                               self.ring_pos.copy(),
-                                               self.mu.copy())
+        S = self.ecfg.num_slots
+        ov_mask = np.zeros((S,), np.bool_)
+        if self._chain is None:
+            # cold chain: feed everything from the host mirrors
+            chain = (self.cur_tokens.copy(), self.lengths.copy(),
+                     self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
         else:
-            tokens, lengths, ring, rpos, mu = self._chain
+            chain = self._chain
+            for i in self._override:
+                ov_mask[i] = True
+        self._override.clear()
+        # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
+        # (observed on the CPU client) — an in-flight dispatch holding the
+        # live mirror arrays would see later in-place host mutations
+        ov = (ov_mask, self.cur_tokens.copy(), self.lengths.copy(),
+              self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
         # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
         # released and re-admitted while this burst is in flight, and the
         # new occupant must never receive the stale burst's tokens
-        burst_slots = [(i, s) for i, s in enumerate(self.slots)
-                       if s is not None and s.phase == "decode"
-                       and (exclude is None or not exclude[i])]
-        ids_all, lps_all, self.ck, self.cv, self.rng_keys, self._chain = fn(
-            self.params, tokens, self.ck, self.cv, lengths,
-            ring, rpos, self.bias, self.rng_keys,
+        burst_slots = [(i, self.slots[i]) for i in included]
+        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+            self.params, chain[0], self.ck, self.cv, chain[1],
+            chain[2], chain[3], self.bias, self.rng_keys,
             jax.tree.map(np.array, self.slot_params),
-            active, mu,
+            active, chain[4], *ov,
         )
-        self._chain_dirty = False
         self._tmark("dispatch", t_d)
         if self._trace:
             s = self._tstats.setdefault("burst_steps", [0.0, 0])
             s[0] += n_steps
             s[1] += 1
-        prev, self._inflight = self._inflight, _Burst(n_steps, burst_slots,
-                                                      ids_all, lps_all,
-                                                      self._chain[4])
-        if prev is not None:
-            t0 = time.monotonic()
-            self._process_burst(prev)
-            self._tmark("process_prev", t0)
+            # occupancy: the compiled step computes ALL slots, so every
+            # inactive slot wastes 1/S of the burst — this stat is the
+            # device-waste diagnostic (avg = slots riding per burst)
+            occ = self._tstats.setdefault("active_slots", [0.0, 0])
+            occ[0] += len(included)
+            occ[1] += 1
+        b = _Burst(n_steps, burst_slots, pack, t_dispatch=t_d)
+        self._fifo.append(b)
+        self._sync_q.put(b)
+        return True
 
     def _live(self, i, snap):
         return self.slots[i] is snap and snap.phase == "decode"
 
     def _fold_burst(self, b: "_Burst"):
-        """Sync a burst's ids and fold the device-side state evolution into
-        the host mirrors. Cheap (~1ms past the device sync) and idempotent;
-        emission is separate so it can overlap the NEXT dispatch."""
+        """Sync a burst's packed results (ONE device->host transfer) and
+        fold the device-side state evolution into the host mirrors. Cheap
+        (~1ms past the device sync) and idempotent; emission is separate
+        so it can overlap the NEXT dispatch."""
         if b.folded:
             return
         t0 = time.monotonic()
-        b.ids_np = np.asarray(b.ids_all)    # [K, S]
-        self._tmark("burst_sync", t0)
-        b.lps_np = np.asarray(b.lps_all)
-        mu_np = np.asarray(b.mu_out)
+        if not b.ready.is_set():
+            b.ready.wait()                  # worker-side sync in flight
+        if b.err is not None:
+            raise b.err
+        packed = b.pack_np                  # [2K+1(+2), S] f32
+        self._tmark("burst_wait", t0)
+        K = b.n_steps
+        b.ids_np = packed[:K].astype(np.int32)
+        b.lps_np = packed[K:2 * K]
+        mu_np = packed[2 * K]
+        if b.group:
+            b.first_ids = packed[2 * K + 1].astype(np.int32)
+            b.first_lps = packed[2 * K + 2]
         live_idx = [i for i, snap in b.slots
                     if self._live(i, snap) and i not in b.skip_slots]
         for i in live_idx:
@@ -1633,6 +1997,12 @@ class Engine:
         for i in live_idx:
             self.cur_tokens[i] = b.ids_np[-1, i]
             self.lengths[i] += b.n_steps
+        # fused groups: the in-fn first token precedes the burst ids in the
+        # ring (mirror must match the device evolution)
+        for i, snap in b.group:
+            if self._live(i, snap) and i not in b.skip_slots:
+                self.ring[i, self.ring_pos[i] % sampling.RING_N] = b.first_ids[i]
+                self.ring_pos[i] += 1
         sampling.host_update_ring(self.ring, self.ring_pos, b.ids_np, live_idx)
         b.folded = True
 
@@ -1642,10 +2012,33 @@ class Engine:
         chain dirty). Per-slot events are COALESCED into one queue put per
         burst (see StreamEvent.token_ids)."""
         self._fold_burst(b)
+        if not b.group and b.t_dispatch:
+            dt = (time.monotonic() - b.t_dispatch) * 1e3
+            self._burst_ms_ema += 0.2 * (dt - self._burst_ms_ema)
         t0 = time.monotonic()
         self._sink_buf = {}
         rolled: set = set()   # grammar slots rolled back mid-burst
         try:
+            # fused-admission slots: emit the in-fn sampled first token
+            # before their burst tokens (this is their TTFT event)
+            t1 = time.monotonic()
+            for i, snap in b.group:
+                if not self._live(i, snap) or i in b.skip_slots:
+                    continue
+                snap.cache_len = snap.written
+                snap.committed = snap.written
+                # charge only the prefill's share of the fused dispatch:
+                # subtract the typical plain-burst latency (EMA) so the
+                # timing stays comparable with the non-fused path
+                snap.t_prefill_ms += max(
+                    0.0, (t1 - b.t_dispatch) * 1e3 - self._burst_ms_ema)
+                if snap.t_first_token == 0.0:
+                    snap.t_first_token = t1
+                if not self._emit_token(i, int(b.first_ids[i]),
+                                        float(b.first_lps[i])):
+                    rolled.add(i)
+            for i, _snap in b.group:
+                self._process_fork_waiters(i)
             for j in range(b.n_steps):
                 for i, snap in b.slots:
                     if i in rolled or i in b.skip_slots \
@@ -1766,7 +2159,6 @@ class Engine:
         s.committed = 0
         self.active_dev[slot] = False
         self.lengths[slot] = 0
-        self._chain_dirty = True
         # restart the penalty ring from the kept window
         self.ring, self.ring_pos = sampling.set_slot_ring(
             self.ring, self.ring_pos, slot, new_ids)
@@ -1808,4 +2200,3 @@ class Engine:
         self.slots[slot] = None
         self.active_dev[slot] = False
         self.lengths[slot] = 0
-        self._chain_dirty = True

@@ -1,0 +1,64 @@
+"""Does a device->host transfer overlap with queued compute on this rig?
+
+Dispatches chained decode bursts and compares: (a) serialized
+sync-after-each-burst, (b) depth-2 pipelined sync (sync burst N after
+dispatching N+1). If (b) ~= (a), transfers serialize with compute and the
+per-roundtrip latency can only be amortized with bigger bursts; if (b) is
+~the pure compute time, pipelining hides the latency and the serving loop
+should too.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sys
+sys.path.insert(0, ".")
+from bench import PRESETS  # noqa: E402
+from localai_tpu.models import llama  # noqa: E402
+
+cfg = llama.LlamaConfig(max_position_embeddings=2048, **PRESETS["1b"])
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+S, C, K = 32, 1024, int(__import__("os").environ.get("K", "16"))
+ck, cv = llama.init_cache(cfg, S, C)
+
+
+@jax.jit
+def burst(params, tokens, lengths, ck, cv):
+    def body(carry, _):
+        tokens, lengths, ck, cv = carry
+        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
+        ids = jnp.argmax(logits, -1).astype(jnp.int32)
+        return (ids, lengths + 1, ck, cv), ids
+
+    carry, ids = jax.lax.scan(body, (tokens, lengths, ck, cv), None, length=K)
+    return carry, ids
+
+
+tokens = jnp.zeros((S,), jnp.int32)
+lengths = jnp.full((S,), C // 2, jnp.int32)
+state = (tokens, lengths, ck, cv)
+state, ids = burst(params, *state)
+np.asarray(ids)
+
+N = 10
+for mode in ("serial", "pipe2", "nosync"):
+    # reset lengths so cache never overflows
+    state = (state[0], jnp.full((S,), C // 2, jnp.int32), state[2], state[3])
+    t0 = time.perf_counter()
+    prev = None
+    for _ in range(N):
+        state, ids = burst(params, *state)
+        if mode == "serial":
+            np.asarray(ids)
+        elif mode == "pipe2":
+            if prev is not None:
+                np.asarray(prev)
+            prev = ids
+    if prev is not None:
+        np.asarray(prev)
+    if mode == "nosync":
+        np.asarray(ids)
+    dt = time.perf_counter() - t0
+    print(f"{mode}: {dt*1e3/N:.1f} ms/burst  ({S*K*N/dt:.0f} tok/s)")
