@@ -67,6 +67,13 @@ class EngineServicer(BackendServicer):
         self._load_lock = threading.Lock()
         self._embed = False
 
+    @staticmethod
+    def _sane_ga_w(extra: dict) -> int:
+        n = max(1, int(extra.get("ga_n", 1) or 1))
+        w = int(extra.get("ga_w", 512) or 512)
+        w = max(w, n)
+        return w - (w % n)   # divisible window: no shared block boundaries
+
     # ---- lifecycle ----
 
     def LoadModel(self, request: pb.ModelOptions, context) -> pb.Result:
@@ -135,10 +142,19 @@ class EngineServicer(BackendServicer):
             tok_dir = request.tokenizer or model_dir
             self.tokenizer = AutoTokenizer.from_pretrained(tok_dir)
 
+        extra = dict(kv.split("=", 1) for kv in (request.options or "").split(",")
+                     if "=" in kv)
         ecfg = eng.EngineConfig(
             num_slots=request.num_slots or 8,
             max_context=request.context_size or min(cfg.max_position_embeddings, 4096),
             prefill_buckets=tuple(request.prefill_buckets) or (32, 128, 512, 2048),
+            # self-extend (model YAML group_attn_n/group_attn_w via the
+            # options k=v escape hatch, reference backend.proto Options).
+            # Sanitized here too: external gRPC clients bypass the YAML
+            # validator, and ga_w=0 or non-divisible windows would crash
+            # or degrade the engine loop.
+            ga_n=max(1, int(extra.get("ga_n", 1) or 1)),
+            ga_w=self._sane_ga_w(extra),
         )
         draft = None
         if request.draft_model:

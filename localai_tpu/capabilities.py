@@ -40,6 +40,8 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         lora_adapter=mc.lora_adapter,
         lora_base=mc.lora_base,
         lora_scale=mc.lora_scale,
+        options=(f"ga_n={mc.group_attn_n},ga_w={mc.group_attn_w}"
+                 if mc.group_attn_n > 1 else ""),
     )
 
 

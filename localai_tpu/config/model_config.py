@@ -133,6 +133,12 @@ class ModelConfig:
     prompt_cache_path: str = ""
     prompt_cache_ro: bool = False
     prompt_cache_all: bool = False
+    # self-extend / group attention (reference: ga_n/ga_w slot state,
+    # grpc-server.cpp:209-213): >1 compresses RoPE positions of completed
+    # ga_w windows by group_attn_n, extending usable context past the
+    # model's training window
+    group_attn_n: int = 1
+    group_attn_w: int = 512
 
     def validate(self) -> list:
         problems = []
@@ -142,6 +148,19 @@ class ModelConfig:
             problems.append(f"context_size must be positive, got {self.context_size}")
         if self.num_slots <= 0:
             problems.append(f"num_slots must be positive, got {self.num_slots}")
+        if self.group_attn_n < 1:
+            problems.append(
+                f"group_attn_n must be >= 1, got {self.group_attn_n}")
+        elif self.group_attn_n > 1:
+            if self.group_attn_w <= 0:
+                problems.append(
+                    f"group_attn_w must be positive, got {self.group_attn_w}")
+            elif self.group_attn_w % self.group_attn_n != 0:
+                # a non-divisible window makes adjacent compressed blocks
+                # share a boundary RoPE position
+                problems.append(
+                    f"group_attn_w ({self.group_attn_w}) must be divisible "
+                    f"by group_attn_n ({self.group_attn_n})")
         return problems
 
     def usecases(self) -> Usecase:

@@ -70,6 +70,16 @@ class EngineConfig:
     # processing never idles the device. Deeper than 2 buys nothing (the
     # host work fits easily inside one burst) and worsens admission lag.
     pipeline_depth: int = 2
+    # self-extend / group attention (reference: ga_n/ga_w slot state,
+    # grpc-server.cpp:209-213, KV surgery :1904-1927): with ga_n > 1,
+    # every completed window of ga_w raw tokens has its RoPE positions
+    # divided by ga_n (cached keys re-rotated in place — rotations
+    # compose, so this is exact and recomputeless), letting a model
+    # trained to max_position_embeddings attend usefully over ga_n x
+    # longer raw contexts. Cache ROWS are unaffected (context shift still
+    # governs capacity).
+    ga_n: int = 1
+    ga_w: int = 512
 
 
 @dataclasses.dataclass
@@ -200,7 +210,7 @@ class _Slot:
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
         "grammar", "gstate", "bias_base", "cur_penalty",
         "phase", "pending", "written", "reused", "cache_len", "committed",
-        "mm_pos", "mm_vec", "spec_ok",
+        "mm_pos", "mm_vec", "spec_ok", "ga_blocks",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -226,6 +236,7 @@ class _Slot:
         self.reused = 0         # prefix tokens reused from a previous request
         self.cache_len = 0      # rows occupied in the slot's KV cache
         self.committed = 0      # rows whose KV write has actually executed
+        self.ga_blocks = 0      # self-extend: position blocks compressed
 
 
 class Engine:
@@ -274,6 +285,7 @@ class Engine:
         self.lengths = np.zeros((S,), np.int32)
         self.cur_tokens = np.zeros((S,), np.int32)
         self.active_dev = np.zeros((S,), np.bool_)
+        self.pos_offset = np.zeros((S,), np.int32)  # self-extend offsets
         self._bias_dirty = np.zeros((S,), np.bool_)
         self._shard_state()
 
@@ -432,10 +444,25 @@ class Engine:
 
     # ---------- jitted step bodies ----------
 
+    def _compose_overrides(self, tokens, lengths, ring, ring_pos, mu, ov_pack):
+        """Merge host override rows (ONE packed [6+RING_N, S] f32 upload:
+        mask, tokens, lengths, ring_pos, mu, pos_offset, ring.T) into the
+        chain state. pos_offset (self-extend) is NOT override-gated — it is
+        current host truth every dispatch."""
+        ov_mask = ov_pack[0] > 0
+        tokens = jnp.where(ov_mask, ov_pack[1].astype(jnp.int32), tokens)
+        lengths = jnp.where(ov_mask, ov_pack[2].astype(jnp.int32), lengths)
+        ring_pos = jnp.where(ov_mask, ov_pack[3].astype(jnp.int32),
+                             jnp.asarray(ring_pos))
+        mu = jnp.where(ov_mask, ov_pack[4], jnp.asarray(mu))
+        pos_offset = ov_pack[5].astype(jnp.int32)
+        ring = jnp.where(ov_mask[:, None], ov_pack[6:].T.astype(jnp.int32),
+                         jnp.asarray(ring))
+        return tokens, lengths, ring, ring_pos, mu, pos_offset
+
     def _decode_burst_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
                            bias, keys, slot_params, active, mu,
-                           ov_mask, ov_tokens, ov_lengths, ov_ring, ov_rpos,
-                           ov_mu, n_steps: int,
+                           ov_pack, n_steps: int,
                            flags: tuple = (True, True, True)):
         """n_steps decode+sample steps in ONE dispatch (lax.scan).
 
@@ -444,18 +471,18 @@ class Engine:
         bias/slot_params/active are constant across the burst.
 
         tokens/lengths/ring/ring_pos/mu arrive as the previous burst's
-        DEVICE output handles (the chain); ov_* are host rows composed in
-        for the slots in ``ov_mask`` — newly activated / rolled-back /
-        re-admitted slots — so host events never force a chain rebuild
-        (and therefore never force the host to wait on an in-flight burst
-        before it can dispatch the next one)."""
-        tokens = jnp.where(ov_mask, ov_tokens, tokens)
-        lengths = jnp.where(ov_mask, ov_lengths, lengths)
-        ring = jnp.where(ov_mask[:, None], ov_ring, jnp.asarray(ring))
-        ring_pos = jnp.where(ov_mask, ov_rpos, jnp.asarray(ring_pos))
-        mu = jnp.where(ov_mask, ov_mu, jnp.asarray(mu))
+        DEVICE output handles (the chain); ov_pack carries host rows
+        composed in for newly activated / rolled-back / re-admitted slots —
+        so host events never force a chain rebuild (and therefore never
+        force the host to wait on an in-flight burst before it can
+        dispatch the next one)."""
+        slot_params = sampling.unpack_slot_params(slot_params)
+        tokens, lengths, ring, ring_pos, mu, pos_offset = \
+            self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
+                                    ov_pack)
 
-        step = self._make_scan_step(params, slot_params, bias, active, flags)
+        step = self._make_scan_step(params, slot_params, bias, active, flags,
+                                    pos_offset)
         carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
         carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None, length=n_steps)
         tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
@@ -470,7 +497,8 @@ class Engine:
             [ids_all.astype(jnp.float32), lps_all, mu[None, :]], axis=0)
         return pack, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
 
-    def _make_scan_step(self, params, slot_params, bias, active, flags):
+    def _make_scan_step(self, params, slot_params, bias, active, flags,
+                        pos_offset=None):
         """The shared decode+sample scan step for plain and fused bursts.
 
         Inactive slots (free / mid-prefill) must NOT write KV: their write
@@ -485,7 +513,8 @@ class Engine:
             tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
             write_lengths = jnp.where(active, lengths, C)
             logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
-                                               write_lengths, ck, cv)
+                                               write_lengths, ck, cv,
+                                               pos_offset=pos_offset)
             ids, logprobs, new_keys, new_mu = sampling.sample(
                 logits, slot_params, ring, ring_pos, bias, keys, mu,
                 use_penalties=flags[0], use_typical=flags[1],
@@ -510,8 +539,7 @@ class Engine:
 
     def _fused_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
                     bias, keys, slot_params, active, mu,
-                    ov_mask, ov_tokens, ov_lengths, ov_ring, ov_rpos, ov_mu,
-                    p_tokens, p_seq, p_slots, p_start,
+                    ov_pack, p_tokens, p_seq, p_slots, p_start,
                     n_steps: int):
         """FUSED admission: final-prefill a batch of B fresh prompts,
         sample their first tokens, and run the decode burst with those
@@ -528,11 +556,10 @@ class Engine:
         Duplicate p_slots entries (pow2 batch padding repeats the last
         prompt) stay idempotent: every per-slot update is a .set() of
         identical values (same inputs -> same sampled id)."""
-        tokens = jnp.where(ov_mask, ov_tokens, tokens)
-        lengths = jnp.where(ov_mask, ov_lengths, lengths)
-        ring = jnp.where(ov_mask[:, None], ov_ring, jnp.asarray(ring))
-        ring_pos = jnp.where(ov_mask, ov_rpos, jnp.asarray(ring_pos))
-        mu = jnp.where(ov_mask, ov_mu, jnp.asarray(mu))
+        slot_params = sampling.unpack_slot_params(slot_params)
+        tokens, lengths, ring, ring_pos, mu, pos_offset = \
+            self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
+                                    ov_pack)
 
         logits, ck, cv = llama.prefill(params, self.cfg, p_tokens, p_seq, ck,
                                        cv, p_slots, p_start, continued=False)
@@ -558,7 +585,7 @@ class Engine:
         # per (bucket, B); a flags dimension would double the precompile
         # set for a small sampler saving)
         step = self._make_scan_step(params, slot_params, bias, active,
-                                    (True, True, True))
+                                    (True, True, True), pos_offset)
         carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
         carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None,
                                                  length=n_steps)
@@ -584,14 +611,17 @@ class Engine:
 
     def _prefill_final_body(self, params, tokens, seq_len, ck, cv, slot, start_pos,
                             ring, ring_pos, bias, keys, slot_params, mu,
-                            continued: bool, mm_pos=None, mm_vec=None):
+                            continued: bool, mm_pos=None, mm_vec=None,
+                            positions=None):
         """Final chunk for a BATCH of B prompts: write KV, sample each one's
         first output token. slot may contain duplicate entries (batch
         padding repeats the last prompt; duplicate KV writes and key
         scatters are idempotent — same inputs, last write wins)."""
         logits, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv,
                                        slot, start_pos, continued=continued,
-                                       mm_pos=mm_pos, mm_vec=mm_vec)
+                                       mm_pos=mm_pos, mm_vec=mm_vec,
+                                       positions=positions)
+        slot_params = sampling.unpack_slot_params(slot_params)
         sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), slot, axis=0),
                                slot_params)
         bias_rows = jnp.take(bias, slot, axis=0)
@@ -649,6 +679,42 @@ class Engine:
             self._final_fns[key] = fn
         return fn
 
+    # self-extend prefill variants (B=1, explicit grouped positions;
+    # lazily compiled — ga is off by default)
+
+    def _get_ga_chunk_fn(self, bucket: int):
+        key = ("ga", bucket)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, t, sl, ck, cv, slo, st, pos: llama.prefill(
+                    p, self.cfg, t, sl, ck, cv, slo, st, continued=True,
+                    positions=pos)[1:],
+                donate_argnums=(3, 4))
+            self._chunk_fns[key] = fn
+        return fn
+
+    def _get_ga_final_fn(self, bucket: int, continued: bool):
+        key = ("ga_final", bucket, continued)
+        fn = self._final_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._prefill_final_body(
+                    *a[:13], continued=continued, positions=a[13]),
+                donate_argnums=(3, 4, 10))
+            self._final_fns[key] = fn
+        return fn
+
+    def _get_ga_rotate_fn(self):
+        fn = self._fork_fns.get("ga_rotate")
+        if fn is None:
+            fn = jax.jit(
+                lambda ck, slot, deltas: llama.shift_cache_positions(
+                    ck, self.cfg, slot, deltas),
+                donate_argnums=(0,))
+            self._fork_fns["ga_rotate"] = fn
+        return fn
+
     # multimodal prefill variants (B=1, lazily compiled on first vision
     # request; keyed additionally on the image-embedding bucket P)
 
@@ -692,15 +758,15 @@ class Engine:
             ks.append(k)
             k *= 2
         S = self.ecfg.num_slots
-        no_ov = (np.zeros((S,), np.bool_), self.cur_tokens, self.lengths,
-                 self.ring, self.ring_pos, self.mu)
+        no_ov = self._pack_ov(np.zeros((S,), np.bool_))
+        spp = sampling.pack_slot_params(self.slot_params)
         for k in ks:
             for flags in ((False, False, False), (True, True, True)):
                 fn = self._get_burst_fn(k, flags)
                 _, self.ck, self.cv, self.rng_keys, _ = fn(
                     self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
-                    self.slot_params, self.active_dev, self.mu, *no_ov)
+                    spp, self.active_dev, self.mu, no_ov)
         for bucket in self._buckets:
             one = np.ones((1,), np.int32)
             zero = np.zeros((1,), np.int32)
@@ -726,7 +792,7 @@ class Engine:
                 _, _, self.ck, self.cv, self.rng_keys, _ = fn(
                     self.params, tb, sb, self.ck, self.cv, slotb, startb,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
-                    self.slot_params, self.mu)
+                    spp, self.mu)
             # fused admission variants (prefill+first-token+burst)
             Bs = [1]
             fb = 2
@@ -738,8 +804,8 @@ class Engine:
                 _, self.ck, self.cv, self.rng_keys, _ = fn(
                     self.params, self.cur_tokens, self.ck, self.cv,
                     self.lengths, self.ring, self.ring_pos, self.bias,
-                    self.rng_keys, self.slot_params, self.active_dev,
-                    self.mu, *no_ov,
+                    self.rng_keys, spp, self.active_dev,
+                    self.mu, no_ov,
                     np.zeros((B, bucket), np.int32), np.ones((B,), np.int32),
                     np.zeros((B,), np.int32), np.zeros((B,), np.int32))
         jax.block_until_ready(self.ck)
@@ -796,6 +862,7 @@ class Engine:
         self.lengths = np.zeros((S,), np.int32)
         self.cur_tokens = np.zeros((S,), np.int32)
         self.active_dev = np.zeros((S,), np.bool_)
+        self.pos_offset = np.zeros((S,), np.int32)
         self._bias_dirty = np.zeros((S,), np.bool_)
         self.slot_params = sampling.make_slot_params(S)
         self.mu = sampling.make_mu(S)
@@ -1036,36 +1103,17 @@ class Engine:
                     self._stop = True
 
     def _admission_ready(self) -> bool:
-        """Hold admissions briefly so batched prefill groups can form:
-        completions arrive a few per decode burst, and admitting each
-        singleton immediately costs a ~140ms prefill dispatch for one
-        prompt. Admit when the queue can fill a decent group, when the
-        engine is otherwise idle, or when the oldest wait exceeds one
-        burst's latency."""
-        if self._queue.empty() or self._free_count() == 0:
-            return False
-        qn = self._queue.qsize()
-        if qn >= min(4, self._free_count()):
-            return True
-        n_decoding = sum(1 for s in self.slots
-                         if s is not None and s.phase == "decode")
-        if n_decoding < self.ecfg.num_slots // 2:
-            return True  # light load: completions won't clump; admit now
-        # under steady (desynced) load completions trickle 1-2 per burst;
-        # holding longer than ~a burst period idles the freed slots for
-        # more than the batched-prefill dispatch saves (r4 measurement:
-        # the r3 0.35 s hold cost ~15% occupancy at steady state)
-        now = time.monotonic()
-        oldest = getattr(self, "_oldest_queued_t", None)
-        return oldest is not None and (now - oldest) > 0.15
+        """Admit the moment a slot is free: fused admission (prefill +
+        first token + burst in one dispatch) makes singleton admissions as
+        cheap as batched ones, so holding requests back to form groups
+        (r2/r3 did, up to 0.35 s) only idles freed slots. The prefill
+        queue itself still batches whatever has accumulated per dispatch."""
+        return not self._queue.empty() and self._free_count() > 0
 
     def _admit(self) -> bool:
         self._reap_cancelled()
-        if not self._queue.empty() and getattr(self, "_oldest_queued_t", None) is None:
-            self._oldest_queued_t = time.monotonic()
         if not self._admission_ready():
             return False
-        self._oldest_queued_t = None
         admitted = False
         batch: list[GenRequest] = []
         while not self._queue.empty() and self._free_count() > len(batch):
@@ -1083,7 +1131,11 @@ class Engine:
                 req.out.put(None)
                 continue
             key = None
-            if not req.grammar and req.mm_vectors is None:
+            # fork-dedup shares KV rows verbatim; under self-extend those
+            # rows are position-compressed state the sibling's own ga
+            # bookkeeping would re-compress — mutually exclusive
+            if not req.grammar and req.mm_vectors is None \
+                    and self.ecfg.ga_n <= 1:
                 # truncation depends on max_new_tokens; bucket it into the key
                 key = (tuple(req.prompt_ids),
                        min(req.max_new_tokens, self.ecfg.max_context // 4))
@@ -1162,7 +1214,12 @@ class Engine:
         # never reuse (their cache rows hold image embeddings, not tokens).
         if common < 16 or mm_pos is not None:
             common = 0
-        if mm_pos is None:
+        if self.ecfg.ga_n > 1:
+            # self-extend re-maps positions as the context grows; cached
+            # prefixes from other requests were keyed under a different
+            # mapping, so reuse and prompt-cache restore are disabled
+            common = 0
+        elif mm_pos is None:
             common = self._restore_prompt_cache(slot, req, ids, common)
 
         # install sampling state for the slot
@@ -1206,6 +1263,7 @@ class Engine:
         s.grammar, s.gstate, s.bias_base = grammar, gstate, bias_base
         s.cur_penalty = penalty0
         s.mm_pos, s.mm_vec = mm_pos, mm_vec
+        self._init_ga(slot, s, len(ids))
         # per-SLOT speculation eligibility (r3; r2 was fleet-wide). Gates:
         #   * greedy, ungrammared, no logit_bias and no penalties — the
         #     spec verify accepts via raw argmax (speculative.py), so any
@@ -1379,6 +1437,11 @@ class Engine:
         req = s.req
         if not req.prompt_cache_path or req.prompt_cache_ro:
             return
+        if self.ecfg.ga_n > 1:
+            # rows may hold position-compressed (self-extend) keys; a
+            # later raw-position engine restoring them would corrupt the
+            # reused prefix — and restore is disabled while ga is on
+            return
         n = s.committed if req.prompt_cache_all else min(s.prompt_len,
                                                          s.committed)
         tokens = self._cache_tokens[slot][:n]
@@ -1418,6 +1481,117 @@ class Engine:
             __import__("logging").getLogger(__name__).exception(
                 "prompt cache save failed: %s", req.prompt_cache_path)
 
+    # ---------- self-extend (group attention) ----------
+
+    def _ga_c(self, P: int) -> int:
+        """Position blocks fully compressed after ingesting P tokens."""
+        return max(0, (P - 1) // self.ecfg.ga_w)
+
+    def _ga_positions(self, lo: int, hi: int, c: int) -> "np.ndarray":
+        """Grouped RoPE positions for raw rows [lo, hi) under c compressed
+        blocks: each full block of ga_w raw tokens occupies ga_w/ga_n
+        positions (integer-divided, so positions repeat within a group —
+        that IS grouped attention); rows past the compressed region keep
+        unit spacing."""
+        n, w = self.ecfg.ga_n, self.ecfg.ga_w
+        i = np.arange(lo, hi, dtype=np.int64)
+        pos = np.where(i < c * w,
+                       (i // w) * (w // n) + (i % w) // n,
+                       c * (w // n) + (i - c * w))
+        return pos.astype(np.int32)
+
+    def _prefill_ga_piece(self, slot: int, s: "_Slot") -> bool:
+        """One prefill piece for a slot whose prompt spans compressed
+        position blocks: explicit grouped positions, one prompt per
+        dispatch. (The reference ingests long prompts chunked and then
+        divides their cached positions, grpc-server.cpp:1904-1927;
+        ingesting directly at the final grouped positions is the same
+        mapping without the intermediate surgery.)"""
+        chunk = self._chunk
+        remaining = len(s.pending)
+        final = remaining <= chunk
+        take = remaining if final else chunk
+        bucket = self._bucket_for(take) if final else chunk
+        positions = np.zeros((1, bucket), np.int32)
+        positions[0, :take] = self._ga_positions(s.written, s.written + take,
+                                                 s.ga_blocks)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :take] = s.pending[:take]
+        t0 = time.monotonic()
+        if not final:
+            self.ck, self.cv = self._get_ga_chunk_fn(bucket)(
+                self.params, tokens, np.array([take], np.int32), self.ck,
+                self.cv, np.array([slot], np.int32),
+                np.array([s.written], np.int32), positions)
+            s.pending = s.pending[take:]
+            s.written += take
+            s.committed = s.written
+            s.t_prefill_ms += (time.monotonic() - t0) * 1e3
+            return True
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = \
+            self._get_ga_final_fn(bucket, s.written > 0)(
+                self.params, tokens, np.array([take], np.int32), self.ck,
+                self.cv, np.array([slot], np.int32),
+                np.array([s.written], np.int32),
+                self.ring.copy(), self.ring_pos.copy(), self.bias,
+                self.rng_keys, sampling.pack_slot_params(self.slot_params),
+                self.mu.copy(), positions)
+        s.pending = []
+        s.written += take
+        if slot in self._prefill_queue:
+            self._prefill_queue.remove(slot)
+        item = _PendingPrefill([(slot, s)], out_ids, logprobs, mu_out, t0)
+        self._fifo.append(item)
+        self._sync_q.put(item)
+        return True
+
+    def _init_ga(self, slot: int, s: "_Slot", P: int):
+        """Set the slot's self-extend state for a fresh P-token ingestion."""
+        if self.ecfg.ga_n <= 1 or s.mm_pos is not None:
+            s.ga_blocks = 0
+            self.pos_offset[slot] = 0
+            return
+        n, w = self.ecfg.ga_n, self.ecfg.ga_w
+        s.ga_blocks = self._ga_c(P)
+        self.pos_offset[slot] = s.ga_blocks * (w - w // n)
+
+    def _maybe_self_extend(self, slot: int, s: "_Slot") -> bool:
+        """Compress newly completed position blocks (reference KV surgery:
+        grpc-server.cpp:1904-1927, recomputeless here — cached keys are
+        re-rotated in place since RoPE rotations compose). Returns True if
+        a compression ran: the slot's not-yet-processed in-flight tokens
+        carry stale positions and are dropped (recompute semantics, the
+        same trade grammar rollback makes)."""
+        n, w = self.ecfg.ga_n, self.ecfg.ga_w
+        did = False
+        while s.committed >= (s.ga_blocks + 1) * w:
+            c = s.ga_blocks
+            bd = w - w // n
+            deltas = np.zeros((self.ecfg.max_context,), np.int32)
+            i = np.arange(c * w, (c + 1) * w, dtype=np.int64)
+            old = i - self.pos_offset[slot]
+            new = c * (w // n) + (i - c * w) // n
+            deltas[c * w:(c + 1) * w] = (new - old).astype(np.int32)
+            deltas[(c + 1) * w:s.committed] = -bd
+            self.ck = self._get_ga_rotate_fn()(self.ck, np.int32(slot), deltas)
+            self.pos_offset[slot] += bd
+            s.ga_blocks = c + 1
+            did = True
+        if did:
+            # reset the slot's decode state to host truth (same recipe as
+            # grammar rollback — verified equivalent by the burst=1 vs
+            # burst=8 grammar determinism check)
+            self.lengths[slot] = s.cache_len
+            toks = self._cache_tokens[slot]
+            self.cur_tokens[slot] = toks[-1] if toks else 0
+            self.ring, self.ring_pos = sampling.set_slot_ring(
+                self.ring, self.ring_pos, slot, toks)
+            self._override.add(slot)
+            for b in self._fifo:
+                if isinstance(b, _Burst):
+                    b.skip_slots.add(slot)
+        return did
+
     def _prefill_plan(self, slot: int):
         """(final, take, bucket, continued) for a slot's next chunk."""
         s = self.slots[slot]
@@ -1450,6 +1624,11 @@ class Engine:
             break
         else:
             return False
+
+        if self.ecfg.ga_n > 1 and s.ga_blocks > 0:
+            # prompt spans compressed position blocks: explicit grouped
+            # positions, singly (never grouped or fused)
+            return self._prefill_ga_piece(slot, s)
 
         final, take, bucket, continued = self._prefill_plan(slot)
 
@@ -1494,7 +1673,8 @@ class Engine:
                 if len(group) >= self._final_pad:
                     break
                 so = self.slots[other]
-                if so is None or so.phase != "prefill" or so.mm_pos is not None:
+                if so is None or so.phase != "prefill" \
+                        or so.mm_pos is not None or so.ga_blocks > 0:
                     continue
                 of, ot, ob, oc = self._prefill_plan(other)
                 if of and not oc and ob == bucket:
@@ -1535,7 +1715,7 @@ class Engine:
         # _dispatch_decode (in-flight dispatches must not see host mutations)
         args = (self.params, tokens, seq_len, self.ck, self.cv, slots_v, start_v,
                 self.ring.copy(), self.ring_pos.copy(), self.bias, self.rng_keys,
-                jax.tree.map(np.array, self.slot_params), self.mu.copy())
+                sampling.pack_slot_params(self.slot_params), self.mu.copy())
         if s.mm_pos is not None:
             fn = self._get_mm_final_fn(bucket, len(s.mm_pos), continued)
             args = args + (mm_rel(s.mm_pos, start_v[0], take, bucket),
@@ -1633,14 +1813,12 @@ class Engine:
             for i in self._override:
                 ov_mask[i] = True
         self._override.clear()
-        ov = (ov_mask, self.cur_tokens.copy(), self.lengths.copy(),
-              self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
         fn = self._get_fused_fn(bucket, B)
         pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, chain[0], self.ck, self.cv, chain[1],
             chain[2], chain[3], self.bias, self.rng_keys,
-            jax.tree.map(np.array, self.slot_params),
-            active, chain[4], *ov,
+            sampling.pack_slot_params(self.slot_params),
+            active, chain[4], self._pack_ov(ov_mask),
             p_tokens, p_seq, p_slots, p_start,
         )
         if self.dck is not None and any(s.spec_ok for _, s in group_snaps):
@@ -1707,6 +1885,20 @@ class Engine:
         for gslot, _snap in group:
             self._process_fork_waiters(gslot)
         self._flush_grammar_bias()
+
+    def _pack_ov(self, ov_mask) -> "np.ndarray":
+        """Build the packed override upload (fresh array every call: the
+        in-flight dispatch must never alias live host mirrors)."""
+        S = self.ecfg.num_slots
+        p = np.empty((6 + sampling.RING_N, S), np.float32)
+        p[0] = ov_mask
+        p[1] = self.cur_tokens
+        p[2] = self.lengths
+        p[3] = self.ring_pos
+        p[4] = self.mu
+        p[5] = self.pos_offset
+        p[6:] = self.ring.T
+        return p
 
     def _n_inflight_bursts(self) -> int:
         return sum(1 for x in self._fifo if isinstance(x, _Burst))
@@ -1821,7 +2013,9 @@ class Engine:
         rows of headroom."""
         S = self.ecfg.num_slots
         mask = np.zeros((S,), np.bool_)
-        if self.dck is None or self.ecfg.n_draft <= 0:
+        if self.dck is None or self.ecfg.n_draft <= 0 or self.ecfg.ga_n > 1:
+            # spec rounds advance positions row=position; they are not
+            # self-extend-aware — mutually exclusive features
             return mask
         D = self.ecfg.n_draft
         for i, s in enumerate(self.slots):
@@ -1935,11 +2129,6 @@ class Engine:
             for i in self._override:
                 ov_mask[i] = True
         self._override.clear()
-        # DEFENSIVE COPIES: jax may zero-copy alias numpy arguments
-        # (observed on the CPU client) — an in-flight dispatch holding the
-        # live mirror arrays would see later in-place host mutations
-        ov = (ov_mask, self.cur_tokens.copy(), self.lengths.copy(),
-              self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
         # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
         # released and re-admitted while this burst is in flight, and the
         # new occupant must never receive the stale burst's tokens
@@ -1947,8 +2136,8 @@ class Engine:
         pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, chain[0], self.ck, self.cv, chain[1],
             chain[2], chain[3], self.bias, self.rng_keys,
-            jax.tree.map(np.array, self.slot_params),
-            active, chain[4], *ov,
+            sampling.pack_slot_params(self.slot_params),
+            active, chain[4], self._pack_ov(ov_mask),
         )
         self._tmark("dispatch", t_d)
         if self._trace:
@@ -2113,10 +2302,13 @@ class Engine:
                 elif delta:
                     delta, s.held_text = self._holdback(s, delta)
 
+        extended = False
         if finish is None and not shifted:
             # this token's KV is written by the next decode step
             self._cache_tokens[slot].append(token_id)
             s.cache_len += 1
+            if self.ecfg.ga_n > 1 and s.mm_pos is None:
+                extended = self._maybe_self_extend(slot, s)
 
         ev = StreamEvent(
             token_id=token_id, text=delta, logprob=logprob,
@@ -2143,7 +2335,10 @@ class Engine:
             buf.setdefault((slot, s.req.out), []).append(ev)
         else:
             s.req.out.put(ev)
-        return True
+        # a self-extend compression invalidates the slot's remaining
+        # in-flight tokens (stale positions) — skip them like a rollback,
+        # but the token above was valid and HAS been emitted
+        return not extended
 
     def _context_shift(self, slot: int, s: _Slot, token_id: int):
         """Cache full mid-generation: re-prefill the tail half of the logical
@@ -2157,6 +2352,7 @@ class Engine:
         s.written = 0
         s.cache_len = 0
         s.committed = 0
+        self._init_ga(slot, s, len(new_ids))
         self.active_dev[slot] = False
         self.lengths[slot] = 0
         # restart the penalty ring from the kept window

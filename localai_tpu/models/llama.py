@@ -261,6 +261,7 @@ def prefill(
     mm_pos: Optional[jax.Array] = None,   # [B, P] chunk-relative positions
     mm_vec: Optional[jax.Array] = None,   # [B, P, D] injected embeddings
     return_all_logits: bool = False,      # STATIC: logits for every position
+    positions: Optional[jax.Array] = None,  # [B, T] RoPE position override
 ):
     """Process full prompts, write KV into the cache slots, return last-token logits.
 
@@ -280,7 +281,8 @@ def prefill(
     the KV scatter (mode="drop"), i.e. silently lost, not clamped.
     """
     B, T = tokens.shape
-    positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    if positions is None:
+        positions = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     sin, cos = rope_frequencies(cfg, positions)
     x = _embed_rows(params["embed"], tokens, cfg.dtype)
     if mm_pos is not None:
@@ -342,11 +344,15 @@ def decode_step(
     lengths: jax.Array,    # [S] int32 — current context length per slot (position of new token)
     cache_k: jax.Array,    # [L, S, C, KV, hd]
     cache_v: jax.Array,
+    pos_offset: jax.Array = None,  # [S] int32 — self-extend position offset
 ):
     """One decode step for ALL slots (inactive slots are masked by caller).
 
     Returns (logits [S, V], cache_k, cache_v). The new token for slot s is
     written at cache position lengths[s]; attention spans [0, lengths[s]].
+    With self-extend (group attention) active, its RoPE position is
+    lengths[s] - pos_offset[s]: cache ROWS keep raw token order (attention
+    masking is row-based) while positions are compressed.
 
     INVARIANT (enforced by the engine scheduler): lengths[s] < C for active
     slots. At lengths[s] == C the one_hot write row is all-zero and the new
@@ -355,6 +361,8 @@ def decode_step(
     """
     S = tokens.shape[0]
     positions = lengths[:, None]  # [S, 1]
+    if pos_offset is not None:
+        positions = positions - pos_offset[:, None]
     sin, cos = rope_frequencies(cfg, positions)
     x = _embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]  # [S,1,D]
     C = cache_k.shape[2]
@@ -412,6 +420,24 @@ def decode_step(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _unembed(x, params, cfg)[:, 0, :]
     return logits, cache_k, cache_v
+
+
+def shift_cache_positions(cache_k: jax.Array, cfg: LlamaConfig,
+                          slot: jax.Array, deltas: jax.Array) -> jax.Array:
+    """Re-rotate ONE slot's cached keys by per-row position deltas [C].
+
+    The recomputeless self-extend primitive: grouped attention compresses
+    the positions of past blocks (reference KV surgery:
+    grpc-server.cpp:1904-1927); since RoPE rotations compose, rotating the
+    cached (already-rotated) keys by (new_pos - old_pos) is EXACT. Values
+    carry no positional encoding and stay untouched. Rows with delta 0
+    are rotated by the identity."""
+    from localai_tpu.ops.rope import rope_delta_terms, rotate_by_delta
+
+    sin, cos = rope_delta_terms(cfg, deltas)            # [C, hd]
+    rows = cache_k[:, slot]                             # [L, C, KV, hd]
+    out = rotate_by_delta(rows, sin[None, :, None, :], cos[None, :, None, :])
+    return cache_k.at[:, slot].set(out)
 
 
 def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int, dtype=None):

@@ -137,7 +137,10 @@ def encode(params: dict, cfg: VisionConfig, pixels: jax.Array) -> jax.Array:
         a = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, -1, D)
         x = x + jnp.einsum("bte,ed->btd", a, ly["wo"]) + ly["bo"]
         h = _ln(x, ly["norm2_w"], ly["norm2_b"], eps)
-        h = jax.nn.gelu(jnp.einsum("btd,df->btf", h, ly["w1"]) + ly["b1"])
+        # CLIP's MLP activation is QUICK gelu (x * sigmoid(1.702 x)), not
+        # the tanh approximation — r4 torch-parity divergence
+        a = jnp.einsum("btd,df->btf", h, ly["w1"]) + ly["b1"]
+        h = a * jax.nn.sigmoid(1.702 * a)
         x = x + jnp.einsum("btf,fd->btd", h, ly["w2"]) + ly["b2"]
         return x, None
 
@@ -155,8 +158,9 @@ def encode(params: dict, cfg: VisionConfig, pixels: jax.Array) -> jax.Array:
         layers_used = params["layers"]
     x, _ = jax.lax.scan(layer, x, layers_used)
     patches = x[:, 1:, :]                                # drop CLS (LLaVA)
+    # the LLaVA projector uses EXACT gelu (erf), unlike the CLIP tower
     h = jax.nn.gelu(jnp.einsum("bnd,de->bne", patches, params["proj_w1"])
-                    + params["proj_b1"])
+                    + params["proj_b1"], approximate=False)
     return jnp.einsum("bne,ef->bnf", h, params["proj_w2"]) + params["proj_b2"]
 
 

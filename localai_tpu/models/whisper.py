@@ -77,12 +77,29 @@ class WhisperConfig:
 # ---------- mel frontend ----------
 
 def _mel_filterbank(n_mels: int) -> np.ndarray:
-    """[n_mels, n_fft//2+1] triangular mel filters (HTK mel scale)."""
+    """[n_mels, n_fft//2+1] triangular mel filters (SLANEY mel scale).
+
+    Whisper's filterbank (and the HF WhisperFeatureExtractor oracle) uses
+    the slaney scale — linear below 1 kHz, logarithmic above — not HTK;
+    r4's torch-parity test caught the HTK version diverging by up to
+    0.23 in log-mel units (a real transcription-quality bug)."""
+    f_sp = 200.0 / 3.0
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = np.log(6.4) / 27.0
+
     def hz_to_mel(f):
-        return 2595.0 * np.log10(1.0 + f / 700.0)
+        f = np.asarray(f, np.float64)
+        mel = f / f_sp
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-9) / min_log_hz)
+                        / logstep, mel)
 
     def mel_to_hz(m):
-        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        m = np.asarray(m, np.float64)
+        hz = m * f_sp
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
 
     fmax = SAMPLE_RATE / 2
     mels = np.linspace(hz_to_mel(0.0), hz_to_mel(fmax), n_mels + 2)

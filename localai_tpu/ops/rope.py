@@ -70,6 +70,30 @@ def rope_frequencies(cfg, positions: jax.Array):
     return jnp.sin(emb) * mscale, jnp.cos(emb) * mscale
 
 
+def rope_delta_terms(cfg, delta: jax.Array):
+    """delta positions [...] -> (sin, cos) each [..., head_dim] for a PURE
+    rotation by ``delta * inv_freq`` — no yarn attention-temperature
+    mscale. RoPE rotations compose (angle is linear in position for every
+    scaling family, which only modifies inv_freq), so cached keys written
+    at position a become keys at position b when rotated by (b - a); the
+    mscale magnitude factor is already baked into the cached keys and must
+    not be applied twice. Used by the self-extend KV re-rotation
+    (reference: grpc-server.cpp:1916-1927 llama_kv_cache_seq_div/add)."""
+    inv_freq = jnp.asarray(_scaled_inv_freq(cfg), jnp.float32)
+    freqs = delta.astype(jnp.float32)[..., None] * inv_freq
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.sin(emb), jnp.cos(emb)
+
+
+def rotate_by_delta(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """x [..., hd]; sin/cos broadcastable [..., hd]. rotate_half rotation."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    return (x * cos + rotated * sin).astype(dtype)
+
+
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     """x [B, T, H, hd]; sin/cos [B, T, hd]. HF rotate_half convention."""
     dtype = x.dtype
