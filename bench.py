@@ -49,10 +49,230 @@ PRESETS = {
     # TinyLlama-1.1B shape
     "1b": dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64),
+    # Llama-3.1-8B shape (the BASELINE.json metric model; int8 weights
+    # ~8.5 GB fit a single v5e chip)
+    "8b": dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+               num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128),
     # small smoke config (CPU-safe)
     "smoke": dict(vocab_size=512, hidden_size=128, intermediate_size=256,
                   num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16),
 }
+
+# serving shape per preset: (slots, context, quantization)
+HTTP_PRESETS = {
+    "1b": dict(slots=32, ctx=1024, quant=""),
+    "8b": dict(slots=16, ctx=1024, quant="int8"),
+    "smoke": dict(slots=2, ctx=128, quant=""),   # CPU-safe harness check
+}
+
+
+def _write_bench_model(models_dir: str, preset: str, slots: int, ctx: int,
+                       quant: str) -> None:
+    """config.json-only checkpoint (random weights via the gated loader
+    fallback) + a size-matched word-level tokenizer + model YAML."""
+    import json as _json
+
+    shape = PRESETS[preset]
+    ckpt = os.path.join(models_dir, f"bench-{preset}")
+    os.makedirs(ckpt, exist_ok=True)
+    with open(os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "vocab_size": shape["vocab_size"],
+            "hidden_size": shape["hidden_size"],
+            "intermediate_size": shape["intermediate_size"],
+            "num_hidden_layers": shape["num_layers"],
+            "num_attention_heads": shape["num_heads"],
+            "num_key_value_heads": shape["num_kv_heads"],
+            "head_dim": shape["head_dim"],
+            "max_position_embeddings": 2048,
+            "rms_norm_eps": 1e-5, "rope_theta": 500000.0,
+            "bos_token_id": 1, "eos_token_id": 2,
+            "tie_word_embeddings": False,
+        }, f)
+    from tokenizers import Tokenizer, models as tokmodels
+    from tokenizers.pre_tokenizers import WhitespaceSplit
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for i in range(3, shape["vocab_size"]):
+        vocab[f"t{i}"] = i
+    tok = Tokenizer(tokmodels.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = WhitespaceSplit()
+    tok.save(os.path.join(ckpt, "tokenizer.json"))
+    with open(os.path.join(ckpt, "tokenizer_config.json"), "w") as f:
+        _json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                    "bos_token": "<s>", "eos_token": "</s>",
+                    "model_max_length": 2048}, f)
+    with open(os.path.join(models_dir, f"bench-{preset}.yaml"), "w") as f:
+        f.write(f"""\
+name: bench-{preset}
+backend: tpu-llm
+parameters:
+  model: bench-{preset}
+  temperature: 0.8
+  top_k: 40
+  top_p: 0.95
+context_size: {ctx}
+num_slots: {slots}
+dtype: bfloat16
+quantization: "{quant}"
+prefill_buckets: [128, 512]
+template:
+  completion: "{{{{ Input }}}}"
+  chat_message: "{{{{ Content }}}}"
+  chat: "{{{{ Input }}}}"
+""")
+
+
+def bench_http(preset: str, prompt_len: int, max_new: int,
+               target_tokens: int) -> dict:
+    """THE BASELINE.json metric: tokens/sec/chip + TTFT measured on
+    /v1/chat/completions over real HTTP with SSE streaming — full stack
+    (aiohttp app -> capabilities -> gRPC -> subprocess engine on the TPU),
+    closed-loop with de-phased concurrent streams.
+
+    The parent process stays on the CPU platform; the spawned backend owns
+    the chip (reference measures at the endpoint too:
+    core/services/metrics.go:36-44)."""
+    import asyncio
+    import tempfile
+    import threading
+
+    import httpx
+
+    hp = HTTP_PRESETS[preset]
+    S = int(os.environ.get("LOCALAI_BENCH_SLOTS", hp["slots"]))
+    models = tempfile.mkdtemp(prefix=f"bench-{preset}-")
+    _write_bench_model(models, preset, S, hp["ctx"], hp["quant"])
+
+    os.environ["LOCALAI_ALLOW_RANDOM_WEIGHTS"] = "1"
+    os.environ["LOCALAI_JAX_PLATFORM"] = os.environ.get(
+        "LOCALAI_BENCH_BACKEND_PLATFORM", "")
+
+    from localai_tpu.api.app import build_app, run_app
+    from localai_tpu.capabilities import Capabilities
+    from localai_tpu.config.app_config import AppConfig
+    from localai_tpu.config.model_config import scan_models_dir
+    from localai_tpu.modelmgr.loader import ModelLoader
+    from localai_tpu.modelmgr.process import free_port
+
+    port = free_port()
+    app_config = AppConfig(models_path=models, address=f"127.0.0.1:{port}")
+    # model load = spawn + weight gen + precompile: can take many minutes
+    # for fresh 8B int8 executables (persistent cache makes reruns fast)
+    loader = ModelLoader(health_attempts=1200, health_interval_s=0.5)
+    configs = scan_models_dir(models)
+    caps = Capabilities(app_config, loader, configs)
+    app = build_app(caps, app_config)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            await run_app(app, app_config.address)
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    base = f"http://127.0.0.1:{port}"
+    model = f"bench-{preset}"
+    rng = np.random.default_rng(0)
+    V = PRESETS[preset]["vocab_size"]
+
+    def prompt_text(n):
+        ids = rng.integers(3, V, size=n)
+        return " ".join(f"t{i}" for i in ids)
+
+    async def drive():
+        results = {"completed": 0, "ttfts": [], "errors": []}
+        stop = asyncio.Event()
+
+        async def one_stream(client, n_new):
+            body = {"model": model, "stream": True, "ignore_eos": True,
+                    "max_tokens": n_new,
+                    "messages": [{"role": "user",
+                                  "content": prompt_text(prompt_len)}]}
+            t0 = time.monotonic()
+            ttft = None
+            usage_ct = 0
+            async with client.stream("POST", f"{base}/v1/chat/completions",
+                                     json=body) as r:
+                if r.status_code != 200:
+                    results["errors"].append(await r.aread())
+                    return 0, None
+                async for line in r.aiter_lines():
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        break
+                    chunk = json.loads(data)
+                    ch = chunk.get("choices") or [{}]
+                    delta = ch[0].get("delta") or {}
+                    if ttft is None and delta.get("content"):
+                        ttft = time.monotonic() - t0
+                    if chunk.get("usage"):
+                        usage_ct = chunk["usage"].get("completion_tokens",
+                                                      usage_ct)
+            return usage_ct, ttft
+
+        async def consumer(client, tid):
+            first = True
+            while not stop.is_set():
+                n_new = (max(8, max_new - (tid * max_new) // S)
+                         if first else max_new)
+                first = False
+                ct, ttft = await one_stream(client, n_new)
+                results["completed"] += ct
+                if ttft is not None:
+                    results["ttfts"].append(ttft)
+                if results["completed"] >= target_tokens or results["errors"]:
+                    stop.set()
+
+        timeout = httpx.Timeout(connect=60, read=3600, write=60, pool=3600)
+        limits = httpx.Limits(max_connections=S + 4)
+        async with httpx.AsyncClient(timeout=timeout, limits=limits) as client:
+            # warmup: trigger model load + jit warm, one full round
+            warm = [one_stream(client, 2 * max_new) for _ in range(S)]
+            await asyncio.gather(*warm)
+            t0 = time.monotonic()
+            tasks = [asyncio.create_task(consumer(client, i))
+                     for i in range(S)]
+            await asyncio.gather(*tasks)
+            wall = time.monotonic() - t0
+            # unloaded TTFT floor: single stream against the idle server
+            unloaded = []
+            for _ in range(3):
+                _, ttft = await one_stream(client, 4)
+                if ttft is not None:
+                    unloaded.append(ttft)
+        return results, wall, unloaded
+
+    try:
+        results, wall, unloaded = asyncio.run(drive())
+    finally:
+        loader.stop_all()
+        loop.call_soon_threadsafe(loop.stop)
+    if results["errors"]:
+        raise RuntimeError(str(results["errors"][0])[:500])
+    ttfts = results["ttfts"]
+    return {
+        "tok_s": results["completed"] / wall,
+        "p50_ttft_ms": float(np.percentile(ttfts, 50) * 1e3),
+        "p95_ttft_ms": float(np.percentile(ttfts, 95) * 1e3),
+        "unloaded_ttft_ms": float(np.median(unloaded) * 1e3) if unloaded else 0.0,
+        "completion_tokens": results["completed"],
+        "wall_s": wall,
+    }
+
+
 
 
 def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
@@ -251,44 +471,86 @@ def bench_kernel(cfg, S, C, steps, inner):
 
 
 def main():
-    from localai_tpu.utils.jaxtools import enable_compilation_cache
-
-    enable_compilation_cache()
-    preset = os.environ.get("LOCALAI_BENCH_PRESET", "1b")
-    from localai_tpu.models import llama
-    cfg = llama.LlamaConfig(max_position_embeddings=2048, **PRESETS[preset])
-
-    S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "32"))
-    C = int(os.environ.get("LOCALAI_BENCH_CTX", "1024"))
-
-    if "--kernel" in sys.argv:
-        steps = int(os.environ.get("LOCALAI_BENCH_STEPS", "128"))
-        inner = int(os.environ.get("LOCALAI_BENCH_INNER", "16"))
-        r = bench_kernel(cfg, S, C, steps, inner)
-        qtag = "int8" if os.environ.get("LOCALAI_BENCH_QUANT", "") == "int8" else "bf16"
-        print(json.dumps({
-            "metric": f"kernel_decode_tok_s_per_chip_llama_{preset}_{qtag}_slots{S}",
-            "value": round(r["tok_s"], 1), "unit": "tok/s",
-            "vs_baseline": round(r["tok_s"] / 2000.0, 3),
-        }))
-        return
-
     prompt_len = int(os.environ.get("LOCALAI_BENCH_PROMPT", "128"))
     max_new = int(os.environ.get("LOCALAI_BENCH_NEW", "128"))
     target = int(os.environ.get("LOCALAI_BENCH_TOKENS", "8192"))
-    burst = int(os.environ.get("LOCALAI_BENCH_BURST", "16"))
-    r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
-    gtag = "_grammar" if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1" else ""
-    print(json.dumps({
-        "metric": (f"serving_tok_s_per_chip_llama_{preset}_"
-                   f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', '') == 'int8' else 'bf16'}"
-                   f"_slots{S}{gtag}"),
+
+    if "--engine" in sys.argv or "--kernel" in sys.argv:
+        # engine-direct / kernel modes own the chip in-process
+        from localai_tpu.utils.jaxtools import enable_compilation_cache
+
+        enable_compilation_cache()
+        preset = os.environ.get("LOCALAI_BENCH_PRESET", "1b")
+        from localai_tpu.models import llama
+        cfg = llama.LlamaConfig(max_position_embeddings=2048, **PRESETS[preset])
+
+        S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "32"))
+        C = int(os.environ.get("LOCALAI_BENCH_CTX", "1024"))
+
+        if "--kernel" in sys.argv:
+            steps = int(os.environ.get("LOCALAI_BENCH_STEPS", "128"))
+            inner = int(os.environ.get("LOCALAI_BENCH_INNER", "16"))
+            r = bench_kernel(cfg, S, C, steps, inner)
+            qtag = "int8" if os.environ.get("LOCALAI_BENCH_QUANT", "") == "int8" else "bf16"
+            print(json.dumps({
+                "metric": f"kernel_decode_tok_s_per_chip_llama_{preset}_{qtag}_slots{S}",
+                "value": round(r["tok_s"], 1), "unit": "tok/s",
+                "vs_baseline": round(r["tok_s"] / 2000.0, 3),
+            }))
+            return
+
+        burst = int(os.environ.get("LOCALAI_BENCH_BURST", "16"))
+        r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
+        gtag = "_grammar" if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1" else ""
+        print(json.dumps({
+            "metric": (f"engine_tok_s_per_chip_llama_{preset}_"
+                       f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', '') == 'int8' else 'bf16'}"
+                       f"_slots{S}{gtag}"),
+            "value": round(r["tok_s"], 1), "unit": "tok/s",
+            "vs_baseline": round(r["tok_s"] / 2000.0, 3),
+            "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
+            "p95_ttft_ms": round(r["p95_ttft_ms"], 1),
+            "unloaded_ttft_ms": round(r["unloaded_ttft_ms"], 1),
+        }))
+        return
+
+    # DEFAULT: the BASELINE.json metric — /v1/chat/completions over real
+    # HTTP with SSE. The parent process pins itself to the CPU platform
+    # (config, not env — the spawned backend must still see the chip).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b,1b").split(",")
+    presets = [p.strip() for p in presets if p.strip()]
+    results = {}
+    errors = {}
+    for p in presets:
+        try:
+            results[p] = bench_http(p, prompt_len, max_new, target)
+        except Exception as e:  # report what ran; a preset OOM shouldn't
+            errors[p] = f"{type(e).__name__}: {e}"  # zero the whole bench
+    if not results:
+        raise RuntimeError(f"no preset completed: {errors}")
+    primary = "8b" if "8b" in results else sorted(results)[0]
+    r = results[primary]
+    qtag = "int8" if HTTP_PRESETS.get(primary, {}).get("quant") == "int8" else "bf16"
+    line = {
+        "metric": (f"http_chat_tok_s_per_chip_llama_{primary}_{qtag}_slots"
+                   f"{int(os.environ.get('LOCALAI_BENCH_SLOTS', HTTP_PRESETS[primary]['slots']))}"),
         "value": round(r["tok_s"], 1), "unit": "tok/s",
         "vs_baseline": round(r["tok_s"] / 2000.0, 3),
         "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
         "p95_ttft_ms": round(r["p95_ttft_ms"], 1),
         "unloaded_ttft_ms": round(r["unloaded_ttft_ms"], 1),
-    }))
+    }
+    for p, rr in results.items():
+        if p != primary:
+            line[f"{p}_tok_s"] = round(rr["tok_s"], 1)
+            line[f"{p}_p50_ttft_ms"] = round(rr["p50_ttft_ms"], 1)
+            line[f"{p}_p95_ttft_ms"] = round(rr["p95_ttft_ms"], 1)
+    for p, err in errors.items():
+        line[f"{p}_error"] = err[:200]
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,112 @@
+"""Remote-API passthrough LLM backend (reference parity:
+backend/go/llm/langchain/langchain.go + pkg/langchain/huggingface.go —
+the lowest-priority greedy fallback that answers via the HuggingFace
+Inference API when no local backend can serve a model).
+
+LoadModel `model` is either a full endpoint URL (http/https) or a HF
+model id (mapped to the public inference endpoint). The token comes from
+HUGGINGFACEHUB_API_TOKEN / HF_TOKEN, like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import urllib.request
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.service import BackendServicer, make_server
+
+log = logging.getLogger(__name__)
+
+HF_ENDPOINT = "https://api-inference.huggingface.co/models/{model}"
+
+
+class RemoteServicer(BackendServicer):
+    def __init__(self):
+        self.endpoint = None
+        self.token = ""
+
+    def LoadModel(self, request, context):
+        model = request.model or ""
+        if model.startswith(("http://", "https://")):
+            self.endpoint = model
+        elif model:
+            self.endpoint = HF_ENDPOINT.format(model=model)
+        else:
+            return pb.Result(success=False, message="no model/endpoint")
+        self.token = (os.environ.get("HUGGINGFACEHUB_API_TOKEN")
+                      or os.environ.get("HF_TOKEN") or "")
+        return pb.Result(success=True, message="remote endpoint set")
+
+    def _infer(self, opts: "pb.PredictOptions") -> str:
+        body = {
+            "inputs": opts.prompt,
+            "parameters": {
+                "max_new_tokens": opts.max_tokens or 256,
+                "temperature": max(opts.temperature, 1e-3)
+                if opts.temperature else None,
+                "top_p": opts.top_p or None,
+                "top_k": opts.top_k or None,
+                "return_full_text": False,
+            },
+        }
+        body["parameters"] = {k: v for k, v in body["parameters"].items()
+                              if v is not None}
+        req = urllib.request.Request(
+            self.endpoint, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.token}"}
+                        if self.token else {})})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read().decode())
+        # HF text-generation shape: [{"generated_text": "..."}]
+        if isinstance(out, list) and out and "generated_text" in out[0]:
+            return out[0]["generated_text"]
+        if isinstance(out, dict) and "generated_text" in out:
+            return out["generated_text"]
+        raise ValueError(f"unexpected remote response: {str(out)[:200]}")
+
+    def Predict(self, request, context):
+        if self.endpoint is None:
+            import grpc
+
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "no endpoint configured")
+        try:
+            text = self._infer(request)
+            return pb.Reply(message=text.encode("utf-8"))
+        except Exception as e:
+            log.exception("remote inference failed")
+            import grpc
+
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"{type(e).__name__}: {e}")
+
+    def PredictStream(self, request, context):
+        # the reference's langchain backend is also non-incremental: one
+        # remote call, one reply (langchain.go:34-62)
+        yield self.Predict(request, context)
+
+    def Status(self, request, context):
+        state = (pb.StatusResponse.READY if self.endpoint
+                 else pb.StatusResponse.UNINITIALIZED)
+        return pb.StatusResponse(state=state,
+                                 memory=pb.MemoryUsageData(total=0))
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--addr", required=True)
+    args = parser.parse_args(argv)
+    server = make_server(RemoteServicer(), args.addr)
+    server.start()
+    print(f"gRPC Server listening at {args.addr}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,9 @@ class TTSServicer(BackendServicer):
         # kakao-enterprise/vits-*) — set when config.json says vits
         self.vits = None       # (cfg, params)
         self.vits_tokenizer = None
+        # real music generation (HF MusicgenForConditionalGeneration)
+        self.musicgen = None   # (cfg, params)
+        self.musicgen_tokenizer = None
 
     def LoadModel(self, request, context):
         try:
@@ -74,10 +77,27 @@ class TTSServicer(BackendServicer):
             if model_dir and os.path.exists(cfg_path):
                 with open(cfg_path) as f:
                     cfg_dict = _json.load(f)
-            # a reload must never leave a previous VITS model active
+            # a reload must never leave a previous real model active
             self.vits = None
             self.vits_tokenizer = None
-            if cfg_dict.get("model_type") == "vits":
+            self.musicgen = None
+            self.musicgen_tokenizer = None
+            if cfg_dict.get("model_type") == "musicgen":
+                # published MusicGen checkpoint (facebook/musicgen-*):
+                # T5 text encoder + codebook LM + EnCodec decode, full
+                # torch parity (models/musicgen.py; reference:
+                # backend/python/transformers-musicgen/backend.py)
+                from localai_tpu.models import musicgen as jmg
+
+                mcfg = jmg.MusicgenConfig.from_json(cfg_path)
+                self.musicgen = (mcfg, jmg.load_hf_params(model_dir, mcfg))
+                from transformers import AutoTokenizer
+
+                self.musicgen_tokenizer = AutoTokenizer.from_pretrained(
+                    model_dir)
+                self.cfg = tts.TTSConfig()
+                self.params = self.musicgen[1]
+            elif cfg_dict.get("model_type") == "vits":
                 # published VITS/MMS checkpoint: full parity stack
                 from localai_tpu.models import vits as jvits
 
@@ -150,6 +170,14 @@ class TTSServicer(BackendServicer):
 
         try:
             with self._lock:
+                if self.musicgen is not None:
+                    # the reference's musicgen backend serves TTS too
+                    # (transformers-musicgen backend.py TTS -> generate)
+                    wave, rate = self._musicgen_generate(
+                        pb.SoundGenerationRequest(text=request.text,
+                                                  duration=8.0))
+                    tts.write_wav(request.dst, wave, sample_rate=rate)
+                    return pb.Result(success=True, message="ok")
                 if self.vits is not None:
                     wave, rate = self._vits_synthesize(request.text,
                                                        request.voice)
@@ -170,6 +198,10 @@ class TTSServicer(BackendServicer):
 
         try:
             with self._lock:
+                if self.musicgen is not None:
+                    wave, rate = self._musicgen_generate(request)
+                    tts.write_wav(request.dst, wave, sample_rate=rate)
+                    return pb.Result(success=True, message="ok")
                 if self.vits is not None:
                     wave, rate = self._vits_synthesize(request.text)
                 else:
@@ -185,6 +217,31 @@ class TTSServicer(BackendServicer):
         except Exception as e:
             log.exception("SoundGeneration failed")
             return pb.Result(success=False, message=f"{type(e).__name__}: {e}")
+
+    def _musicgen_generate(self, request) -> tuple:
+        """Reference semantics (transformers-musicgen backend.py:1-176):
+        text prompt + optional duration (default 8 s) + temperature /
+        do_sample; sampled top-k generation with CFG."""
+        from localai_tpu.models import musicgen as jmg
+
+        mcfg, params = self.musicgen
+        duration = (float(request.duration)
+                    if request.HasField("duration") else 8.0)
+        frames = max(1, int(round(duration * mcfg.frame_rate)))
+        do_sample = (bool(request.sample)
+                     if request.HasField("sample") else True)
+        temperature = (float(request.temperature)
+                       if request.HasField("temperature") else 1.0)
+        if not do_sample:
+            temperature = 0.0
+        enc = self.musicgen_tokenizer(request.text, return_tensors="np")
+        tokens = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc.get(
+            "attention_mask", np.ones_like(tokens)), np.int32)
+        wave = jmg.generate(params, mcfg, tokens, mask, frames=frames,
+                            temperature=temperature,
+                            seed=hash(request.text) & 0x7FFFFFFF)
+        return wave, mcfg.enc.sampling_rate
 
     def Status(self, request, context):
         state = pb.StatusResponse.READY if self.params is not None else \

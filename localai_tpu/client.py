@@ -1,0 +1,102 @@
+"""Python client SDK for a running server (reference parity:
+core/clients/store.go + pkg/store/client.go — the Go vector-store client
+SDK, extended with the obvious chat/embedding helpers).
+
+Synchronous, httpx-based, dependency-light:
+
+    from localai_tpu.client import Client
+    c = Client("http://localhost:8080", api_key="sk-...")
+    c.stores_set(keys=[[0.1, 0.2]], values=["hello"], store="default")
+    hits = c.stores_find(key=[0.1, 0.2], topk=3)
+    text = c.chat("tiny", [{"role": "user", "content": "hi"}])
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import httpx
+
+
+class Client:
+    def __init__(self, base_url: str, api_key: str = "",
+                 timeout: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        headers = {}
+        if api_key:
+            headers["Authorization"] = f"Bearer {api_key}"
+        self._http = httpx.Client(base_url=self.base_url, headers=headers,
+                                  timeout=timeout)
+
+    def close(self):
+        self._http.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _post(self, path: str, body: dict) -> dict:
+        r = self._http.post(path, json=body)
+        r.raise_for_status()
+        return r.json() if r.content else {}
+
+    # ---- vector store (reference: core/clients/store.go:1-155) ----
+
+    def stores_set(self, keys: list, values: list, store: str = "") -> None:
+        self._post("/stores/set",
+                   {"keys": keys, "values": values, "store": store})
+
+    def stores_get(self, keys: list, store: str = "") -> tuple:
+        r = self._post("/stores/get", {"keys": keys, "store": store})
+        return r.get("keys", []), r.get("values", [])
+
+    def stores_delete(self, keys: list, store: str = "") -> None:
+        self._post("/stores/delete", {"keys": keys, "store": store})
+
+    def stores_find(self, key: list, topk: int = 5, store: str = "") -> tuple:
+        r = self._post("/stores/find",
+                       {"key": key, "topk": topk, "store": store})
+        return (r.get("keys", []), r.get("values", []),
+                r.get("similarities", []))
+
+    # ---- convenience wrappers over the OpenAI surface ----
+
+    def chat(self, model: str, messages: list, **kw) -> str:
+        r = self._post("/v1/chat/completions",
+                       {"model": model, "messages": messages, **kw})
+        return r["choices"][0]["message"]["content"]
+
+    def chat_stream(self, model: str, messages: list, **kw) -> Iterator[str]:
+        import json as _json
+
+        with self._http.stream("POST", "/v1/chat/completions", json={
+                "model": model, "messages": messages, "stream": True, **kw
+        }) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    return
+                delta = (_json.loads(data)["choices"] or [{}])[0].get(
+                    "delta", {})
+                if delta.get("content"):
+                    yield delta["content"]
+
+    def embeddings(self, model: str, inputs) -> list:
+        r = self._post("/v1/embeddings", {"model": model, "input": inputs})
+        return [d["embedding"] for d in r["data"]]
+
+    def models(self) -> list:
+        r = self._http.get("/v1/models")
+        r.raise_for_status()
+        return [m["id"] for m in r.json().get("data", [])]
+
+    def health(self) -> bool:
+        try:
+            return self._http.get("/readyz").status_code == 200
+        except httpx.HTTPError:
+            return False

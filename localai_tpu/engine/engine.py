@@ -252,9 +252,17 @@ class Engine:
         mesh=None,
         param_shardings=None,
         draft: Optional[tuple] = None,   # (LlamaConfig, params) draft model
+        bus=None,                        # parallel/lockstep.LeaderBus
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # multi-host lockstep mode: every device dispatch is mirrored to
+        # follower processes (see parallel/lockstep.py); features whose
+        # dispatches are not in the descriptor set are rejected/disabled
+        self._bus = bus
+        if bus is not None:
+            assert draft is None, "speculative draft unsupported in lockstep"
+            assert self.ecfg.ga_n <= 1, "self-extend unsupported in lockstep"
         self.tokenizer = tokenizer
         self.mesh = mesh
         S = self.ecfg.num_slots
@@ -309,6 +317,7 @@ class Engine:
         self._load_time = time.monotonic()
         self._total_tokens = 0
         self._reused_total = 0
+        self._rollbacks = 0     # grammar rollbacks (test observability)
 
         self._burst_fns: dict[int, Callable] = {}
         self._chunk_fns: dict[int, Callable] = {}
@@ -822,6 +831,8 @@ class Engine:
         if self._thread:
             self._thread.join(timeout=10)
         self._sync_q.put(None)
+        if self._bus is not None:
+            self._bus.close()
         if self._trace and self._tstats:
             import sys
 
@@ -849,6 +860,8 @@ class Engine:
                 s.req.out.put(None)
 
     def _reset_device_state(self):
+        if self._bus is not None:
+            self._bus.send("reset")
         S = self.ecfg.num_slots
         V = self.cfg.vocab_size
         self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
@@ -1009,8 +1022,15 @@ class Engine:
         s.generated.pop()
         s.n_decoded -= 1
         self._total_tokens -= 1
-        s.committed = min(s.committed, s.cache_len)
-        self.lengths[slot] = s.cache_len
+        self._rollbacks += 1
+        # quiescent invariant (r4, verified against a fresh-prefill KV
+        # oracle): lengths == cache_len - 1 — the pending token toks[-1]
+        # has row cache_len-1, to be (re)written by the next step. r3 set
+        # lengths = cache_len here, which re-wrote the pending token's KV
+        # one row too far and silently position-shifted every row after a
+        # rollback.
+        s.committed = min(s.committed, max(s.cache_len - 1, 0))
+        self.lengths[slot] = max(s.cache_len - 1, 0)
         toks = self._cache_tokens[slot]
         self.cur_tokens[slot] = toks[-1] if toks else 0
         self.ring, self.ring_pos = sampling.set_slot_ring(
@@ -1133,9 +1153,10 @@ class Engine:
             key = None
             # fork-dedup shares KV rows verbatim; under self-extend those
             # rows are position-compressed state the sibling's own ga
-            # bookkeeping would re-compress — mutually exclusive
+            # bookkeeping would re-compress, and in lockstep mode the fork
+            # op is not in the descriptor set — mutually exclusive
             if not req.grammar and req.mm_vectors is None \
-                    and self.ecfg.ga_n <= 1:
+                    and self.ecfg.ga_n <= 1 and self._bus is None:
                 # truncation depends on max_new_tokens; bucket it into the key
                 key = (tuple(req.prompt_ids),
                        min(req.max_new_tokens, self.ecfg.max_context // 4))
@@ -1176,6 +1197,12 @@ class Engine:
     def _start_request(self, req: GenRequest):
         """Admit a request: install sampling state and queue its prompt for
         chunked prefill. No model compute happens here."""
+        if self._bus is not None and (
+                req.grammar or req.params.logit_bias
+                or req.mm_vectors is not None or req.prompt_cache_path):
+            raise ValueError(
+                "grammar/logit_bias/multimodal/prompt-cache are not "
+                "supported in multi-host lockstep mode")
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
         # truncate the prompt head, keeping the tail (reference semantics:
@@ -1227,9 +1254,15 @@ class Engine:
         # mirostat v2 initializes mu at 2*tau (llama.cpp semantics)
         tau = req.params.mirostat_tau if req.params.mirostat_tau > 0 else 5.0
         self.mu[slot] = 2.0 * tau
+        fallback = hash(req.request_id) & 0x7FFFFFFF
         self.rng_keys = sampling.seed_slot_key(
-            self.rng_keys, slot, req.params, fallback_seed=hash(req.request_id) & 0x7FFFFFFF
+            self.rng_keys, slot, req.params, fallback_seed=fallback
         )
+        if self._bus is not None:
+            sv = req.params.seed
+            self._bus.send("seed", slot=slot,
+                           seed=int(sv) if sv is not None and sv >= 0
+                           else fallback)
         grammar = gstate = bias_base = penalty0 = None
         if req.grammar:
             grammar = self._grammar_for(req.grammar)
@@ -1578,10 +1611,10 @@ class Engine:
             s.ga_blocks = c + 1
             did = True
         if did:
-            # reset the slot's decode state to host truth (same recipe as
-            # grammar rollback — verified equivalent by the burst=1 vs
-            # burst=8 grammar determinism check)
-            self.lengths[slot] = s.cache_len
+            # reset the slot's decode state to host truth: the pending
+            # token toks[-1] occupies row cache_len-1 (same corrected
+            # recipe as grammar rollback; see the invariant note there)
+            self.lengths[slot] = max(s.cache_len - 1, 0)
             toks = self._cache_tokens[slot]
             self.cur_tokens[slot] = toks[-1] if toks else 0
             self.ring, self.ring_pos = sampling.set_slot_ring(
@@ -1651,6 +1684,10 @@ class Engine:
                                s.mm_vec[None])
             else:
                 fn = self._get_chunk_fn(bucket)
+                if self._bus is not None:
+                    self._bus.send("chunk", bucket=bucket, tokens=tokens,
+                                   seq_len=args[2], slot=args[5],
+                                   start=args[6])
             self.ck, self.cv = fn(*args)
             if self.dck is not None and s.spec_ok:
                 # mirror the prompt into the draft cache (speculative
@@ -1722,6 +1759,12 @@ class Engine:
                            s.mm_vec[None])
         else:
             fn = self._get_final_fn(bucket, B, continued)
+            if self._bus is not None:
+                self._bus.send("final", bucket=bucket, B=B,
+                               continued=continued, tokens=tokens,
+                               seq_len=seq_len, slots_v=slots_v,
+                               start_v=start_v, ring=args[7],
+                               ring_pos=args[8], spp=args[11], mu=args[12])
         out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
         if self.dck is not None and any(
                 self.slots[g].spec_ok for g, _ in group):
@@ -1812,13 +1855,21 @@ class Engine:
             chain = self._chain
             for i in self._override:
                 ov_mask[i] = True
+        cold = self._chain is None
         self._override.clear()
         fn = self._get_fused_fn(bucket, B)
+        spp = sampling.pack_slot_params(self.slot_params)
+        ovp = self._pack_ov(ov_mask)
+        if self._bus is not None:
+            self._bus.send("fused", bucket=bucket, B=B,
+                           chain=chain if cold else None,
+                           spp=spp, active=active, ovp=ovp,
+                           p_tokens=p_tokens, p_seq=p_seq, p_slots=p_slots,
+                           p_start=p_start)
         pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, chain[0], self.ck, self.cv, chain[1],
             chain[2], chain[3], self.bias, self.rng_keys,
-            sampling.pack_slot_params(self.slot_params),
-            active, chain[4], self._pack_ov(ov_mask),
+            spp, active, chain[4], ovp,
             p_tokens, p_seq, p_slots, p_start,
         )
         if self.dck is not None and any(s.spec_ok for _, s in group_snaps):
@@ -2128,16 +2179,22 @@ class Engine:
             chain = self._chain
             for i in self._override:
                 ov_mask[i] = True
+        cold = self._chain is None
         self._override.clear()
         # snapshot the PARTICIPATING SLOT OBJECTS: a slot index may be
         # released and re-admitted while this burst is in flight, and the
         # new occupant must never receive the stale burst's tokens
         burst_slots = [(i, self.slots[i]) for i in included]
+        spp = sampling.pack_slot_params(self.slot_params)
+        ovp = self._pack_ov(ov_mask)
+        if self._bus is not None:
+            self._bus.send("burst", k=n_steps, flags=flags,
+                           chain=chain if cold else None,
+                           spp=spp, active=active, ovp=ovp)
         pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
             self.params, chain[0], self.ck, self.cv, chain[1],
             chain[2], chain[3], self.bias, self.rng_keys,
-            sampling.pack_slot_params(self.slot_params),
-            active, chain[4], self._pack_ov(ov_mask),
+            spp, active, chain[4], ovp,
         )
         self._tmark("dispatch", t_d)
         if self._trace:

@@ -141,7 +141,19 @@ def load_llama_params(
             node[spec_path[-1]] = put(arr, spec_path)
             del arr
         return params
-    tensors = _open_shards(model_dir)
+    try:
+        tensors = _open_shards(model_dir)
+    except FileNotFoundError:
+        if os.environ.get("LOCALAI_ALLOW_RANDOM_WEIGHTS") == "1":
+            # BENCH/TEST ONLY: a config.json-only dir serves random weights
+            # through the same cast/quantize/shard path — lets the full
+            # serving stack run benchmark-shaped models (e.g. 8B int8 on
+            # one chip) without writing a multi-GB checkpoint to disk.
+            # Gated: silently serving garbage from an incomplete real
+            # checkpoint would be far worse than this convenience.
+            return _random_llama_params(
+                cfg, _make_put(cfg, mesh, dtype, quantize, adapter))
+        raise
 
     def get(name: str) -> np.ndarray:
         h = tensors[name]
@@ -176,6 +188,44 @@ def load_llama_params(
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = put(get("lm_head.weight").T, ("lm_head",))
+    return params
+
+
+def _random_llama_params(cfg, put) -> dict:
+    """Leaf-at-a-time random weights (see the gate in load_llama_params)."""
+    rng = np.random.default_rng(0)
+    hd = cfg.head_dim_
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    H, KV, V = cfg.num_heads, cfg.num_kv_heads, cfg.vocab_size
+
+    def mk(shape, fan_in):
+        a = rng.standard_normal(shape, dtype=np.float32)
+        a /= np.float32(np.sqrt(fan_in))
+        return a
+
+    leaves = [
+        (("embed",), lambda: mk((V, D), D)),
+        (("layers", "attn_norm"), lambda: np.ones((L, D), np.float32)),
+        (("layers", "wq"), lambda: mk((L, D, H * hd), D)),
+        (("layers", "wk"), lambda: mk((L, D, KV * hd), D)),
+        (("layers", "wv"), lambda: mk((L, D, KV * hd), D)),
+        (("layers", "wo"), lambda: mk((L, H * hd, D), H * hd)),
+        (("layers", "mlp_norm"), lambda: np.ones((L, D), np.float32)),
+        (("layers", "w_gate"), lambda: mk((L, D, F), D)),
+        (("layers", "w_up"), lambda: mk((L, D, F), D)),
+        (("layers", "w_down"), lambda: mk((L, F, D), F)),
+        (("final_norm",), lambda: np.ones((D,), np.float32)),
+    ]
+    if not cfg.tie_word_embeddings:
+        leaves.append((("lm_head",), lambda: mk((D, V), D)))
+    params: dict = {"layers": {}}
+    for spec_path, gen in leaves:
+        arr = gen()
+        node = params
+        for k in spec_path[:-1]:
+            node = node[k]
+        node[spec_path[-1]] = put(arr, spec_path)
+        del arr
     return params
 
 

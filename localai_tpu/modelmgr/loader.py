@@ -36,8 +36,11 @@ KNOWN_BACKENDS: dict = {
     "tpu-tts": "localai_tpu.backend.tts_runner",
     "local-store": "localai_tpu.backend.store_backend",
     "fake": "localai_tpu.backend.fake",
+    # remote HF Inference API passthrough (reference:
+    # backend/go/llm/langchain — lowest greedy priority)
+    "langchain-huggingface": "localai_tpu.backend.remote_runner",
 }
-GREEDY_ORDER = ["tpu-llm"]
+GREEDY_ORDER = ["tpu-llm", "langchain-huggingface"]
 
 
 class LoadedModel:

@@ -1,0 +1,252 @@
+"""EnCodec neural audio codec — the DECODE path (codes -> waveform).
+
+TPU-native port of the codec MusicGen/Bark-class models emit audio
+through (reference consumes it inside the transformers-musicgen backend:
+backend/python/transformers-musicgen/backend.py:1-176). Implements the
+HF `EncodecModel` layout: residual-vector-quantizer codebook lookup and
+the SEANet decoder (conv -> 2-layer residual LSTM -> per-ratio
+[ELU, transposed conv, resnet blocks] -> ELU -> conv), with
+weight-norm parametrizations folded into plain kernels at load time.
+
+Convolution padding mirrors EncodecConv1d/EncodecConvTranspose1d
+exactly: causal (left) or asymmetric padding for strided convs, and
+fixed-padding trim after transposed convs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodecConfig:
+    audio_channels: int = 1
+    hidden_size: int = 128
+    num_filters: int = 32
+    num_residual_layers: int = 1
+    upsampling_ratios: tuple = (8, 5, 4, 2)
+    kernel_size: int = 7
+    last_kernel_size: int = 7
+    residual_kernel_size: int = 3
+    dilation_growth_rate: int = 2
+    compress: int = 2
+    num_lstm_layers: int = 2
+    codebook_size: int = 1024
+    codebook_dim: int = 128
+    use_causal_conv: bool = True
+    pad_mode: str = "reflect"
+    trim_right_ratio: float = 1.0
+    use_conv_shortcut: bool = True
+    sampling_rate: int = 24000
+
+    @staticmethod
+    def from_hf_config(cfg: dict) -> "EncodecConfig":
+        return EncodecConfig(
+            audio_channels=cfg.get("audio_channels", 1),
+            hidden_size=cfg.get("hidden_size", 128),
+            num_filters=cfg.get("num_filters", 32),
+            num_residual_layers=cfg.get("num_residual_layers", 1),
+            upsampling_ratios=tuple(cfg.get("upsampling_ratios", (8, 5, 4, 2))),
+            kernel_size=cfg.get("kernel_size", 7),
+            last_kernel_size=cfg.get("last_kernel_size", 7),
+            residual_kernel_size=cfg.get("residual_kernel_size", 3),
+            dilation_growth_rate=cfg.get("dilation_growth_rate", 2),
+            compress=cfg.get("compress", 2),
+            num_lstm_layers=cfg.get("num_lstm_layers", 2),
+            codebook_size=cfg.get("codebook_size", 1024),
+            codebook_dim=cfg.get("codebook_dim",
+                                 cfg.get("hidden_size", 128)),
+            use_causal_conv=cfg.get("use_causal_conv", True),
+            pad_mode=cfg.get("pad_mode", "reflect"),
+            trim_right_ratio=cfg.get("trim_right_ratio", 1.0),
+            use_conv_shortcut=cfg.get("use_conv_shortcut", True),
+            sampling_rate=cfg.get("sampling_rate", 24000),
+        )
+
+
+def _pad1d(x, left: int, right: int, mode: str):
+    """x [B, C, T]; reflect with the small-input zero-extension trick
+    EncodecConv1d._pad1d uses."""
+    if mode != "reflect":
+        return jnp.pad(x, ((0, 0), (0, 0), (left, right)))
+    T = x.shape[-1]
+    max_pad = max(left, right)
+    extra = 0
+    if T <= max_pad:
+        extra = max_pad - T + 1
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, extra)))
+    out = jnp.pad(x, ((0, 0), (0, 0), (left, right)), mode="reflect")
+    if extra:
+        out = out[..., : out.shape[-1] - extra]
+    return out
+
+
+def conv1d(x, w, b, cfg: EncodecConfig, stride: int = 1, dilation: int = 1):
+    """EncodecConv1d: x [B, C, T], w [out, in, k] (weight-norm folded)."""
+    k = (w.shape[-1] - 1) * dilation + 1
+    pad_total = k - stride
+    T = x.shape[-1]
+    n_frames = (T - k + pad_total) / stride + 1
+    ideal = (math.ceil(n_frames) - 1) * stride + k - pad_total
+    extra = ideal - T
+    if cfg.use_causal_conv:
+        x = _pad1d(x, pad_total, extra, cfg.pad_mode)
+    else:
+        right = pad_total // 2
+        x = _pad1d(x, pad_total - right, right + extra, cfg.pad_mode)
+    out = jax.lax.conv_general_dilated(
+        x, w, (stride,), [(0, 0)], rhs_dilation=(dilation,),
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    return out + b[None, :, None]
+
+
+def conv_transpose1d(x, w, b, cfg: EncodecConfig, stride: int):
+    """EncodecConvTranspose1d: w [in, out, k] (torch layout, folded)."""
+    k = w.shape[-1]
+    # torch ConvTranspose1d weight is [in, out, k]; with
+    # transpose_kernel=True jax treats the kernel as the FORWARD conv's
+    # (spatially flipped, I/O swapped), so the torch layout maps to "OIH"
+    out = jax.lax.conv_transpose(
+        x, w, (stride,), "VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"), transpose_kernel=True)
+    out = out + b[None, :, None]
+    pad_total = k - stride
+    if cfg.use_causal_conv:
+        right = math.ceil(pad_total * cfg.trim_right_ratio)
+    else:
+        right = pad_total // 2
+    left = pad_total - right
+    end = out.shape[-1] - right
+    return out[..., left:end]
+
+
+def _lstm_layer(x, wi, wh, bi, bh):
+    """One LSTM layer over x [T, B, D] (torch gate order i, f, g, o)."""
+    D = wh.shape[-1]
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    B = x.shape[1]
+    h0 = jnp.zeros((B, D), x.dtype)
+    _, ys = jax.lax.scan(step, (h0, h0), x)
+    return ys
+
+
+def lstm_residual(x, layers):
+    """EncodecLSTM: x [B, C, T] -> lstm stack + residual."""
+    h = x.transpose(2, 0, 1)          # [T, B, C]
+    y = h
+    for wi, wh, bi, bh in layers:
+        y = _lstm_layer(y, wi, wh, bi, bh)
+    return (y + h).transpose(1, 2, 0)
+
+
+def rvq_decode(codes, codebooks):
+    """codes [nq, B, T] int32; codebooks [nq][codebook_size, dim] ->
+    [B, dim, T] (sum of per-quantizer codebook rows)."""
+    out = 0.0
+    for i, cb in enumerate(codebooks):
+        out = out + jnp.take(cb, codes[i], axis=0)   # [B, T, dim]
+    return out.transpose(0, 2, 1)
+
+
+def decode(params: dict, cfg: EncodecConfig, codes) -> jax.Array:
+    """codes [nq, B, T] -> waveform [B, audio_channels, samples]."""
+    x = rvq_decode(jnp.asarray(codes, jnp.int32), params["codebooks"])
+    x = x.astype(jnp.float32)
+    layers = params["decoder"]
+    x = conv1d(x, *layers["conv_in"], cfg)
+    x = lstm_residual(x, layers["lstm"])
+    for up in layers["ups"]:
+        x = jax.nn.elu(x)
+        x = conv_transpose1d(x, up["convt_w"], up["convt_b"], cfg,
+                             stride=up["stride"])
+        for rb in up["resblocks"]:
+            res = x
+            h = jax.nn.elu(x)
+            h = conv1d(h, rb["w1"], rb["b1"], cfg, dilation=rb["dilation"])
+            h = jax.nn.elu(h)
+            h = conv1d(h, rb["w2"], rb["b2"], cfg)
+            if "ws" in rb:
+                res = conv1d(res, rb["ws"], rb["bs"], cfg)
+            x = res + h
+    x = jax.nn.elu(x)
+    x = conv1d(x, *layers["conv_out"], cfg)
+    return x
+
+
+def load_hf_params(tensors: dict, cfg: EncodecConfig, prefix: str = "") -> dict:
+    """Build the decode-path pytree from a {name: np.ndarray} mapping
+    (e.g. a loaded safetensors file). ``prefix`` selects a sub-model
+    (\"audio_encoder.\" inside a MusicGen checkpoint)."""
+
+    def get(name):
+        return np.asarray(tensors[prefix + name])
+
+    def fold(name, transpose_dim0=False):
+        """Fold a weight-norm parametrized conv kernel: w = g * v/||v||.
+        torch weight_norm uses dim=0 for BOTH Conv1d and ConvTranspose1d
+        (g has shape [dim0, 1, 1]; norm over the remaining dims)."""
+        base = name + ".parametrizations.weight"
+        g = get(base + ".original0")
+        v = get(base + ".original1")
+        norm = np.sqrt((v ** 2).sum(axis=(1, 2), keepdims=True))
+        w = g * v / np.maximum(norm, 1e-12)
+        b = get(name + ".bias")
+        return jnp.asarray(w, jnp.float32), jnp.asarray(b, jnp.float32)
+
+    # layer indices in EncodecDecoder.layers (see module doc): 0 conv_in,
+    # 1 lstm, then per ratio [ELU, convT, resblock x n], finally ELU, conv
+    idx = 0
+    dec = {}
+    dec["conv_in"] = fold(f"decoder.layers.{idx}.conv")
+    idx += 1
+    lstm = []
+    for li in range(cfg.num_lstm_layers):
+        lstm.append(tuple(jnp.asarray(get(
+            f"decoder.layers.{idx}.lstm.{nm}_l{li}"), jnp.float32)
+            for nm in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")))
+    dec["lstm"] = lstm
+    idx += 1
+    ups = []
+    for ratio in cfg.upsampling_ratios:
+        idx += 1  # ELU
+        wt, bt = fold(f"decoder.layers.{idx}.conv", transpose_dim0=True)
+        up = {"convt_w": wt, "convt_b": bt, "stride": ratio, "resblocks": []}
+        idx += 1
+        for j in range(cfg.num_residual_layers):
+            rb = {}
+            base = f"decoder.layers.{idx}.block"
+            rb["w1"], rb["b1"] = fold(base + ".1.conv")
+            rb["w2"], rb["b2"] = fold(base + ".3.conv")
+            rb["dilation"] = cfg.dilation_growth_rate ** j
+            if cfg.use_conv_shortcut:
+                rb["ws"], rb["bs"] = fold(
+                    f"decoder.layers.{idx}.shortcut.conv")
+            up["resblocks"].append(rb)
+            idx += 1
+        ups.append(up)
+    dec["ups"] = ups
+    idx += 1  # ELU
+    dec["conv_out"] = fold(f"decoder.layers.{idx}.conv")
+
+    codebooks = []
+    i = 0
+    while prefix + f"quantizer.layers.{i}.codebook.embed" in tensors:
+        codebooks.append(jnp.asarray(
+            get(f"quantizer.layers.{i}.codebook.embed"), jnp.float32))
+        i += 1
+    return {"decoder": dec, "codebooks": codebooks}

@@ -1,0 +1,431 @@
+"""MusicGen text-to-music in JAX (real SoundGeneration, VERDICT r3 #6).
+
+Replaces the reference's transformers-musicgen backend
+(backend/python/transformers-musicgen/backend.py:1-176 — MusicGen via
+torch, duration + prompted generation) with a TPU-native port of the HF
+`MusicgenForConditionalGeneration` layout:
+
+  text prompt --T5 encoder--> states --MusicGen decoder (cross-attn,
+  num_codebooks delay pattern)--> EnCodec codes --models/encodec.py-->
+  waveform
+
+The decoder runs as a jitted cached step (cross K/V precomputed, self
+K/V cache carried), with classifier-free guidance as a batch-of-2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from localai_tpu.models import encodec as codec
+
+
+# ---------------------------------------------------------------- configs
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 768
+    d_kv: int = 64
+    d_ff: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"
+
+    @staticmethod
+    def from_hf_config(c: dict) -> "T5Config":
+        return T5Config(
+            vocab_size=c.get("vocab_size", 32128),
+            d_model=c.get("d_model", 768),
+            d_kv=c.get("d_kv", 64),
+            d_ff=c.get("d_ff", 3072),
+            num_layers=c.get("num_layers", 12),
+            num_heads=c.get("num_heads", 12),
+            relative_attention_num_buckets=c.get(
+                "relative_attention_num_buckets", 32),
+            relative_attention_max_distance=c.get(
+                "relative_attention_max_distance", 128),
+            layer_norm_epsilon=c.get("layer_norm_epsilon", 1e-6),
+            feed_forward_proj=c.get("feed_forward_proj", "relu"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MusicgenConfig:
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_dim: int = 4096
+    vocab_size: int = 2048          # EnCodec codebook size
+    num_codebooks: int = 4
+    max_position_embeddings: int = 2048
+    activation: str = "gelu"
+    audio_channels: int = 1
+    t5: T5Config = dataclasses.field(default_factory=T5Config)
+    enc: codec.EncodecConfig = dataclasses.field(
+        default_factory=codec.EncodecConfig)
+    frame_rate: int = 50
+
+    @property
+    def pad_token_id(self) -> int:   # the delay-pattern BOS/pad code
+        return self.vocab_size
+
+    @staticmethod
+    def from_hf_config(c: dict) -> "MusicgenConfig":
+        d = c.get("decoder", c)
+        ec = c.get("audio_encoder", {})
+        up = ec.get("upsampling_ratios", (8, 5, 4, 2))
+        sr = ec.get("sampling_rate", 32000)
+        return MusicgenConfig(
+            hidden_size=d.get("hidden_size", 1024),
+            num_layers=d.get("num_hidden_layers", 24),
+            num_heads=d.get("num_attention_heads", 16),
+            ffn_dim=d.get("ffn_dim", 4096),
+            vocab_size=d.get("vocab_size", 2048),
+            num_codebooks=d.get("num_codebooks", 4),
+            max_position_embeddings=d.get("max_position_embeddings", 2048),
+            activation=d.get("activation_function", "gelu"),
+            audio_channels=d.get("audio_channels", 1),
+            t5=T5Config.from_hf_config(c.get("text_encoder", {})),
+            enc=codec.EncodecConfig.from_hf_config(ec),
+            frame_rate=ec.get("frame_rate",
+                              int(round(sr / float(np.prod(up))))),
+        )
+
+    @staticmethod
+    def from_json(path: str) -> "MusicgenConfig":
+        with open(path) as f:
+            return MusicgenConfig.from_hf_config(json.load(f))
+
+
+# ---------------------------------------------------------------- T5 encoder
+
+def _t5_ln(x, w, eps):
+    """T5LayerNorm: rms-style, no mean subtraction, no bias."""
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def _rel_bucket(rel, num_buckets, max_distance):
+    """HF T5 _relative_position_bucket (bidirectional)."""
+    nb = num_buckets // 2
+    buckets = jnp.where(rel > 0, nb, 0)
+    n = jnp.abs(rel)
+    max_exact = nb // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-9)
+        / math.log(max_distance / max_exact) * (nb - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    return buckets + jnp.where(is_small, n, large)
+
+
+def t5_encode(params: dict, cfg: T5Config, tokens, mask) -> jax.Array:
+    """tokens [B, T] int32, mask [B, T] -> encoder states [B, T, D]."""
+    B, T = tokens.shape
+    H, dkv = cfg.num_heads, cfg.d_kv
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    pos = jnp.arange(T, dtype=jnp.int32)
+    rel = pos[None, :] - pos[:, None]            # memory - query
+    bucket = _rel_bucket(rel, cfg.relative_attention_num_buckets,
+                         cfg.relative_attention_max_distance)
+    # bias table only exists in block 0 and is shared by all blocks
+    bias = jnp.take(params["rel_bias"], bucket, axis=0)      # [T, T, H]
+    bias = bias.transpose(2, 0, 1)[None]                     # [1, H, T, T]
+    neg = (1.0 - mask[:, None, None, :].astype(jnp.float32)) * -1e9
+    bias = bias + neg
+
+    def layer(x, ly):
+        h = _t5_ln(x, ly["attn_norm"], cfg.layer_norm_epsilon)
+        q = (h @ ly["wq"]).reshape(B, T, H, dkv)
+        k = (h @ ly["wk"]).reshape(B, T, H, dkv)
+        v = (h @ ly["wv"]).reshape(B, T, H, dkv)
+        # T5 attention has NO 1/sqrt(d) scaling (folded into init)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) + bias
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        a = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, H * dkv)
+        x = x + a @ ly["wo"]
+        h = _t5_ln(x, ly["mlp_norm"], cfg.layer_norm_epsilon)
+        if "wi_1" in ly:   # gated act (flan-style)
+            h = jax.nn.gelu(h @ ly["wi_0"], approximate=False) * (h @ ly["wi_1"])
+        else:
+            h = jax.nn.relu(h @ ly["wi"])
+        x = x + h @ ly["wo_ff"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return _t5_ln(x, params["final_norm"], cfg.layer_norm_epsilon)
+
+
+# ------------------------------------------------------------- decoder LM
+
+def sinusoidal_positions(n: int, dim: int) -> np.ndarray:
+    """Musicgen sinusoids: [cos | sin] concatenation (tensor2tensor)."""
+    half = dim // 2
+    freq = np.exp(np.arange(half, dtype=np.float64)
+                  * -(math.log(10000.0) / (half - 1)))
+    ang = np.arange(n, dtype=np.float64)[:, None] * freq[None, :]
+    emb = np.concatenate([np.cos(ang), np.sin(ang)], axis=1)
+    if dim % 2 == 1:
+        emb = np.concatenate([emb, np.zeros((n, 1))], axis=1)
+    return emb.astype(np.float32)
+
+
+def _attn(q, k, v, H, mask=None):
+    """q [B,Tq,D], k/v [B,Tk,D] -> [B,Tq,D]; scaled dot-product."""
+    B, Tq, D = q.shape
+    hd = D // H
+    q = q.reshape(B, Tq, H, hd) * (hd ** -0.5)
+    k = k.reshape(B, -1, H, hd)
+    v = v.reshape(B, -1, H, hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if mask is not None:
+        s = s + mask
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, Tq, D)
+
+
+def _ln(x, w, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def cross_kv(params: dict, cfg: MusicgenConfig, enc_states):
+    """Precompute per-layer cross-attention K/V: ([L,B,Tk,D], [L,B,Tk,D])."""
+    ls = params["layers"]
+    return jax.lax.map(
+        lambda wkv: (enc_states @ wkv[0], enc_states @ wkv[1]),
+        (ls["xwk"], ls["xwv"]))
+
+
+def decode_step(params: dict, cfg: MusicgenConfig, codes, pos, xk, xv,
+                enc_mask, cache_k, cache_v):
+    """One decoder step.
+
+    codes [B, nq] int32 (previous frame's token per codebook, delay
+    pattern already applied; pad_token_id = BOS row of the embeddings);
+    pos [] int32; xk/xv [L, B, Tk, D]; enc_mask [B, Tk];
+    cache_k/v [L, B, Tmax, D]. Returns (logits [B, nq, V], ck, cv).
+    """
+    B = codes.shape[0]
+    D = cfg.hidden_size
+    H = cfg.num_heads
+    # sum of per-codebook embeddings (each table has vocab+1 rows; row
+    # vocab == the delay-pattern pad/BOS token)
+    x = 0.0
+    emb = params["embed"]                      # [nq, V+1, D]
+    for k in range(cfg.num_codebooks):
+        x = x + jnp.take(emb[k], codes[:, k], axis=0)
+    x = x[:, None, :] + params["pos_table"][pos][None, None, :]
+
+    Tmax = cache_k.shape[2]
+    neg_enc = (1.0 - enc_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+
+    def layer_fn(x, inp):
+        ly, ck_l, cv_l, li = inp
+        h = _ln(x, ly["norm1_w"], ly["norm1_b"])
+        q = h @ ly["wq"]
+        k = h @ ly["wk"]
+        v = h @ ly["wv"]
+        ck_l = jax.lax.dynamic_update_slice(ck_l, k, (0, pos, 0))
+        cv_l = jax.lax.dynamic_update_slice(cv_l, v, (0, pos, 0))
+        valid = (jnp.arange(Tmax) <= pos)[None, None, None, :]
+        mask = jnp.where(valid, 0.0, -1e9)
+        a = _attn(q, ck_l, cv_l, H, mask)
+        x = x + a @ ly["wo"]
+        h = _ln(x, ly["norm2_w"], ly["norm2_b"])
+        a = _attn(h @ ly["xwq"], xk[li], xv[li], H, neg_enc)
+        x = x + a @ ly["xwo"]
+        h = _ln(x, ly["norm3_w"], ly["norm3_b"])
+        h = jax.nn.gelu(h @ ly["fc1"], approximate=False)
+        x = x + h @ ly["fc2"]
+        return x, (ck_l, cv_l)
+
+    layers = dict(params["layers"])
+    li = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer_fn, x, (layers, cache_k, cache_v, li))
+    x = _ln(x, params["final_norm_w"], params["final_norm_b"])
+    # lm_heads [nq, V, D]; x [B, 1, D]
+    logits = jnp.einsum("bd,nvd->bnv", x[:, 0, :], params["lm_heads"])
+    return logits, cache_k, cache_v
+
+
+# ---------------------------------------------------------------- loading
+
+def load_hf_params(model_dir: str, cfg: MusicgenConfig) -> dict:
+    from localai_tpu.engine.weights import _open_shards
+
+    shards = _open_shards(model_dir)
+    tensors = {n: np.asarray(h.get_tensor(n)) for n, h in shards.items()}
+    return params_from_tensors(tensors, cfg)
+
+
+def params_from_tensors(tensors: dict, cfg: MusicgenConfig) -> dict:
+    f32 = lambda a: jnp.asarray(a, jnp.float32)  # noqa: E731
+
+    def get(name):
+        return tensors[name]
+
+    # ---- T5 text encoder ----
+    t5 = cfg.t5
+    tl = {"attn_norm": [], "wq": [], "wk": [], "wv": [], "wo": [],
+          "mlp_norm": [], "wo_ff": []}
+    gated = "gated" in t5.feed_forward_proj
+    if gated:
+        tl["wi_0"], tl["wi_1"] = [], []
+    else:
+        tl["wi"] = []
+    for i in range(t5.num_layers):
+        b = f"text_encoder.encoder.block.{i}.layer"
+        tl["attn_norm"].append(get(f"{b}.0.layer_norm.weight"))
+        tl["wq"].append(get(f"{b}.0.SelfAttention.q.weight").T)
+        tl["wk"].append(get(f"{b}.0.SelfAttention.k.weight").T)
+        tl["wv"].append(get(f"{b}.0.SelfAttention.v.weight").T)
+        tl["wo"].append(get(f"{b}.0.SelfAttention.o.weight").T)
+        tl["mlp_norm"].append(get(f"{b}.1.layer_norm.weight"))
+        if gated:
+            tl["wi_0"].append(get(f"{b}.1.DenseReluDense.wi_0.weight").T)
+            tl["wi_1"].append(get(f"{b}.1.DenseReluDense.wi_1.weight").T)
+        else:
+            tl["wi"].append(get(f"{b}.1.DenseReluDense.wi.weight").T)
+        tl["wo_ff"].append(get(f"{b}.1.DenseReluDense.wo.weight").T)
+    t5_params = {
+        "embed": f32(get("text_encoder.shared.weight")),
+        "rel_bias": f32(get(
+            "text_encoder.encoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight")),
+        "final_norm": f32(get("text_encoder.encoder.final_layer_norm.weight")),
+        "layers": {k: f32(np.stack(v)) for k, v in tl.items()},
+    }
+
+    # ---- MusicGen decoder ----
+    nq, V, D = cfg.num_codebooks, cfg.vocab_size, cfg.hidden_size
+    embed = np.stack([get(f"decoder.model.decoder.embed_tokens.{k}.weight")
+                      for k in range(nq)])
+    heads = np.stack([get(f"decoder.lm_heads.{k}.weight")
+                      for k in range(nq)])                  # [nq, V, D]
+    dl = {}
+    names = {
+        "norm1_w": "self_attn_layer_norm.weight",
+        "norm1_b": "self_attn_layer_norm.bias",
+        "norm2_w": "encoder_attn_layer_norm.weight",
+        "norm2_b": "encoder_attn_layer_norm.bias",
+        "norm3_w": "final_layer_norm.weight",
+        "norm3_b": "final_layer_norm.bias",
+    }
+    mats = {
+        "wq": "self_attn.q_proj.weight", "wk": "self_attn.k_proj.weight",
+        "wv": "self_attn.v_proj.weight", "wo": "self_attn.out_proj.weight",
+        "xwq": "encoder_attn.q_proj.weight",
+        "xwk": "encoder_attn.k_proj.weight",
+        "xwv": "encoder_attn.v_proj.weight",
+        "xwo": "encoder_attn.out_proj.weight",
+        "fc1": "fc1.weight", "fc2": "fc2.weight",
+    }
+    for out, nm in names.items():
+        dl[out] = f32(np.stack(
+            [get(f"decoder.model.decoder.layers.{i}.{nm}")
+             for i in range(cfg.num_layers)]))
+    for out, nm in mats.items():
+        dl[out] = f32(np.stack(
+            [get(f"decoder.model.decoder.layers.{i}.{nm}").T
+             for i in range(cfg.num_layers)]))
+    dec_params = {
+        "embed": f32(embed),
+        "lm_heads": f32(heads),
+        "pos_table": f32(sinusoidal_positions(
+            cfg.max_position_embeddings, D)),
+        "final_norm_w": f32(get("decoder.model.decoder.layer_norm.weight")),
+        "final_norm_b": f32(get("decoder.model.decoder.layer_norm.bias")),
+        "layers": dl,
+    }
+
+    enc_params = codec.load_hf_params(tensors, cfg.enc,
+                                      prefix="audio_encoder.")
+    return {"t5": t5_params, "decoder": dec_params, "encodec": enc_params}
+
+
+# -------------------------------------------------------------- generation
+
+def generate(params: dict, cfg: MusicgenConfig, text_tokens, text_mask,
+             frames: int, temperature: float = 1.0, top_k: int = 250,
+             guidance_scale: float = 3.0, seed: int = 0):
+    """Text-conditioned generation -> waveform [samples] float32.
+
+    Mirrors the reference backend's semantics (duration -> frames at the
+    codec frame rate; sampled with top-k, classifier-free guidance).
+    """
+    nq = cfg.num_codebooks
+    B = 1
+    enc = t5_encode(params["t5"], cfg.t5, text_tokens, text_mask)
+    if guidance_scale and guidance_scale != 1.0:
+        # CFG: row 0 conditioned, row 1 "unconditioned" (text fully
+        # masked — HF zeroes the attention mask for the null branch)
+        enc = jnp.concatenate([enc, enc], axis=0)
+        mask2 = jnp.concatenate([text_mask,
+                                 jnp.zeros_like(text_mask)], axis=0)
+        B = 2
+    else:
+        mask2 = text_mask
+    xk, xv = cross_kv(params["decoder"], cfg, enc)
+
+    L, D = cfg.num_layers, cfg.hidden_size
+    total = frames + nq            # BOS column + delayed tail
+    ck = jnp.zeros((L, B, total, D), jnp.float32)
+    cv = jnp.zeros((L, B, total, D), jnp.float32)
+
+    step_fn = jax.jit(
+        lambda codes, pos, ck, cv: decode_step(
+            params["decoder"], cfg, codes, pos, xk, xv, mask2, ck, cv))
+
+    pad = cfg.pad_token_id
+    seq = np.full((nq, total), pad, np.int32)
+    key = jax.random.PRNGKey(seed)
+    cur = np.full((B, nq), pad, np.int32)
+    for t in range(total - 1):
+        logits, ck, cv = step_fn(jnp.asarray(cur), jnp.int32(t), ck, cv)
+        lg = np.asarray(logits, np.float32)      # [B, nq, V]
+        if B == 2:
+            lg = lg[1] + guidance_scale * (lg[0] - lg[1])  # [nq, V]
+        else:
+            lg = lg[0]
+        key, sub = jax.random.split(key)
+        nxt = _sample_row(lg, temperature, top_k, sub)
+        # delay pattern: codebook k only emits real tokens for
+        # t+1 in [k+1, k+1+frames); otherwise the pad/BOS token
+        for k in range(nq):
+            tt = t + 1
+            if k + 1 <= tt < k + 1 + frames:
+                seq[k, tt] = nxt[k]
+            else:
+                seq[k, tt] = pad
+        cur = np.broadcast_to(seq[:, t + 1], (B, nq)).copy()
+    # revert the delay: codes[k, f] = seq[k, f + k + 1]
+    codes = np.stack([seq[k, k + 1:k + 1 + frames] for k in range(nq)])
+    wav = codec.decode(params["encodec"], cfg.enc, codes[:, None, :])
+    return np.asarray(wav[0, 0], np.float32)
+
+
+def _sample_row(logits, temperature, top_k, key):
+    """logits [nq, V] -> [nq] sampled ids (top-k + temperature)."""
+    if temperature <= 0:
+        return np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+    lg = logits / max(temperature, 1e-6)
+    if top_k and top_k < lg.shape[-1]:
+        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+        lg = jnp.where(lg < kth, -1e9, lg)
+    return np.asarray(jax.random.categorical(key, lg, axis=-1), np.int32)

@@ -1,0 +1,207 @@
+"""Multi-process (multi-host) serving: leader/follower lockstep dispatch.
+
+In JAX's multi-controller SPMD model every process must issue IDENTICAL
+programs in IDENTICAL order or cross-process collectives deadlock. The
+serving engine is host-driven and timing-dependent, so multi-host serving
+needs an explicit dispatch plan: process 0 (the LEADER — it owns HTTP and
+the engine loop) serializes every device dispatch as a small descriptor
+(opcode + the host-side arrays that parameterize it) over TCP; follower
+processes replay the descriptors 1:1 against their own shards. Device
+state (params, KV cache, RNG keys, the burst chain) then evolves
+identically everywhere because it is the same program.
+
+This replaces the reference's distributed "worker mode" — llama.cpp RPC
+servers receiving individual tensor ops over TCP
+(reference: core/cli/worker/worker_p2p.go:31-109, grpc-server.cpp:2264)
+— with XLA collectives over ICI/DCN: the bus carries only tiny dispatch
+descriptors (~KBs per burst), never tensors; all tensor traffic rides the
+mesh inside jit.
+
+Feature restrictions in lockstep mode (enforced at admission): no
+grammar/logit-bias (device bias writes), no multimodal, no speculative
+draft, no prompt-cache persistence, no self-extend, no fork-dedup. Each
+is per-slot host logic that would need its own descriptor; the core
+serving path (chat/completions with the full sampler) is covered.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import queue
+from typing import Optional
+
+import numpy as np
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        part = sock.recv(4 - len(hdr))
+        if not part:
+            raise ConnectionError("bus closed")
+        hdr += part
+    (n,) = struct.unpack("!I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(min(1 << 20, n - len(buf)))
+        if not part:
+            raise ConnectionError("bus closed mid-message")
+        buf += part
+    return pickle.loads(bytes(buf))
+
+
+class LeaderBus:
+    """Leader side: accepts follower connections ASYNCHRONOUSLY (leader
+    and followers must construct their engines concurrently — building a
+    multi-process-sharded array runs internal collectives, so a blocking
+    accept here would deadlock against the follower's Engine.__init__)
+    and streams descriptors in dispatch order; a sender thread keeps
+    serialization off the engine loop, and the queue preserves order."""
+
+    def __init__(self, bind: str, n_followers: int):
+        host, port = bind.rsplit(":", 1)
+        self._srv = socket.create_server((host, int(port)))
+        self._n = n_followers
+        self._socks = []
+        self._ready = threading.Event()
+        self._q: "queue.Queue" = queue.Queue()
+        threading.Thread(target=self._accept, daemon=True,
+                         name="lockstep-accept").start()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="lockstep-send")
+        self._thread.start()
+
+    def _accept(self):
+        for _ in range(self._n):
+            conn, _ = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(conn)
+        self._ready.set()
+
+    def _pump(self):
+        self._ready.wait()
+        while True:
+            msg = self._q.get()
+            for s in self._socks:
+                try:
+                    _send_msg(s, msg)
+                except OSError:
+                    pass
+            if msg and msg.get("op") == "shutdown":
+                return
+
+    def send(self, op: str, **payload):
+        payload["op"] = op
+        self._q.put(payload)
+
+    def close(self):
+        self.send("shutdown")
+        self._thread.join(timeout=10)
+        for s in self._socks:
+            s.close()
+        self._srv.close()
+
+
+class FollowerBus:
+    def __init__(self, addr: str, retries: int = 120, delay: float = 0.5):
+        import time
+
+        host, port = addr.rsplit(":", 1)
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection((host, int(port)))
+                break
+            except OSError as e:
+                last = e
+                time.sleep(delay)
+        else:
+            raise ConnectionError(f"cannot reach leader bus {addr}: {last}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def recv(self):
+        return _recv_msg(self._sock)
+
+    def close(self):
+        self._sock.close()
+
+
+def follow(engine, bus: "FollowerBus") -> None:
+    """Replay the leader's dispatch stream on a follower process.
+
+    ``engine`` is a NEVER-STARTED Engine built with the same config,
+    params (same checkpoint, same mesh) and EngineConfig as the leader's.
+    Blocks until the leader shuts down."""
+    from localai_tpu.engine import sampling
+
+    e = engine
+    e.precompile()   # the leader precompiles before serving; same order
+    while True:
+        m = bus.recv()
+        op = m["op"]
+        if op == "shutdown":
+            return
+        if op == "seed":
+            e.rng_keys = sampling.seed_slot_key(
+                e.rng_keys, m["slot"],
+                sampling.SamplingParamsHost(seed=int(m["seed"])),
+                fallback_seed=int(m["seed"]))
+        elif op == "burst":
+            fn = e._get_burst_fn(m["k"], tuple(m["flags"]))
+            chain = tuple(m["chain"]) if m["chain"] is not None else e._chain
+            _, e.ck, e.cv, e.rng_keys, e._chain = fn(
+                e.params, chain[0], e.ck, e.cv, chain[1], chain[2], chain[3],
+                e.bias, e.rng_keys, m["spp"], m["active"], chain[4], m["ovp"])
+        elif op == "fused":
+            fn = e._get_fused_fn(m["bucket"], m["B"])
+            chain = tuple(m["chain"]) if m["chain"] is not None else e._chain
+            _, e.ck, e.cv, e.rng_keys, e._chain = fn(
+                e.params, chain[0], e.ck, e.cv, chain[1], chain[2], chain[3],
+                e.bias, e.rng_keys, m["spp"], m["active"], chain[4], m["ovp"],
+                m["p_tokens"], m["p_seq"], m["p_slots"], m["p_start"])
+        elif op == "final":
+            fn = e._get_final_fn(m["bucket"], m["B"], m["continued"])
+            _, _, e.ck, e.cv, e.rng_keys, _ = fn(
+                e.params, m["tokens"], m["seq_len"], e.ck, e.cv,
+                m["slots_v"], m["start_v"], m["ring"], m["ring_pos"],
+                e.bias, e.rng_keys, m["spp"], m["mu"])
+        elif op == "chunk":
+            fn = e._get_chunk_fn(m["bucket"])
+            e.ck, e.cv = fn(e.params, m["tokens"], m["seq_len"], e.ck, e.cv,
+                            m["slot"], m["start"])
+        elif op == "reset":
+            e._reset_device_state()
+        else:
+            raise ValueError(f"unknown lockstep op {op!r}")
+
+
+class PrebuiltEngineServicer:
+    """An EngineServicer over an engine that already exists in-process
+    (the leader's distributed engine) — registered as an EMBEDDED backend
+    so the real HTTP app serves it (the reference's in-process backend
+    seam: pkg/grpc/embed.go Provide, used by local-store)."""
+
+    def __new__(cls, engine, tokenizer, model_cfg):
+        from localai_tpu.backend import contract_pb2 as pb
+        from localai_tpu.backend.runner import EngineServicer
+
+        class _Impl(EngineServicer):
+            def __init__(self):
+                super().__init__()
+                self.engine = engine
+                self.tokenizer = tokenizer
+                self.model_cfg = model_cfg
+                self._state = pb.StatusResponse.READY
+
+            def LoadModel(self, request, context):
+                return pb.Result(success=True, message="prebuilt (lockstep)")
+
+        return _Impl()

@@ -1,166 +1,168 @@
-"""Component microbenchmark for the serving decode step.
+"""Unified decode-path profiler for the serving chip.
 
-Times isolated pieces of the burst-decode path on the real chip to locate
-where the per-step milliseconds go (vs the ~3-5 ms HBM roofline for the
-1B bench config). Run: python scripts/profile_decode.py
+One parameterized tool replacing the r3 probe accretion
+(profile_decode2..9.py — their one-shot experiments and conclusions are
+recorded in ROUND3_NOTES.md/ROUND4_NOTES.md; the losing designs were
+dropped, the winning ones live in the product as selectable paths).
+
+Modes:
+  components   time isolated pieces of one decode step (full step, qkv,
+               mlp, sampler) to locate where per-step milliseconds go
+  burst        burst-size scaling + dispatch overlap: serialized sync
+               per burst vs depth-2 pipelined vs no-sync ceiling
+  attn         decode-attention path comparison (einsum default vs
+               append vs pallas — the LOCALAI_DECODE_ATTN choices)
+
+Usage: python scripts/profile_decode.py [components|burst|attn]
+       [--preset 1b] [--slots 32] [--ctx 1024] [--burst 16] [--reps 8]
 """
 
+import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bench import PRESETS
 from localai_tpu.engine import sampling
 from localai_tpu.models import llama
 from localai_tpu.utils.jaxtools import enable_compilation_cache
 
 enable_compilation_cache()
 
-S, C, INNER = 32, 1024, 16
-cfg = llama.LlamaConfig(
-    vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-    num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64,
-    max_position_embeddings=2048)
 
-params = llama.init_params(cfg, jax.random.PRNGKey(0))
-ck, cv = llama.init_cache(cfg, S, C)
-slot_params = sampling.make_slot_params(S)
-ring, rpos = sampling.make_ring(S)
-bias = jnp.zeros((S, cfg.vocab_size), jnp.float32)
-keys = jax.vmap(jax.random.key_data)(
-    jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32)))
-active = jnp.ones((S,), jnp.bool_)
-mu = jnp.zeros((S,), jnp.float32)
-
-tokens0 = jnp.zeros((S,), jnp.int32)
-lengths0 = jnp.full((S,), C // 2, jnp.int32)
+def build(args):
+    cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                            **PRESETS[args.preset])
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    if args.quant == "int8":
+        params = llama.quantize_params(params)
+    ck, cv = llama.init_cache(cfg, args.slots, args.ctx)
+    return cfg, params, ck, cv
 
 
-def timeit(name, fn, *args, n=5):
-    out = fn(*args)
-    jax.block_until_ready(out)
+def timed(fn, *a, reps=8, sync=lambda out: np.asarray(out[0])):
+    out = fn(*a)
+    sync(out)
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n
-    print(f"{name:40s} {dt*1e3/INNER:8.2f} ms/step  ({dt*1e3:8.1f} ms/burst)")
-    return dt
+    for _ in range(reps):
+        out = fn(*a)
+    sync(out)
+    return (time.perf_counter() - t0) / reps * 1e3, out
 
 
-# 1. full burst: model + sampler (what bench --kernel measures)
-@jax.jit
-def full_burst(params, ck, cv, ring, rpos, keys):
-    def body(carry, _):
-        tokens, lengths, ck, cv, ring, rpos, keys = carry
-        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
-        ids, _, keys, _ = sampling.sample(logits, slot_params, ring, rpos, bias, keys)
-        ring, rpos = sampling.update_ring(ring, rpos, ids, active)
-        return (ids, lengths + 1, ck, cv, ring, rpos, keys), ids
-    carry, ids = jax.lax.scan(body, (tokens0, lengths0, ck, cv, ring, rpos, keys),
-                              None, length=INNER)
-    return ids
+def mode_components(args):
+    cfg, params, ck, cv = build(args)
+    S, C = args.slots, args.ctx
+    tokens = jnp.zeros((S,), jnp.int32)
+    lengths = jnp.full((S,), C // 2, jnp.int32)
+
+    full = jax.jit(lambda t, l, ck, cv: llama.decode_step(
+        params, cfg, t, l, ck, cv))
+    ms, _ = timed(full, tokens, lengths, ck, cv, reps=args.reps)
+    print(f"full step        {ms:7.2f} ms")
+
+    one = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, 1, cfg.hidden_size),
+                          cfg.dtype)
+    qkv = jax.jit(lambda x: llama._project_qkv(x, dict(one), cfg))
+    ms, _ = timed(qkv, x, reps=args.reps, sync=lambda o: np.asarray(o[0]))
+    print(f"qkv (1 layer)    {ms:7.2f} ms  (x{cfg.num_layers} layers)")
+
+    mlp = jax.jit(lambda x: llama._mlp(x, dict(one)))
+    ms, _ = timed(mlp, x, reps=args.reps, sync=np.asarray)
+    print(f"mlp (1 layer)    {ms:7.2f} ms  (x{cfg.num_layers})")
+
+    logits = jax.random.normal(jax.random.PRNGKey(2), (S, cfg.vocab_size),
+                               jnp.float32)
+    sp = sampling.make_slot_params(S)
+    ring, rpos = sampling.make_ring(S)
+    bias = jnp.zeros((S, cfg.vocab_size), jnp.float32)
+    keys = jax.vmap(jax.random.key_data)(
+        jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32)))
+    samp = jax.jit(lambda lg: sampling.sample(lg, sp, ring, rpos, bias, keys))
+    ms, _ = timed(samp, logits, reps=args.reps, sync=lambda o: np.asarray(o[0]))
+    print(f"sampler          {ms:7.2f} ms")
 
 
-# 2. model only, greedy argmax (no sampler suite)
-@jax.jit
-def model_greedy(params, ck, cv):
-    def body(carry, _):
-        tokens, lengths, ck, cv = carry
-        logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths, ck, cv)
-        ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (ids, lengths + 1, ck, cv), ids
-    carry, ids = jax.lax.scan(body, (tokens0, lengths0, ck, cv), None, length=INNER)
-    return ids
+def mode_burst(args):
+    cfg, params, ck, cv = build(args)
+    S, C, K = args.slots, args.ctx, args.burst
+
+    @jax.jit
+    def burst(tokens, lengths, ck, cv):
+        def body(carry, _):
+            tokens, lengths, ck, cv = carry
+            logits, ck, cv = llama.decode_step(params, cfg, tokens, lengths,
+                                               ck, cv)
+            ids = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (ids, lengths + 1, ck, cv), ids
+
+        carry, ids = jax.lax.scan(body, (tokens, lengths, ck, cv), None,
+                                  length=K)
+        return carry, ids
+
+    tokens = jnp.zeros((S,), jnp.int32)
+    lengths = jnp.full((S,), C // 2, jnp.int32)
+    state = (tokens, lengths, ck, cv)
+    state, ids = burst(*state)
+    np.asarray(ids)
+
+    N = args.reps
+    for mode in ("serial", "pipe2", "nosync"):
+        state = (state[0], jnp.full((S,), C // 2, jnp.int32),
+                 state[2], state[3])
+        t0 = time.perf_counter()
+        prev = None
+        for _ in range(N):
+            state, ids = burst(*state)
+            if mode == "serial":
+                np.asarray(ids)
+            elif mode == "pipe2":
+                if prev is not None:
+                    np.asarray(prev)
+                prev = ids
+        np.asarray(ids)
+        dt = time.perf_counter() - t0
+        print(f"{mode:7s} {dt * 1e3 / N:7.1f} ms/burst  "
+              f"({S * K * N / dt:6.0f} tok/s)")
 
 
-# 3. model without the lm_head (isolate unembed cost)
-@jax.jit
-def model_no_unembed(params, ck, cv):
-    def body(carry, _):
-        tokens, lengths, ck, cv = carry
-        # decode_step minus unembed: reuse internals via a local copy
-        S_ = tokens.shape[0]
-        positions = lengths[:, None]
-        from localai_tpu.ops.rope import rope_frequencies
-        from localai_tpu.ops.norms import rms_norm
-        sin, cos = rope_frequencies(cfg, positions)
-        x = llama._embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]
-
-        def layer_fn(carry2, layer):
-            x, ck, cv = carry2
-            li = layer.pop("_idx")
-            h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = llama._project_qkv(h, layer, cfg)
-            from localai_tpu.ops.rope import apply_rope
-            q = apply_rope(q, sin, cos)
-            k = apply_rope(k, sin, cos)
-            slot_idx = jnp.arange(S_, dtype=jnp.int32)
-            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
-            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
-            ck = ck.at[li].set(lk)
-            cv = cv.at[li].set(lv)
-            from localai_tpu.ops.attention import decode_attention
-            attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)
-            x = x + jnp.einsum("sh,hd->sd", attn.reshape(S_, -1),
-                               llama._mat(layer["wo"], x.dtype))[:, None, :]
-            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-            x = x + llama._mlp(h, layer)
-            return (x, ck, cv), None
-
-        layers = dict(params["layers"])
-        layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (x, ck, cv), _ = jax.lax.scan(layer_fn, (x, ck, cv), layers)
-        ids = jnp.sum(x[:, 0, :], axis=-1).astype(jnp.int32) % cfg.vocab_size
-        return (ids, lengths + 1, ck, cv), ids
-    carry, ids = jax.lax.scan(body, (tokens0, lengths0, ck, cv), None, length=INNER)
-    return ids
+def mode_attn(args):
+    S, C = args.slots, args.ctx
+    for path in ("einsum", "append", "pallas"):
+        os.environ["LOCALAI_DECODE_ATTN"] = "" if path == "einsum" else path
+        cfg, params, ck, cv = build(args)
+        tokens = jnp.zeros((S,), jnp.int32)
+        lengths = jnp.full((S,), C // 2, jnp.int32)
+        try:
+            fn = jax.jit(lambda t, l, ck, cv: llama.decode_step(
+                params, cfg, t, l, ck, cv))
+            ms, _ = timed(fn, tokens, lengths, ck, cv, reps=args.reps)
+            print(f"{path:7s} {ms:7.2f} ms/step")
+        except Exception as e:
+            print(f"{path:7s} unavailable: {type(e).__name__}: {e}")
 
 
-# 4. sampler only (fixed logits)
-logits_fixed = jnp.zeros((S, cfg.vocab_size), jnp.float32)
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=("components", "burst", "attn"),
+                    nargs="?", default="components")
+    ap.add_argument("--preset", default="1b")
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--ctx", type=int, default=1024)
+    ap.add_argument("--burst", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=8)
+    ap.add_argument("--quant", default="")
+    args = ap.parse_args()
+    {"components": mode_components, "burst": mode_burst,
+     "attn": mode_attn}[args.mode](args)
 
 
-@jax.jit
-def sampler_only(ring, rpos, keys):
-    def body(carry, _):
-        ring, rpos, keys = carry
-        ids, _, keys, _ = sampling.sample(logits_fixed, slot_params, ring, rpos, bias, keys)
-        ring, rpos = sampling.update_ring(ring, rpos, ids, active)
-        return (ring, rpos, keys), ids
-    carry, ids = jax.lax.scan(body, (ring, rpos, keys), None, length=INNER)
-    return ids
-
-
-# 5. HBM read roofline: reduce every weight leaf once per "step"
-@jax.jit
-def read_weights(params):
-    def body(carry, _):
-        tot = sum(jnp.sum(l.astype(jnp.float32))
-                  for l in jax.tree.leaves(params))
-        return carry + tot, None
-    out, _ = jax.lax.scan(body, jnp.float32(0), None, length=INNER)
-    return out
-
-
-# 6. KV cache touch roofline: reduce cache once per step
-@jax.jit
-def read_cache(ck, cv):
-    def body(carry, _):
-        return carry + jnp.sum(ck.astype(jnp.float32)) + jnp.sum(cv.astype(jnp.float32)), None
-    out, _ = jax.lax.scan(body, jnp.float32(0), None, length=INNER)
-    return out
-
-
-nbytes_w = sum(l.nbytes for l in jax.tree.leaves(params))
-nbytes_c = ck.nbytes + cv.nbytes
-print(f"weights: {nbytes_w/1e9:.2f} GB   cache: {nbytes_c/1e9:.2f} GB   "
-      f"(roofline @819GB/s: {nbytes_w/819e9*1e3:.2f} + {nbytes_c/819e9*1e3:.2f} ms/step)")
-
-timeit("full burst (model+sampler)", full_burst, params, ck, cv, ring, rpos, keys)
-timeit("model only (greedy)", model_greedy, params, ck, cv)
-timeit("model no unembed", model_no_unembed, params, ck, cv)
-timeit("sampler only", sampler_only, ring, rpos, keys)
-timeit("weights read roofline", read_weights, params)
-timeit("kv cache read roofline", read_cache, ck, cv)
+if __name__ == "__main__":
+    main()

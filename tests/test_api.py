@@ -189,6 +189,31 @@ def test_metrics_endpoint(server):
     assert "localai_api_call" in r.text
 
 
+def test_client_sdk(server):
+    """The Python client SDK (reference parity: core/clients/store.go)."""
+    from localai_tpu.client import Client
+
+    with Client(server.base) as c:
+        assert c.health()
+        assert "tiny" in c.models()
+        c.stores_set(keys=[[1.0, 0.0], [0.0, 1.0]], values=["a", "b"])
+        keys, values, sims = c.stores_find(key=[0.95, 0.05], topk=1)
+        assert values == ["a"] and len(sims) == 1
+        got_k, got_v = c.stores_get(keys=[[0.0, 1.0]])
+        assert got_v == ["b"]
+        c.stores_delete(keys=[[0.0, 1.0]])
+        _, got_v = c.stores_get(keys=[[0.0, 1.0]])
+        assert got_v == []
+        out = c.chat("tiny", [{"role": "user", "content": "hello"}],
+                     max_tokens=8)
+        assert isinstance(out, str) and out
+        stream = "".join(c.chat_stream(
+            "tiny", [{"role": "user", "content": "hello"}], max_tokens=8))
+        assert stream
+        embs = c.embeddings("embedder", ["x", "y"])
+        assert len(embs) == 2
+
+
 def test_system_endpoint(server):
     r = httpx.get(f"{server.base}/system").json()
     assert "devices" in r

@@ -1,0 +1,193 @@
+"""MusicGen torch-parity + generation tests (VERDICT r3 #6).
+
+Oracle: installed torch transformers MusicgenForConditionalGeneration
+(tiny-random). Per-component: T5 encoder states, decoder step logits
+(cached), EnCodec RVQ+SEANet decode. End-to-end: greedy generation
+matches HF `generate(do_sample=False)` token-for-token, and the decoded
+waveform matches.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from localai_tpu.models import encodec as jcodec  # noqa: E402
+from localai_tpu.models import musicgen as jmg  # noqa: E402
+
+
+def _tiny_torch_musicgen():
+    from transformers import (EncodecConfig, MusicgenForConditionalGeneration,
+                              MusicgenConfig, T5Config)
+    from transformers.models.musicgen.configuration_musicgen import (
+        MusicgenDecoderConfig)
+
+    t5 = T5Config(vocab_size=99, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+                  num_heads=4)
+    enc = EncodecConfig(audio_channels=1, codebook_size=64, hidden_size=16,
+                        num_filters=8, num_residual_layers=1,
+                        upsampling_ratios=[4, 5], target_bandwidths=[19.2],
+                        sampling_rate=16000, normalize=False)
+    dec = MusicgenDecoderConfig(vocab_size=64, hidden_size=32,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                ffn_dim=64, num_codebooks=4, audio_channels=1,
+                                dropout=0.0, attention_dropout=0.0,
+                                activation_dropout=0.0,
+                                pad_token_id=64, bos_token_id=64)
+    cfg = MusicgenConfig.from_sub_models_config(t5, enc, dec)
+    torch.manual_seed(0)
+    model = MusicgenForConditionalGeneration(cfg).eval()
+    assert model.audio_encoder.quantizer.num_quantizers >= 4
+    return cfg, model
+
+
+def _ours(cfg, model):
+    jcfg = jmg.MusicgenConfig.from_hf_config(cfg.to_dict())
+    tensors = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    params = jmg.params_from_tensors(tensors, jcfg)
+    return jcfg, params
+
+
+@pytest.fixture(scope="module")
+def musicgen_pair():
+    cfg, model = _tiny_torch_musicgen()
+    jcfg, params = _ours(cfg, model)
+    return cfg, model, jcfg, params
+
+
+def test_t5_encoder_parity(musicgen_pair):
+    cfg, model, jcfg, params = musicgen_pair
+    tokens = np.array([[5, 17, 42, 7, 1, 0, 0]], np.int32)
+    mask = (tokens != 0).astype(np.int32)
+    with torch.no_grad():
+        ref = model.text_encoder(
+            input_ids=torch.tensor(tokens.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).last_hidden_state.numpy()
+    ours = np.asarray(jmg.t5_encode(params["t5"], jcfg.t5, tokens, mask))
+    n = int(mask.sum())
+    np.testing.assert_allclose(ours[0, :n], ref[0, :n], atol=2e-4, rtol=2e-3)
+
+
+def test_decoder_step_parity(musicgen_pair):
+    cfg, model, jcfg, params = musicgen_pair
+    nq = jcfg.num_codebooks
+    tokens = np.array([[5, 17, 42, 7]], np.int32)
+    mask = np.ones_like(tokens)
+    with torch.no_grad():
+        enc = model.text_encoder(
+            input_ids=torch.tensor(tokens.astype(np.int64))
+        ).last_hidden_state
+
+    # a short delayed sequence of codes [1*nq, T]
+    rng = np.random.default_rng(0)
+    T = 5
+    seq = rng.integers(0, 64, size=(nq, T)).astype(np.int64)
+    seq[:, 0] = 2048 if cfg.decoder.vocab_size > 2048 else jcfg.pad_token_id
+    for k in range(nq):
+        seq[k, : min(k + 1, T)] = jcfg.pad_token_id
+    with torch.no_grad():
+        ref = model.decoder(
+            input_ids=torch.tensor(seq),
+            encoder_hidden_states=enc,
+        ).logits.numpy()          # [nq, T, V]
+
+    # ours: step-by-step with cache
+    enc_j = jnp.asarray(enc.numpy())
+    xk, xv = jmg.cross_kv(params["decoder"], jcfg, enc_j)
+    L, D = jcfg.num_layers, jcfg.hidden_size
+    ck = jnp.zeros((L, 1, 8, D), jnp.float32)
+    cv = jnp.zeros((L, 1, 8, D), jnp.float32)
+    for t in range(T):
+        cur = seq[:, t][None].astype(np.int32)      # [1, nq]
+        logits, ck, cv = jmg.decode_step(
+            params["decoder"], jcfg, cur, jnp.int32(t), xk, xv, mask, ck, cv)
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], ref[:, t, :], atol=3e-4, rtol=3e-3,
+            err_msg=f"decoder logits @ step {t}")
+
+
+def test_encodec_decode_parity(musicgen_pair):
+    cfg, model, jcfg, params = musicgen_pair
+    rng = np.random.default_rng(1)
+    codes = rng.integers(0, 64, size=(4, 1, 11)).astype(np.int64)
+    with torch.no_grad():
+        emb = model.audio_encoder.quantizer.decode(torch.tensor(codes))
+        ref = model.audio_encoder.decoder(emb).numpy()
+    ours = np.asarray(jcodec.decode(params["encodec"], jcfg.enc,
+                                    codes.astype(np.int32)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_greedy_generation_matches_hf(musicgen_pair):
+    cfg, model, jcfg, params = musicgen_pair
+    tokens = np.array([[5, 17, 42]], np.int32)
+    mask = np.ones_like(tokens)
+    frames = 6
+    nq = jcfg.num_codebooks
+    with torch.no_grad():
+        ref_wav = model.generate(
+            input_ids=torch.tensor(tokens.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+            do_sample=False, guidance_scale=1.0,
+            max_length=frames + nq,    # HF counts the BOS column
+        ).numpy()
+    wav = jmg.generate(params, jcfg, tokens, mask, frames=frames,
+                       temperature=0.0, guidance_scale=1.0)
+    assert wav.shape[-1] == ref_wav.shape[-1], (wav.shape, ref_wav.shape)
+    np.testing.assert_allclose(wav, ref_wav[0, 0], atol=5e-4, rtol=5e-3)
+
+
+def test_sound_generation_servicer(musicgen_pair, tmp_path):
+    """The serving path: a saved musicgen-layout checkpoint through
+    TTSServicer.SoundGeneration -> WAV (reference RPC semantics:
+    transformers-musicgen backend.py SoundGeneration)."""
+    import json
+    import wave as wavmod
+
+    from localai_tpu.backend import contract_pb2 as pb
+    from localai_tpu.backend.tts_runner import TTSServicer
+
+    cfg, model, jcfg, params = musicgen_pair
+    d = tmp_path / "musicgen-ckpt"
+    d.mkdir()
+    model.save_pretrained(str(d), safe_serialization=True)
+    # offline word-level tokenizer sized to the T5 vocab
+    from tokenizers import Tokenizer, models as tokmodels
+    from tokenizers.pre_tokenizers import WhitespaceSplit
+
+    vocab = {"<unk>": 0, "</s>": 1}
+    for i in range(2, 99):
+        vocab[f"w{i}"] = i
+    tok = Tokenizer(tokmodels.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = WhitespaceSplit()
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps(
+        {"tokenizer_class": "PreTrainedTokenizerFast",
+         "eos_token": "</s>", "unk_token": "<unk>"}))
+
+    svc = TTSServicer()
+    res = svc.LoadModel(pb.ModelOptions(model=str(d)), None)
+    assert res.success, res.message
+    dst = str(tmp_path / "out.wav")
+    res = svc.SoundGeneration(pb.SoundGenerationRequest(
+        text="w5 w17 w42", dst=dst, duration=0.01, temperature=1.0), None)
+    assert res.success, res.message
+    with wavmod.open(dst) as f:
+        assert f.getframerate() == jcfg.enc.sampling_rate
+        assert f.getnframes() > 0
+
+
+def test_sampled_generation_runs(musicgen_pair):
+    cfg, model, jcfg, params = musicgen_pair
+    tokens = np.array([[9, 3, 60, 2]], np.int32)
+    mask = np.ones_like(tokens)
+    wav = jmg.generate(params, jcfg, tokens, mask, frames=5,
+                       temperature=1.0, top_k=50, guidance_scale=3.0,
+                       seed=7)
+    # 5 frames x prod(upsampling ratios)=20 samples/frame
+    assert wav.shape == (100,)
+    assert np.isfinite(wav).all()

@@ -1,0 +1,58 @@
+"""Remote-API passthrough backend (reference parity:
+backend/go/llm/langchain + pkg/langchain — HF Inference API fallback).
+Hermetic: a local mock HTTP server stands in for the remote API."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.backend.remote_runner import RemoteServicer
+from localai_tpu.modelmgr.process import free_port
+
+
+class _MockHF(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        reply = [{"generated_text":
+                  f"echo:{body['inputs']}:"
+                  f"{body['parameters'].get('max_new_tokens')}"}]
+        data = json.dumps(reply).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_remote_passthrough_predict():
+    port = free_port()
+    srv = HTTPServer(("127.0.0.1", port), _MockHF)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        svc = RemoteServicer()
+        res = svc.LoadModel(
+            pb.ModelOptions(model=f"http://127.0.0.1:{port}/models/x"), None)
+        assert res.success, res.message
+        reply = svc.Predict(pb.PredictOptions(
+            prompt="hello", max_tokens=7, temperature=0.5), None)
+        assert reply.message.decode() == "echo:hello:7"
+        chunks = list(svc.PredictStream(pb.PredictOptions(
+            prompt="s", max_tokens=3), None))
+        assert len(chunks) == 1
+        assert chunks[0].message.decode() == "echo:s:3"
+    finally:
+        srv.shutdown()
+
+
+def test_remote_hf_model_id_maps_to_endpoint():
+    svc = RemoteServicer()
+    res = svc.LoadModel(pb.ModelOptions(model="gpt2"), None)
+    assert res.success
+    assert svc.endpoint == \
+        "https://api-inference.huggingface.co/models/gpt2"

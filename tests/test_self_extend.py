@@ -153,6 +153,92 @@ def test_engine_self_extend_long_prompt_ingestion():
     assert offsets.max() >= 4 * 6
 
 
+def _oracle_cache(cfg, params, toks, C, positions=None):
+    """Fresh-prefill KV oracle for a token sequence (rows 0..n-1)."""
+    n = len(toks)
+    ck, cv = llama.init_cache(cfg, 1, C, jnp.float32)
+    ids = np.zeros((1, C), np.int32)
+    ids[0, :n] = toks
+    kwargs = {}
+    if positions is not None:
+        pos = np.zeros((1, C), np.int32)
+        pos[0, :n] = positions
+        kwargs["positions"] = pos
+    _, ck, cv = llama.prefill(params, cfg, ids, np.array([n], np.int32),
+                              ck, cv, np.array([0], np.int32),
+                              np.array([0], np.int32), **kwargs)
+    return np.asarray(ck[:, 0, :n])
+
+
+def test_rollback_cache_matches_fresh_prefill_oracle():
+    """The r4 off-by-one regression test: after grammar rollbacks, the
+    slot's cached keys must equal a fresh prefill of the same committed
+    tokens (the r3 recipe re-wrote the pending token's KV one row too
+    far, position-shifting everything after the first rollback)."""
+    cfg = _tiny_cfg(max_pos=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ecfg = eng.EngineConfig(num_slots=2, max_context=128,
+                            prefill_buckets=(16, 32), prefill_chunk=32,
+                            decode_burst=8, cache_dtype=jnp.float32)
+    e = eng.Engine(cfg, params, _Tok(), ecfg, eos_token_ids={259})
+    e.start()
+    # a STATE-CHANGING grammar: after 'a' only 'b' is legal and vice
+    # versa, so mid-burst tokens sampled under the burst-start mask go
+    # stale and force rollbacks (a single-state grammar like [a-m]*
+    # never would)
+    r = eng.GenRequest(prompt_ids=list(range(10)),
+                       params=sampling.SamplingParamsHost(temperature=0.0),
+                       max_new_tokens=16, ignore_eos=True,
+                       grammar='root ::= ("ab" | "ba")*')
+    ids = eng.event_ids(e.generate(r))
+    assert len(ids) == 16
+    assert e._rollbacks > 0, "scenario no longer triggers a rollback"
+    slot = next(i for i, t in enumerate(e._cache_tokens) if t)
+    toks = list(e._cache_tokens[slot])
+    got = np.asarray(e.ck[:, slot, :len(toks)])
+    e.shutdown()
+    want = _oracle_cache(cfg, params, toks, ecfg.max_context)
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+def test_self_extend_ingestion_cache_matches_grouped_oracle():
+    """A grouped-position prompt ingestion (+ a few decode steps that
+    cross no block boundary) must match a fresh prefill at the grouped
+    positions EXACTLY. Decode-time compressions are deliberately not
+    oracle-checked against a from-scratch forward: self-extend re-rotates
+    cached KEYS only (values/hidden states keep their original
+    computation — the same approximation llama.cpp's KV surgery makes);
+    the key-rotation itself is proven exact by
+    test_shift_cache_positions_matches_direct."""
+    cfg = _tiny_cfg(max_pos=32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    ecfg = eng.EngineConfig(num_slots=2, max_context=128,
+                            prefill_buckets=(16, 32), prefill_chunk=16,
+                            decode_burst=8, ga_n=2, ga_w=8,
+                            cache_dtype=jnp.float32)
+    e = eng.Engine(cfg, params, _Tok(), ecfg, eos_token_ids={259})
+    e.start()
+    # P=36 -> blocks 0-3 ingested compressed (c=4); next boundary at
+    # committed >= 40, so 3 generated tokens never trigger a decode-time
+    # compression
+    prompt = [int(x) for x in np.random.default_rng(0).integers(0, 255, 36)]
+    r = eng.GenRequest(prompt_ids=prompt,
+                       params=sampling.SamplingParamsHost(temperature=0.0),
+                       max_new_tokens=3, ignore_eos=True)
+    ids = eng.event_ids(e.generate(r))
+    assert len(ids) == 3
+    slot = int(np.argmax(e.pos_offset))
+    assert e.pos_offset[slot] == 4 * (8 - 4)
+    toks = list(e._cache_tokens[slot])
+    n = len(toks)
+    positions = eng.Engine._ga_positions(e, 0, n, 4)
+    got = np.asarray(e.ck[:, slot, :n])
+    e.shutdown()
+    want = _oracle_cache(cfg, params, toks, ecfg.max_context,
+                         positions=positions)
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
 def test_self_extend_matches_unextended_before_first_block():
     """With ga_w larger than the whole run, self-extend must be a no-op:
     outputs identical to ga_n=1."""
