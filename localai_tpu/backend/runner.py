@@ -104,6 +104,7 @@ class EngineServicer(BackendServicer):
             request.dtype or "bfloat16", jnp.bfloat16
         )
         gguf_path = weights.find_gguf(model_dir)
+        family = None
         if gguf_path is not None:
             # GGUF checkpoint (ollama://, oci:// or gallery pull): config
             # and tokenizer come from the file's own metadata
@@ -113,8 +114,23 @@ class EngineServicer(BackendServicer):
                 gguflib.config_from_gguf(gguflib.open_gguf(gguf_path)),
                 dtype=dtype)
         else:
-            cfg = llama.LlamaConfig.from_json(
-                os.path.join(model_dir, "config.json"), dtype=dtype)
+            cfg_path = os.path.join(model_dir, "config.json")
+            with open(cfg_path) as f:
+                cfg_dict = json.load(f)
+            if cfg_dict.get("model_type", "") == "mamba":
+                # second LLM family (reference: backend/python/mamba):
+                # scan-native SSM with fixed-size state, same engine
+                from localai_tpu.models import mamba as mamba_mod
+
+                family = mamba_mod
+                cfg = mamba_mod.MambaConfig.from_hf_config(cfg_dict,
+                                                           dtype=dtype)
+                if request.lora_adapter or request.quantization \
+                        or request.dtype == "int8":
+                    raise ValueError(
+                        "LoRA / int8 quantization are llama-family only")
+            else:
+                cfg = llama.LlamaConfig.from_hf_config(cfg_dict, dtype=dtype)
 
         n_dev = len(jax.devices())
         tp = request.mesh_tp or n_dev
@@ -126,11 +142,14 @@ class EngineServicer(BackendServicer):
         lora_dir = request.lora_adapter
         if lora_dir and request.model_path and not os.path.isabs(lora_dir):
             lora_dir = os.path.join(request.model_path, lora_dir)
-        params = weights.load_llama_params(
-            model_dir, cfg, mesh=mesh, dtype=dtype,
-            quantize=request.quantization or
-            ("int8" if request.dtype == "int8" else ""),
-            lora_adapter=lora_dir, lora_scale=request.lora_scale or 1.0)
+        if family is not None:
+            params = family.load_hf_params(model_dir, cfg, dtype=dtype)
+        else:
+            params = weights.load_llama_params(
+                model_dir, cfg, mesh=mesh, dtype=dtype,
+                quantize=request.quantization or
+                ("int8" if request.dtype == "int8" else ""),
+                lora_adapter=lora_dir, lora_scale=request.lora_scale or 1.0)
 
         if gguf_path is not None and not request.tokenizer:
             from localai_tpu.engine import gguf_tokenizer
@@ -179,7 +198,7 @@ class EngineServicer(BackendServicer):
         self.model_cfg = cfg
         self.model_path = request.model_path or os.path.dirname(model_dir)
         self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh,
-                                 draft=draft)
+                                 draft=draft, family=family)
         # compile the whole serving surface before accepting traffic (a cold
         # compile mid-request stalls every active slot for 20-40s); skippable
         # for tests that only care about wiring

@@ -253,9 +253,21 @@ class Engine:
         param_shardings=None,
         draft: Optional[tuple] = None,   # (LlamaConfig, params) draft model
         bus=None,                        # parallel/lockstep.LeaderBus
+        family=None,                     # model-family module (default llama)
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # model-family adapter (init_cache / engine_decode / prefill):
+        # llama-family by default; models/mamba.py rides the same slot
+        # model with a fixed-size (conv, ssm) state in the cache lanes.
+        # Families without a positional KV-row cache get the llama-only
+        # features gated off (prefix reuse, prompt-cache persistence,
+        # fork-dedup, multimodal injection, speculative draft, ga).
+        self.family = family if family is not None else llama
+        self._fam_llama = self.family is llama
+        if not self._fam_llama:
+            assert draft is None, "draft speculation is llama-family only"
+            assert self.ecfg.ga_n <= 1, "self-extend is llama-family only"
         # multi-host lockstep mode: every device dispatch is mirrored to
         # follower processes (see parallel/lockstep.py); features whose
         # dispatches are not in the descriptor set are rejected/disabled
@@ -278,7 +290,8 @@ class Engine:
         # lives as HOST numpy — admissions/releases are then free in-place
         # writes instead of ~3ms `.at[].set` dispatches, and the arrays ride
         # to the device as ordinary jit args each step.
-        self.ck, self.cv = llama.init_cache(model_cfg, S, C, self.ecfg.cache_dtype)
+        self.ck, self.cv = self.family.init_cache(model_cfg, S, C,
+                                                  self.ecfg.cache_dtype)
         # draft cache is allocated LAZILY at the first spec-eligible
         # admission (r2 allocated it up front, doubling per-slot KV HBM
         # even when no request could ever speculate)
@@ -419,8 +432,8 @@ class Engine:
         Falls back to replication per axis when sizes don't divide — a
         wrong-but-silent replicated cache is exactly the HBM waste this
         exists to avoid, so only shard what divides evenly."""
-        if self.mesh is None:
-            return None
+        if self.mesh is None or not self._fam_llama:
+            return None   # non-llama cache layouts are replicated for now
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         dp = self.mesh.shape.get("dp", 1)
@@ -510,20 +523,16 @@ class Engine:
                         pos_offset=None):
         """The shared decode+sample scan step for plain and fused bursts.
 
-        Inactive slots (free / mid-prefill) must NOT write KV: their write
-        position is forced to C so the scatter's mode="drop" discards it —
-        otherwise every decode step would clobber row 0 of slots holding
-        reusable prefixes or in-flight prefill chunks. Only active slots
-        consume RNG/mirostat/ring state: a prefilling slot's seeded state
-        must not advance with others' decode steps."""
-        C = self.ecfg.max_context
+        Inactive slots (free / mid-prefill) must NOT advance their cache
+        state (the family adapter masks KV writes / state updates), and
+        only active slots consume RNG/mirostat/ring state: a prefilling
+        slot's seeded state must not advance with others' decode steps."""
 
         def step(carry, _):
             tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
-            write_lengths = jnp.where(active, lengths, C)
-            logits, ck, cv = llama.decode_step(params, self.cfg, tokens,
-                                               write_lengths, ck, cv,
-                                               pos_offset=pos_offset)
+            logits, ck, cv = self.family.engine_decode(
+                params, self.cfg, tokens, lengths, active, ck, cv,
+                pos_offset=pos_offset)
             ids, logprobs, new_keys, new_mu = sampling.sample(
                 logits, slot_params, ring, ring_pos, bias, keys, mu,
                 use_penalties=flags[0], use_typical=flags[1],
@@ -541,9 +550,9 @@ class Engine:
                             mm_pos=None, mm_vec=None):
         """Non-final chunk: write KV only, no sampling. (The penalty ring is
         seeded host-side at admission from the full prompt tail.)"""
-        _, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv, slot,
-                                  start_pos, continued=True,
-                                  mm_pos=mm_pos, mm_vec=mm_vec)
+        _, ck, cv = self.family.prefill(params, self.cfg, tokens, seq_len, ck,
+                                        cv, slot, start_pos, continued=True,
+                                        mm_pos=mm_pos, mm_vec=mm_vec)
         return ck, cv
 
     def _fused_body(self, params, tokens, ck, cv, lengths, ring, ring_pos,
@@ -570,8 +579,9 @@ class Engine:
             self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
                                     ov_pack)
 
-        logits, ck, cv = llama.prefill(params, self.cfg, p_tokens, p_seq, ck,
-                                       cv, p_slots, p_start, continued=False)
+        logits, ck, cv = self.family.prefill(params, self.cfg, p_tokens,
+                                             p_seq, ck, cv, p_slots, p_start,
+                                             continued=False)
         sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), p_slots,
                                                   axis=0), slot_params)
         rpos_rows = jnp.take(ring_pos, p_slots, axis=0)
@@ -626,10 +636,10 @@ class Engine:
         first output token. slot may contain duplicate entries (batch
         padding repeats the last prompt; duplicate KV writes and key
         scatters are idempotent — same inputs, last write wins)."""
-        logits, ck, cv = llama.prefill(params, self.cfg, tokens, seq_len, ck, cv,
-                                       slot, start_pos, continued=continued,
-                                       mm_pos=mm_pos, mm_vec=mm_vec,
-                                       positions=positions)
+        logits, ck, cv = self.family.prefill(
+            params, self.cfg, tokens, seq_len, ck, cv, slot, start_pos,
+            continued=continued, mm_pos=mm_pos, mm_vec=mm_vec,
+            positions=positions)
         slot_params = sampling.unpack_slot_params(slot_params)
         sp_rows = jax.tree.map(lambda a: jnp.take(jnp.asarray(a), slot, axis=0),
                                slot_params)
@@ -864,8 +874,8 @@ class Engine:
             self._bus.send("reset")
         S = self.ecfg.num_slots
         V = self.cfg.vocab_size
-        self.ck, self.cv = llama.init_cache(self.cfg, S, self.ecfg.max_context,
-                                            self.ecfg.cache_dtype)
+        self.ck, self.cv = self.family.init_cache(
+            self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype)
         self.dck = self.dcv = None   # re-ensured at the next spec admission
         self.ring, self.ring_pos = sampling.make_ring(S)
         self.bias = jnp.zeros((S, V), jnp.float32)
@@ -1156,7 +1166,8 @@ class Engine:
             # bookkeeping would re-compress, and in lockstep mode the fork
             # op is not in the descriptor set — mutually exclusive
             if not req.grammar and req.mm_vectors is None \
-                    and self.ecfg.ga_n <= 1 and self._bus is None:
+                    and self.ecfg.ga_n <= 1 and self._bus is None \
+                    and self._fam_llama:
                 # truncation depends on max_new_tokens; bucket it into the key
                 key = (tuple(req.prompt_ids),
                        min(req.max_new_tokens, self.ecfg.max_context // 4))
@@ -1216,6 +1227,8 @@ class Engine:
             ids = [getattr(self.tokenizer, "eos_token_id", 0) or 0]
 
         mm_pos = mm_vec = None
+        if req.mm_vectors is not None and not self._fam_llama:
+            raise ValueError("multimodal injection is llama-family only")
         if req.mm_vectors is not None and len(req.mm_positions):
             pos = np.asarray(req.mm_positions, np.int64) - shift
             keep = (pos >= 0) & (pos < len(ids))
@@ -1241,10 +1254,10 @@ class Engine:
         # never reuse (their cache rows hold image embeddings, not tokens).
         if common < 16 or mm_pos is not None:
             common = 0
-        if self.ecfg.ga_n > 1:
-            # self-extend re-maps positions as the context grows; cached
-            # prefixes from other requests were keyed under a different
-            # mapping, so reuse and prompt-cache restore are disabled
+        if self.ecfg.ga_n > 1 or not self._fam_llama:
+            # self-extend re-maps positions as the context grows, and
+            # non-llama families have no positional KV rows to share —
+            # prefix reuse and prompt-cache restore are llama-only
             common = 0
         elif mm_pos is None:
             common = self._restore_prompt_cache(slot, req, ids, common)
@@ -1468,7 +1481,8 @@ class Engine:
     def _save_prompt_cache(self, slot: int, s: "_Slot"):
         """Persist the slot's committed rows + tokens on finish."""
         req = s.req
-        if not req.prompt_cache_path or req.prompt_cache_ro:
+        if not req.prompt_cache_path or req.prompt_cache_ro \
+                or not self._fam_llama:
             return
         if self.ecfg.ga_n > 1:
             # rows may hold position-compressed (self-extend) keys; a

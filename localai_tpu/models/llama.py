@@ -422,6 +422,17 @@ def decode_step(
     return logits, cache_k, cache_v
 
 
+def engine_decode(params, cfg, tokens, lengths, active, cache_k, cache_v,
+                  pos_offset=None):
+    """Engine adapter (shared contract with models/mamba.py): one decode
+    step for all slots; inactive slots must not write KV — their write
+    position is forced to C so the scatter's mode=\"drop\" discards it."""
+    C = cache_k.shape[2]
+    write_lengths = jnp.where(active, lengths, C)
+    return decode_step(params, cfg, tokens, write_lengths, cache_k, cache_v,
+                       pos_offset=pos_offset)
+
+
 def shift_cache_positions(cache_k: jax.Array, cfg: LlamaConfig,
                           slot: jax.Array, deltas: jax.Array) -> jax.Array:
     """Re-rotate ONE slot's cached keys by per-row position deltas [C].

@@ -1,0 +1,230 @@
+"""Mamba SSM family (VERDICT r3 #9): torch parity + engine serving.
+
+Oracle: installed torch transformers MambaForCausalLM (tiny-random).
+The same continuous-batching engine serves it — fixed-size (conv, ssm)
+state rides the cache lanes, fused admission included.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from localai_tpu.engine import engine as eng  # noqa: E402
+from localai_tpu.engine import sampling  # noqa: E402
+from localai_tpu.models import mamba as jmamba  # noqa: E402
+
+
+def _tiny_torch_mamba(tmp=None):
+    from transformers import MambaConfig, MambaForCausalLM
+
+    tcfg = MambaConfig(vocab_size=96, hidden_size=32, state_size=8,
+                       num_hidden_layers=2, conv_kernel=4, expand=2,
+                       time_step_rank=4, use_bias=False, use_conv_bias=True,
+                       bos_token_id=0, eos_token_id=0, pad_token_id=0)
+    torch.manual_seed(0)
+    model = MambaForCausalLM(tcfg).eval()
+    d = None
+    if tmp is not None:
+        d = os.path.join(tmp, "mamba")
+        model.save_pretrained(d, safe_serialization=True)
+    return tcfg, model, d
+
+
+def test_mamba_logits_parity(tmp_path):
+    tcfg, model, d = _tiny_torch_mamba(str(tmp_path))
+    cfg = jmamba.MambaConfig.from_json(os.path.join(d, "config.json"),
+                                       dtype=jnp.float32)
+    params = jmamba.load_hf_params(d, cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, size=10).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.tensor(ids[None].astype(np.int64))).logits[0].numpy()
+
+    # prefill path: all-position logits
+    conv, ssm = jmamba.init_cache(cfg, 2, 64)
+    logits, conv, ssm = jmamba.prefill(
+        params, cfg, ids[None], np.array([10], np.int32), conv, ssm,
+        np.array([0], np.int32), np.array([0], np.int32),
+        return_all_logits=True)
+    np.testing.assert_allclose(np.asarray(logits)[0], ref,
+                               atol=2e-4, rtol=2e-3)
+
+    # cached decode continuation: step-by-step vs torch full forward
+    conv, ssm = jmamba.init_cache(cfg, 2, 64)
+    _, conv, ssm = jmamba.prefill(
+        params, cfg, ids[None], np.array([10], np.int32), conv, ssm,
+        np.array([0], np.int32), np.array([0], np.int32))
+    cur = int(np.argmax(ref[-1]))
+    toks = list(ids) + [cur]
+    active = np.array([True, False])
+    for step in range(5):
+        batch = np.array([cur, 0], np.int32)
+        logits, conv, ssm = jmamba.engine_decode(
+            params, cfg, batch, None, active, conv, ssm)
+        with torch.no_grad():
+            tref = model(torch.tensor(np.asarray(toks)[None].astype(np.int64))
+                         ).logits[0, -1].numpy()
+        np.testing.assert_allclose(np.asarray(logits)[0], tref,
+                                   atol=3e-4, rtol=3e-3,
+                                   err_msg=f"decode step {step}")
+        cur = int(np.argmax(tref))
+        toks.append(cur)
+
+
+def test_mamba_continued_prefill_matches_full():
+    """Chunked ingestion (continued=True resumes slot state) must equal
+    one-shot ingestion."""
+    tcfg, model, _ = _tiny_torch_mamba()
+    cfg = jmamba.MambaConfig.from_hf_config(tcfg.to_dict(),
+                                            dtype=jnp.float32)
+    tensors_params = jmamba.init_params(cfg, __import__("jax").random.PRNGKey(3),
+                                        dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 96, size=12).astype(np.int32)
+
+    conv, ssm = jmamba.init_cache(cfg, 1, 64)
+    full, conv, ssm = jmamba.prefill(
+        tensors_params, cfg, ids[None], np.array([12], np.int32), conv, ssm,
+        np.array([0], np.int32), np.array([0], np.int32))
+
+    conv2, ssm2 = jmamba.init_cache(cfg, 1, 64)
+    _, conv2, ssm2 = jmamba.prefill(
+        tensors_params, cfg, ids[None, :7], np.array([7], np.int32),
+        conv2, ssm2, np.array([0], np.int32), np.array([0], np.int32))
+    part, conv2, ssm2 = jmamba.prefill(
+        tensors_params, cfg, ids[None, 7:], np.array([5], np.int32),
+        conv2, ssm2, np.array([0], np.int32), np.array([7], np.int32),
+        continued=True)
+    np.testing.assert_allclose(np.asarray(part), np.asarray(full),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ssm2), np.asarray(ssm),
+                               atol=1e-5, rtol=1e-5)
+
+
+class _Tok:
+    vocab_size = 96
+    eos_token_id = 95
+
+    def decode(self, ids, **kw):
+        return "".join(chr(97 + (i % 26)) for i in ids)
+
+    def convert_ids_to_tokens(self, ids):
+        return [chr(97 + (i % 26)) for i in ids]
+
+    def get_vocab_size(self):
+        return self.vocab_size
+
+
+def test_mamba_engine_serving():
+    """The continuous-batching engine serves mamba: fused admission,
+    bursts, greedy determinism, concurrent slots."""
+    import jax
+
+    cfg = jmamba.MambaConfig(vocab_size=96, hidden_size=32, state_size=8,
+                             num_layers=2, conv_kernel=4, expand=2,
+                             time_step_rank=4, dtype=jnp.float32)
+    params = jmamba.init_params(cfg, jax.random.PRNGKey(0),
+                                dtype=jnp.float32)
+    ecfg = eng.EngineConfig(num_slots=2, max_context=64,
+                            prefill_buckets=(16,), prefill_chunk=16,
+                            decode_burst=4, cache_dtype=jnp.float32)
+    e = eng.Engine(cfg, params, _Tok(), ecfg, eos_token_ids={95},
+                   family=jmamba)
+    e.start()
+
+    def run(prompt, n):
+        r = eng.GenRequest(prompt_ids=prompt,
+                           params=sampling.SamplingParamsHost(temperature=0.0),
+                           max_new_tokens=n, ignore_eos=True)
+        return eng.event_ids(e.generate(r))
+
+    a = run(list(range(5)), 12)
+    b = run(list(range(5)), 12)
+    assert len(a) == 12 and a == b          # greedy determinism
+
+    # concurrent requests share the fleet
+    rs = [eng.GenRequest(prompt_ids=[i, i + 1, i + 2],
+                         params=sampling.SamplingParamsHost(temperature=0.0),
+                         max_new_tokens=8, ignore_eos=True)
+          for i in range(2)]
+    outs = [e.submit(r) for r in rs]
+    got = []
+    for o in outs:
+        ids = []
+        while True:
+            ev = o.get()
+            if ev is None:
+                break
+            ids.extend(ev.token_ids or
+                       ([ev.token_id] if ev.token_id >= 0 else []))
+        got.append(ids)
+    assert all(len(g) == 8 for g in got)
+    e.shutdown()
+
+    # engine output matches a hand-rolled greedy loop (prefill + steps)
+    conv, ssm = jmamba.init_cache(cfg, 1, 64)
+    logits, conv, ssm = jmamba.prefill(
+        params, cfg, np.arange(5, dtype=np.int32)[None],
+        np.array([5], np.int32), conv, ssm, np.array([0], np.int32),
+        np.array([0], np.int32))
+    want = [int(np.argmax(np.asarray(logits)[0]))]
+    act = np.array([True])
+    for _ in range(11):
+        logits, conv, ssm = jmamba.engine_decode(
+            params, cfg, np.array([want[-1]], np.int32), None, act,
+            conv, ssm)
+        want.append(int(np.argmax(np.asarray(logits)[0])))
+    assert a == want
+
+
+def test_mamba_servicer_chat(tmp_path):
+    """Full backend path: mamba checkpoint dir -> EngineServicer ->
+    PredictStream (reference e2e analogue for backend/python/mamba)."""
+    from localai_tpu.backend import contract_pb2 as pb
+    from localai_tpu.backend.runner import EngineServicer
+
+    tcfg, model, d = _tiny_torch_mamba(str(tmp_path))
+    # offline word-level tokenizer sized to the vocab
+    from tokenizers import Tokenizer, models as tokmodels
+    from tokenizers.pre_tokenizers import WhitespaceSplit
+
+    vocab = {"<unk>": 0, "</s>": 1}
+    for i in range(2, 96):
+        vocab[f"w{i}"] = i
+    tok = Tokenizer(tokmodels.WordLevel(vocab=vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = WhitespaceSplit()
+    tok.save(os.path.join(d, "tokenizer.json"))
+    with open(os.path.join(d, "tokenizer_config.json"), "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                   "eos_token": "</s>", "unk_token": "<unk>"}, f)
+
+    os.environ["LOCALAI_PRECOMPILE"] = "0"
+
+    class _Ctx:
+        def is_active(self):
+            return True
+
+        def abort(self, code, msg):
+            raise AssertionError(f"abort: {code} {msg}")
+
+    svc = EngineServicer()
+    res = svc.LoadModel(pb.ModelOptions(
+        model=d, dtype="float32", num_slots=2, context_size=64,
+        prefill_buckets=[16]), None)
+    assert res.success, res.message
+    chunks = list(svc.PredictStream(pb.PredictOptions(
+        prompt="w5 w17 w42", max_tokens=6, temperature=0.0,
+        ignore_eos=True), _Ctx()))
+    text = "".join(c.message.decode("utf-8", "replace") for c in chunks)
+    assert text
+    total = sum(c.tokens for c in chunks if c.tokens)
+    assert total >= 6 or len(chunks) >= 1
+    svc.engine.shutdown()
