@@ -365,19 +365,63 @@ async def images_generations(request):
     prompt = body.get("prompt", "")
     positive, _, negative = prompt.partition("|")
     n = int(body.get("n") or 1)
+    # img2img (reference: OpenAIRequest.File -> request.src,
+    # endpoints/openai/image.go): base64 init image (optionally a data
+    # URL) + "strength"; scheduler override rides the same body
+    from localai_tpu.config.model_config import SCHEDULERS
+
+    scheduler = str(body.get("scheduler", "") or "")
+    if scheduler and scheduler not in SCHEDULERS:
+        return api_error(f"unknown scheduler {scheduler!r}", 400,
+                         "invalid_request_error")
+    strength = body.get("strength")
+    if strength is not None:
+        try:
+            strength = float(strength)
+        except (TypeError, ValueError):
+            return api_error("strength must be a number", 400,
+                             "invalid_request_error")
+    src = ""
+    if body.get("file"):
+        data = body["file"]
+        if isinstance(data, str) and data.startswith("data:"):
+            data = data.partition(",")[2]
+        try:
+            raw = base64.b64decode(data)
+        except Exception:
+            return api_error("file must be base64", 400,
+                             "invalid_request_error")
+        fd, src = tempfile.mkstemp(suffix=".png", prefix="localai-img2img-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(raw)
     out = []
-    for _ in range(n):
-        dst = os.path.join(tempfile.gettempdir(),
-                           f"localai-img-{secrets.token_hex(8)}.png")
-        await state.run_blocking(
-            state.caps.generate_image, mc, positive.strip(), negative.strip(),
-            width, height, int(body.get("step", 25)), int(body.get("seed", 0)), dst)
-        if body.get("response_format") == "b64_json":
-            with open(dst, "rb") as f:
-                out.append({"b64_json": base64.b64encode(f.read()).decode()})
-            os.unlink(dst)
-        else:
-            out.append({"url": f"file://{dst}"})
+    try:
+        base_seed = int(body.get("seed", 0))
+        for i in range(n):
+            dst = os.path.join(tempfile.gettempdir(),
+                               f"localai-img-{secrets.token_hex(8)}.png")
+            # n > 1 must produce n DIFFERENT samples: offset the seed per
+            # image (a fixed seed otherwise reseeds the sampler
+            # identically n times)
+            await state.run_blocking(
+                state.caps.generate_image, mc, positive.strip(),
+                negative.strip(), width, height, int(body.get("step", 25)),
+                base_seed + i if base_seed >= 0 else base_seed - i,
+                dst, src, str(body.get("mode", "") or ""),
+                strength, scheduler)
+            if body.get("response_format") == "b64_json":
+                with open(dst, "rb") as f:
+                    out.append({"b64_json":
+                                base64.b64encode(f.read()).decode()})
+                os.unlink(dst)
+            else:
+                out.append({"url": f"file://{dst}"})
+    finally:
+        if src:
+            try:
+                os.unlink(src)
+            except OSError:
+                pass
     return web.json_response({"created": int(time.time()), "data": out})
 
 

@@ -43,6 +43,8 @@ class DiffusionServicer(BackendServicer):
                 model_dir = os.path.join(request.model_path, model_dir)
             with self._lock:   # no torn state visible to GenerateImage
                 self.sd_pipe = None
+                # model-level default scheduler (model YAML `scheduler:`)
+                self.scheduler = request.scheduler or "ddim"
                 if model_dir and os.path.isdir(os.path.join(model_dir, "unet")):
                     # diffusers pipeline directory (reference:
                     # backend/python/diffusers/backend.py LoadModel)
@@ -77,13 +79,34 @@ class DiffusionServicer(BackendServicer):
                     # (rounded to the VAE factor inside txt2img)
                     w = request.width or 512
                     h = request.height or 512
-                    img = self.sd_pipe.txt2img(
-                        request.positive_prompt,
-                        negative_prompt=request.negative_prompt,
-                        height=h, width=w,
-                        steps=request.step or 20,
-                        cfg_scale=float(request.cfg_scale or 7),
-                        seed=request.seed)
+                    scheduler = (request.scheduler
+                                 or getattr(self, "scheduler", "")
+                                 or "ddim")
+                    if request.src:
+                        # img2img (reference: diffusers backend
+                        # backend.py:399-424 — src image + strength)
+                        from PIL import Image
+
+                        init = np.asarray(Image.open(request.src)
+                                          .convert("RGB"))
+                        strength = (float(request.strength)
+                                    if request.HasField("strength") else 0.75)
+                        img = self.sd_pipe.img2img(
+                            request.positive_prompt, init,
+                            negative_prompt=request.negative_prompt,
+                            strength=strength,
+                            steps=request.step or 20,
+                            cfg_scale=float(request.cfg_scale or 7),
+                            seed=request.seed, scheduler=scheduler)
+                        h, w = img.shape[:2]
+                    else:
+                        img = self.sd_pipe.txt2img(
+                            request.positive_prompt,
+                            negative_prompt=request.negative_prompt,
+                            height=h, width=w,
+                            steps=request.step or 20,
+                            cfg_scale=float(request.cfg_scale or 7),
+                            seed=request.seed, scheduler=scheduler)
                 else:
                     img = diffusion.ddim_sample(
                         self.params, self.cfg,

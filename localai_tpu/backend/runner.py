@@ -129,6 +129,12 @@ class EngineServicer(BackendServicer):
                         or request.dtype == "int8":
                     raise ValueError(
                         "LoRA / int8 quantization are llama-family only")
+                if request.draft_model:
+                    raise ValueError(
+                        "speculative draft models are llama-family only")
+                if "ga_n" in (request.options or ""):
+                    raise ValueError(
+                        "self-extend (group_attn_n) is llama-family only")
             else:
                 cfg = llama.LlamaConfig.from_hf_config(cfg_dict, dtype=dtype)
 

@@ -40,6 +40,7 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         lora_adapter=mc.lora_adapter,
         lora_base=mc.lora_base,
         lora_scale=mc.lora_scale,
+        scheduler=mc.scheduler,
         options=(f"ga_n={mc.group_attn_n},ga_w={mc.group_attn_w}"
                  if mc.group_attn_n > 1 else ""),
     )
@@ -217,15 +218,20 @@ class Capabilities:
 
     def generate_image(self, mc: ModelConfig, positive: str, negative: str,
                        width: int, height: int, steps: int, seed: int,
-                       dst: str, src: str = "", mode: str = "") -> None:
+                       dst: str, src: str = "", mode: str = "",
+                       strength: float = None, scheduler: str = "") -> None:
         lm = self._load(mc)
         lm.mark_busy()
         try:
-            res = lm.client.generate_image(pb.GenerateImageRequest(
+            req = pb.GenerateImageRequest(
                 positive_prompt=positive, negative_prompt=negative,
                 width=width, height=height, step=steps, seed=seed,
                 dst=dst, src=src, mode=mode,
-            ))
+                scheduler=scheduler or mc.scheduler,
+            )
+            if strength is not None:
+                req.strength = float(strength)
+            res = lm.client.generate_image(req)
             if not res.success:
                 raise RuntimeError(res.message or "image generation failed")
         finally:

@@ -18,6 +18,11 @@ from typing import Any, Optional
 
 import yaml
 
+# diffusion schedulers implemented by models/sd.py (single source of
+# truth for YAML validation, the HTTP route, and the sampler itself —
+# importable without pulling in jax)
+SCHEDULERS = ("ddim", "euler", "euler_a", "dpmpp_2m")
+
 
 class Usecase(enum.Flag):
     """Routing flags (reference: backend_config.go:432-548)."""
@@ -122,6 +127,10 @@ class ModelConfig:
     download_files: list = dataclasses.field(default_factory=list)
     # multimodal
     mmproj: str = ""
+    # diffusion (reference: diffusers backend SchedulerType + img2img,
+    # backend.py:169-357): default scheduler for this model
+    # (one of SCHEDULERS below; models/sd.py implements them)
+    scheduler: str = ""
     # speculative decoding (future)
     draft_model: str = ""
     # LoRA (reference: backend.proto LoraAdapter/LoraBase/LoraScale)
@@ -148,6 +157,8 @@ class ModelConfig:
             problems.append(f"context_size must be positive, got {self.context_size}")
         if self.num_slots <= 0:
             problems.append(f"num_slots must be positive, got {self.num_slots}")
+        if self.scheduler and self.scheduler not in SCHEDULERS:
+            problems.append(f"unknown scheduler {self.scheduler!r}")
         if self.group_attn_n < 1:
             problems.append(
                 f"group_attn_n must be >= 1, got {self.group_attn_n}")

@@ -394,6 +394,91 @@ def ddim_timesteps_and_alphas(num_train=1000, steps=20, beta_start=0.00085,
     return ts, alphas_cum
 
 
+from localai_tpu.config.model_config import SCHEDULERS  # noqa: E402
+
+
+def _sigmas_for(ts, alphas_cum) -> np.ndarray:
+    """k-diffusion noise scale per selected timestep: sigma = sqrt((1-a)/a)
+    (descending), terminated with sigma = 0."""
+    sig = np.sqrt((1.0 - alphas_cum[ts]) / alphas_cum[ts])
+    return np.concatenate([sig, [0.0]]).astype(np.float64)
+
+
+def sample_latents(fwd, lat, ctx2, ts, alphas_cum, cfg_scale, rng,
+                   scheduler="ddim", start_index=0):
+    """Run the reverse process on latents with the chosen scheduler.
+
+    ``fwd(lat2, t_vec, ctx2) -> eps2`` is the CFG-batched jitted UNet;
+    ``lat`` enters at step ``start_index`` (img2img skips the early,
+    high-noise steps), already noised appropriately by the caller.
+
+    ddim runs in the variance-preserving (alpha) parameterization; the
+    euler / euler-ancestral / DPM++ 2M samplers use the k-diffusion
+    sigma-space convention (model input scaled by 1/sqrt(sigma^2+1)),
+    matching what the reference's diffusers backend exposes as
+    EulerDiscrete / EulerAncestral / DPMSolverMultistep
+    (backend/python/diffusers/backend.py:169-357)."""
+    if scheduler not in SCHEDULERS:
+        raise ValueError(f"unknown scheduler {scheduler!r}; "
+                         f"expected one of {SCHEDULERS}")
+
+    def cfg_eps(lat_in, t):
+        lat2 = jnp.concatenate([lat_in, lat_in], axis=0)
+        eps2 = fwd(lat2, jnp.full((2,), int(t), jnp.int32), ctx2)
+        eps_u, eps_c = eps2[0:1], eps2[1:2]
+        return eps_u + cfg_scale * (eps_c - eps_u)
+
+    if scheduler == "ddim":
+        for i in range(start_index, len(ts)):
+            t = ts[i]
+            t_prev = ts[i + 1] if i + 1 < len(ts) else -1
+            a_t = float(alphas_cum[t])
+            a_prev = float(alphas_cum[t_prev]) if t_prev >= 0 else 1.0
+            eps = cfg_eps(lat, t)
+            x0 = (lat - math.sqrt(1 - a_t) * eps) / math.sqrt(a_t)
+            lat = math.sqrt(a_prev) * x0 + math.sqrt(1 - a_prev) * eps
+        return lat
+
+    # k-diffusion sigma space: x = lat_vp * sqrt(1 + sigma^2)
+    sig = _sigmas_for(ts, alphas_cum)
+    x = lat * math.sqrt(1.0 + float(sig[start_index]) ** 2)
+    old_denoised = None
+    old_h = None
+    for i in range(start_index, len(ts)):
+        s_i, s_n = float(sig[i]), float(sig[i + 1])
+        eps = cfg_eps(x / math.sqrt(s_i ** 2 + 1.0), ts[i])
+        denoised = x - s_i * eps
+        if scheduler == "euler":
+            x = x + eps * (s_n - s_i)
+        elif scheduler == "euler_a":
+            if s_n > 0:
+                s_up = math.sqrt(s_n ** 2 * (s_i ** 2 - s_n ** 2) / s_i ** 2)
+                s_down = math.sqrt(s_n ** 2 - s_up ** 2)
+            else:
+                s_up, s_down = 0.0, 0.0
+            x = x + eps * (s_down - s_i)
+            if s_up > 0:
+                noise = jnp.asarray(rng.standard_normal(
+                    np.shape(x)).astype(np.float32))
+                x = x + noise * s_up
+        else:  # dpmpp_2m (DPM-Solver++(2M), data prediction, 2nd order)
+            t_i, t_n = -math.log(max(s_i, 1e-10)), \
+                -math.log(max(s_n, 1e-10))
+            h = t_n - t_i
+            if old_denoised is None or s_n == 0:
+                d = denoised
+            else:
+                r = old_h / h
+                d = (1 + 1 / (2 * r)) * denoised - (1 / (2 * r)) * old_denoised
+            if s_n == 0:
+                x = denoised
+            else:
+                x = (s_n / s_i) * x - math.expm1(-h) * d
+            old_denoised = denoised
+            old_h = h
+    return x   # sigma ended at 0 -> VP latents
+
+
 @dataclasses.dataclass
 class SDPipeline:
     """Loaded diffusers-layout pipeline (text encoder + unet + vae)."""
@@ -451,48 +536,89 @@ class SDPipeline:
                 ids[0, i] = (ord(ch) * 7919) % self.clip_cfg.vocab_size
         return clip_text_encode(self.clip, self.clip_cfg, ids)
 
-    def txt2img(self, prompt: str, negative_prompt: str = "",
-                height: int = 512, width: int = 512, steps: int = 20,
-                cfg_scale: float = 7.5, seed: int = 0) -> np.ndarray:
-        """-> uint8 image [H, W, 3] (dims rounded DOWN to the VAE's
-        spatial factor). CFG DDIM (eta=0), SD semantics."""
-        ctx = self.encode_prompt(prompt)
-        ctx_neg = self.encode_prompt(negative_prompt)
-        ctx2 = jnp.concatenate([ctx_neg, ctx], axis=0)
-
-        # proto seed is signed int32; negative means "pick for me"
-        rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
-        # VAE spatial factor: 2 per downsampling block (SD-1.x: 4 blocks -> 8x)
-        vsf = 2 ** (len(self.vae_cfg.block_out_channels) - 1)
-        height = max(height - height % vsf, vsf)
-        width = max(width - width % vsf, vsf)
-        h8, w8 = height // vsf, width // vsf
-        lat = jnp.asarray(rng.standard_normal(
-            (1, self.unet_cfg.in_channels, h8, w8)).astype(np.float32))
-        ts, alphas = ddim_timesteps_and_alphas(steps=steps)
-
+    def _get_fwd(self):
         if self._fwd is None:
             # weights enter as an ARGUMENT: a per-call closure would both
             # recompile every request and bake the weights in as constants
             cfg_ = self.unet_cfg
             self._fwd = jax.jit(
                 lambda p_, l, t, c: unet_forward(p_, cfg_, l, t, c))
-        fwd = lambda l, t, c: self._fwd(self.unet, l, t, c)
-        for i, t in enumerate(ts):
-            t_prev = ts[i + 1] if i + 1 < len(ts) else -1
-            a_t = float(alphas[t])
-            a_prev = float(alphas[t_prev]) if t_prev >= 0 else 1.0
-            lat2 = jnp.concatenate([lat, lat], axis=0)
-            eps2 = fwd(lat2, jnp.full((2,), t, jnp.int32), ctx2)
-            eps_u, eps_c = eps2[0:1], eps2[1:2]
-            eps = eps_u + cfg_scale * (eps_c - eps_u)
-            x0 = (lat - math.sqrt(1 - a_t) * eps) / math.sqrt(a_t)
-            lat = math.sqrt(a_prev) * x0 + math.sqrt(1 - a_prev) * eps
+        return lambda l, t, c: self._fwd(self.unet, l, t, c)
 
+    def _ctx2(self, prompt: str, negative_prompt: str):
+        ctx = self.encode_prompt(prompt)
+        ctx_neg = self.encode_prompt(negative_prompt)
+        return jnp.concatenate([ctx_neg, ctx], axis=0)
+
+    @property
+    def _vsf(self) -> int:
+        # VAE spatial factor: 2 per downsampling block (SD-1.x: 4 -> 8x)
+        return 2 ** (len(self.vae_cfg.block_out_channels) - 1)
+
+    def _decode_image(self, lat) -> np.ndarray:
         img = vae_decode(self.vae, self.vae_cfg,
                          lat / self.vae_cfg.scaling_factor)
         img = np.asarray(jnp.clip((img + 1) / 2, 0, 1))[0]
         return (img.transpose(1, 2, 0) * 255).astype(np.uint8)
+
+    def txt2img(self, prompt: str, negative_prompt: str = "",
+                height: int = 512, width: int = 512, steps: int = 20,
+                cfg_scale: float = 7.5, seed: int = 0,
+                scheduler: str = "ddim") -> np.ndarray:
+        """-> uint8 image [H, W, 3] (dims rounded DOWN to the VAE's
+        spatial factor). CFG + selectable scheduler, SD semantics."""
+        ctx2 = self._ctx2(prompt, negative_prompt)
+        # proto seed is signed int32; negative means "pick for me"
+        rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
+        vsf = self._vsf
+        height = max(height - height % vsf, vsf)
+        width = max(width - width % vsf, vsf)
+        lat = jnp.asarray(rng.standard_normal(
+            (1, self.unet_cfg.in_channels, height // vsf, width // vsf)
+        ).astype(np.float32))
+        ts, alphas = ddim_timesteps_and_alphas(steps=steps)
+        lat = sample_latents(self._get_fwd(), lat, ctx2, ts, alphas,
+                             cfg_scale, rng, scheduler=scheduler)
+        return self._decode_image(lat)
+
+    def img2img(self, prompt: str, init_image: np.ndarray,
+                negative_prompt: str = "", strength: float = 0.75,
+                steps: int = 20, cfg_scale: float = 7.5, seed: int = 0,
+                scheduler: str = "ddim") -> np.ndarray:
+        """init_image uint8 [H, W, 3] -> uint8 image (same VAE-rounded
+        dims). Diffusers img2img semantics (reference:
+        backend/python/diffusers/backend.py:399-424): the init image is
+        VAE-encoded, noised to the schedule point selected by
+        ``strength`` (1.0 = ignore the init image, ~0 = keep it), and
+        denoised from there."""
+        strength = min(max(float(strength), 0.0), 1.0)
+        ctx2 = self._ctx2(prompt, negative_prompt)
+        rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
+        vsf = self._vsf
+        H = max(init_image.shape[0] - init_image.shape[0] % vsf, vsf)
+        W = max(init_image.shape[1] - init_image.shape[1] % vsf, vsf)
+        img = init_image[:H, :W].astype(np.float32) / 255.0 * 2.0 - 1.0
+        img = jnp.asarray(img.transpose(2, 0, 1)[None])
+        noise_enc = jnp.asarray(rng.standard_normal(
+            (1, self.unet_cfg.in_channels, H // vsf, W // vsf)
+        ).astype(np.float32))
+        lat0 = vae_encode(self.vae, self.vae_cfg, img,
+                          noise=noise_enc) * self.vae_cfg.scaling_factor
+
+        ts, alphas = ddim_timesteps_and_alphas(steps=steps)
+        # skip the first (1-strength) of the schedule; start from the
+        # init latent noised to that point
+        start = min(int(round((1.0 - strength) * len(ts))), len(ts) - 1)
+        if strength <= 0.0:
+            return self._decode_image(lat0)
+        noise = jnp.asarray(rng.standard_normal(
+            np.shape(lat0)).astype(np.float32))
+        a_start = float(alphas[ts[start]])
+        lat = math.sqrt(a_start) * lat0 + math.sqrt(1 - a_start) * noise
+        lat = sample_latents(self._get_fwd(), lat, ctx2, ts, alphas,
+                             cfg_scale, rng, scheduler=scheduler,
+                             start_index=start)
+        return self._decode_image(lat)
 
 
 # ---------------- tiny-checkpoint generators (tests/export) ----------------

@@ -1,6 +1,7 @@
 """Stable-Diffusion-class stack: CLIP text parity vs torch transformers,
 diffusers-layout UNet/VAE structural load, end-to-end txt2img."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -108,3 +109,255 @@ def test_diffusion_servicer_routes_diffusers_dirs(tmp_path):
 
     im = Image.open(dst)
     assert im.size == (32, 32)
+
+
+# ---------------- r4: torch block cross-checks (VERDICT #7) ----------------
+# diffusers is not installed here, so the oracles are HAND-BUILT torch
+# modules implementing the documented SD block semantics (ResnetBlock2D,
+# Transformer2DModel with GEGLU, VAE attention) over the SAME weights.
+
+def _np_weights(shapes, seed=0):
+    rng = np.random.default_rng(seed)
+    return {k: rng.standard_normal(s).astype(np.float32) * 0.1
+            for k, s in shapes.items()}
+
+
+def test_unet_resnet_block_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    cin, cout, temb_dim, groups = 16, 32, 24, 8
+    w = _np_weights({
+        "norm1.weight": (cin,), "norm1.bias": (cin,),
+        "conv1.weight": (cout, cin, 3, 3), "conv1.bias": (cout,),
+        "time_emb_proj.weight": (cout, temb_dim),
+        "time_emb_proj.bias": (cout,),
+        "norm2.weight": (cout,), "norm2.bias": (cout,),
+        "conv2.weight": (cout, cout, 3, 3), "conv2.bias": (cout,),
+        "conv_shortcut.weight": (cout, cin, 1, 1), "conv_shortcut.bias": (cout,),
+    }, seed=1)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, cin, 8, 8)).astype(np.float32)
+    temb = rng.standard_normal((2, temb_dim)).astype(np.float32)
+
+    got = np.asarray(sd._resnet(sd._P({k: jnp.asarray(v)
+                                       for k, v in w.items()}),
+                                jnp.asarray(x), jnp.asarray(temb), groups))
+
+    with torch.no_grad():
+        tx = torch.tensor(x)
+        h = F.group_norm(tx, groups, torch.tensor(w["norm1.weight"]),
+                         torch.tensor(w["norm1.bias"]), eps=1e-5)
+        h = F.conv2d(F.silu(h), torch.tensor(w["conv1.weight"]),
+                     torch.tensor(w["conv1.bias"]), padding=1)
+        t = F.linear(F.silu(torch.tensor(temb)),
+                     torch.tensor(w["time_emb_proj.weight"]),
+                     torch.tensor(w["time_emb_proj.bias"]))
+        h = h + t[:, :, None, None]
+        h = F.group_norm(h, groups, torch.tensor(w["norm2.weight"]),
+                         torch.tensor(w["norm2.bias"]), eps=1e-5)
+        h = F.conv2d(F.silu(h), torch.tensor(w["conv2.weight"]),
+                     torch.tensor(w["conv2.bias"]), padding=1)
+        sc = F.conv2d(tx, torch.tensor(w["conv_shortcut.weight"]),
+                      torch.tensor(w["conv_shortcut.bias"]))
+        want = (sc + h).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_unet_attn_block_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    C, heads, groups, ctx_dim, ff = 16, 2, 8, 12, 32
+    names = {
+        "norm.weight": (C,), "norm.bias": (C,),
+        "proj_in.weight": (C, C), "proj_in.bias": (C,),
+        "proj_out.weight": (C, C), "proj_out.bias": (C,),
+    }
+    tb = "transformer_blocks.0."
+    for n in ("norm1", "norm2", "norm3"):
+        names[tb + n + ".weight"] = (C,)
+        names[tb + n + ".bias"] = (C,)
+    for a, kvdim in (("attn1", C), ("attn2", ctx_dim)):
+        names[tb + a + ".to_q.weight"] = (C, C)
+        names[tb + a + ".to_k.weight"] = (C, kvdim)
+        names[tb + a + ".to_v.weight"] = (C, kvdim)
+        names[tb + a + ".to_out.0.weight"] = (C, C)
+        names[tb + a + ".to_out.0.bias"] = (C,)
+    names[tb + "ff.net.0.proj.weight"] = (2 * ff, C)
+    names[tb + "ff.net.0.proj.bias"] = (2 * ff,)
+    names[tb + "ff.net.2.weight"] = (C, ff)
+    names[tb + "ff.net.2.bias"] = (C,)
+    w = _np_weights(names, seed=3)
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, C, 4, 4)).astype(np.float32)
+    ctx = rng.standard_normal((1, 5, ctx_dim)).astype(np.float32)
+
+    got = np.asarray(sd._attn_block(
+        sd._P({k: jnp.asarray(v) for k, v in w.items()}),
+        jnp.asarray(x), jnp.asarray(ctx), heads, groups))
+
+    def t(name):
+        return torch.tensor(w[name])
+
+    with torch.no_grad():
+        tx = torch.tensor(x)
+        h = F.group_norm(tx, groups, t("norm.weight"), t("norm.bias"),
+                         eps=1e-5)
+        h = h.reshape(1, C, 16).permute(0, 2, 1)
+        h = F.linear(h, t("proj_in.weight"), t("proj_in.bias"))
+
+        def mha(pre, q_in, kv_in):
+            hd = C // heads
+            q = F.linear(q_in, t(tb + pre + ".to_q.weight")).reshape(
+                1, -1, heads, hd)
+            k = F.linear(kv_in, t(tb + pre + ".to_k.weight")).reshape(
+                1, -1, heads, hd)
+            v = F.linear(kv_in, t(tb + pre + ".to_v.weight")).reshape(
+                1, -1, heads, hd)
+            wts = torch.softmax(
+                torch.einsum("bthd,bshd->bhts", q, k) / hd ** 0.5, dim=-1)
+            o = torch.einsum("bhts,bshd->bthd", wts, v).reshape(1, -1, C)
+            return F.linear(o, t(tb + pre + ".to_out.0.weight"),
+                            t(tb + pre + ".to_out.0.bias"))
+
+        n1 = F.layer_norm(h, (C,), t(tb + "norm1.weight"),
+                          t(tb + "norm1.bias"))
+        h = h + mha("attn1", n1, n1)
+        n2 = F.layer_norm(h, (C,), t(tb + "norm2.weight"),
+                          t(tb + "norm2.bias"))
+        h = h + mha("attn2", n2, torch.tensor(ctx))
+        n3 = F.layer_norm(h, (C,), t(tb + "norm3.weight"),
+                          t(tb + "norm3.bias"))
+        proj = F.linear(n3, t(tb + "ff.net.0.proj.weight"),
+                        t(tb + "ff.net.0.proj.bias"))
+        a, gate = proj.chunk(2, dim=-1)
+        ffo = a * F.gelu(gate)
+        h = h + F.linear(ffo, t(tb + "ff.net.2.weight"),
+                         t(tb + "ff.net.2.bias"))
+        h = F.linear(h, t("proj_out.weight"), t("proj_out.bias"))
+        want = (h.permute(0, 2, 1).reshape(1, C, 4, 4) + tx).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_vae_attn_torch_parity():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    C, groups = 16, 8
+    w = _np_weights({
+        "group_norm.weight": (C,), "group_norm.bias": (C,),
+        "to_q.weight": (C, C), "to_q.bias": (C,),
+        "to_k.weight": (C, C), "to_k.bias": (C,),
+        "to_v.weight": (C, C), "to_v.bias": (C,),
+        "to_out.0.weight": (C, C), "to_out.0.bias": (C,),
+    }, seed=5)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((1, C, 4, 4)).astype(np.float32)
+    got = np.asarray(sd._vae_attn(
+        sd._P({k: jnp.asarray(v) for k, v in w.items()}),
+        jnp.asarray(x), groups))
+
+    def t(name):
+        return torch.tensor(w[name])
+
+    with torch.no_grad():
+        tx = torch.tensor(x)
+        h = F.group_norm(tx, groups, t("group_norm.weight"),
+                         t("group_norm.bias"), eps=1e-5)
+        flat = h.reshape(1, C, 16).permute(0, 2, 1)
+        q = F.linear(flat, t("to_q.weight"), t("to_q.bias"))
+        k = F.linear(flat, t("to_k.weight"), t("to_k.bias"))
+        v = F.linear(flat, t("to_v.weight"), t("to_v.bias"))
+        wts = torch.softmax(q @ k.permute(0, 2, 1) / C ** 0.5, dim=-1)
+        o = F.linear(wts @ v, t("to_out.0.weight"), t("to_out.0.bias"))
+        want = (tx + o.permute(0, 2, 1).reshape(1, C, 4, 4)).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_timestep_embedding_formula():
+    """flip_sin_to_cos=True, downscale_freq_shift=0 (SD UNet settings)."""
+    import math as m
+
+    t = np.array([0, 7, 500], np.int64)
+    dim = 32
+    half = dim // 2
+    freqs = np.exp(-m.log(10000) * np.arange(half) / half)
+    args = t[:, None].astype(np.float64) * freqs[None]
+    want = np.concatenate([np.cos(args), np.sin(args)], axis=-1)
+    got = np.asarray(sd._timestep_embedding(jnp.asarray(t), dim))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+# ---------------- r4: schedulers + img2img ----------------
+
+def test_schedulers_produce_distinct_deterministic_images(tmp_path):
+    clip, unet, vae = _tiny_cfgs()
+    pipe_dir = str(tmp_path / "pipe")
+    sd.save_tiny_pipeline(pipe_dir, clip, unet, vae)
+    pipe = sd.SDPipeline.load(pipe_dir)
+    imgs = {}
+    for sched in sd.SCHEDULERS:
+        a = pipe.txt2img("a fox", height=32, width=32, steps=4,
+                         cfg_scale=3.0, seed=11, scheduler=sched)
+        b = pipe.txt2img("a fox", height=32, width=32, steps=4,
+                         cfg_scale=3.0, seed=11, scheduler=sched)
+        np.testing.assert_array_equal(a, b)
+        imgs[sched] = a
+    # the samplers genuinely differ
+    assert any(np.abs(imgs["ddim"].astype(int)
+                      - imgs[s].astype(int)).max() > 0
+               for s in ("euler", "euler_a", "dpmpp_2m"))
+    with pytest.raises(ValueError):
+        pipe.txt2img("x", height=32, width=32, steps=2, scheduler="plms")
+
+
+def test_img2img_strength_semantics(tmp_path):
+    clip, unet, vae = _tiny_cfgs()
+    pipe_dir = str(tmp_path / "pipe")
+    sd.save_tiny_pipeline(pipe_dir, clip, unet, vae)
+    pipe = sd.SDPipeline.load(pipe_dir)
+    rng = np.random.default_rng(0)
+    init = rng.integers(0, 255, size=(32, 32, 3)).astype(np.uint8)
+
+    recon = pipe.img2img("a fox", init, strength=0.0, steps=4, seed=5)
+    low = pipe.img2img("a fox", init, strength=0.3, steps=4, seed=5)
+    high = pipe.img2img("a fox", init, strength=1.0, steps=4, seed=5)
+    assert recon.shape == (32, 32, 3)
+
+    def d(a, b):
+        return float(np.mean((a.astype(float) - b.astype(float)) ** 2))
+
+    # low strength stays closer to the strength-0 reconstruction than a
+    # full-strength resample does
+    assert d(low, recon) < d(high, recon)
+    # determinism
+    np.testing.assert_array_equal(
+        low, pipe.img2img("a fox", init, strength=0.3, steps=4, seed=5))
+
+
+def test_diffusion_servicer_img2img_and_scheduler(tmp_path):
+    from PIL import Image
+
+    from localai_tpu.backend import contract_pb2 as pb
+    from localai_tpu.backend.diffusion_runner import DiffusionServicer
+
+    clip, unet, vae = _tiny_cfgs()
+    pipe_dir = str(tmp_path / "pipe")
+    sd.save_tiny_pipeline(pipe_dir, clip, unet, vae)
+
+    s = DiffusionServicer()
+    r = s.LoadModel(pb.ModelOptions(model=pipe_dir, scheduler="euler"), None)
+    assert r.success, r.message
+    assert s.scheduler == "euler"
+
+    rng = np.random.default_rng(1)
+    src = str(tmp_path / "init.png")
+    Image.fromarray(rng.integers(0, 255, size=(32, 32, 3))
+                    .astype(np.uint8)).save(src)
+    dst = str(tmp_path / "out.png")
+    r = s.GenerateImage(pb.GenerateImageRequest(
+        positive_prompt="a pelican", step=3, seed=3, dst=dst, src=src,
+        strength=0.5, scheduler="dpmpp_2m"), None)
+    assert r.success, r.message
+    assert Image.open(dst).size == (32, 32)
