@@ -240,7 +240,7 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
         limits = httpx.Limits(max_connections=S + 4)
         async with httpx.AsyncClient(timeout=timeout, limits=limits) as client:
             # warmup: trigger model load + jit warm, one full round
-            warm = [one_stream(client, 2 * max_new) for _ in range(S)]
+            warm = [one_stream(client, max_new) for _ in range(S)]
             await asyncio.gather(*warm)
             t0 = time.monotonic()
             tasks = [asyncio.create_task(consumer(client, i))
@@ -473,7 +473,8 @@ def bench_kernel(cfg, S, C, steps, inner):
 def main():
     prompt_len = int(os.environ.get("LOCALAI_BENCH_PROMPT", "128"))
     max_new = int(os.environ.get("LOCALAI_BENCH_NEW", "128"))
-    target = int(os.environ.get("LOCALAI_BENCH_TOKENS", "8192"))
+    # default sized so the 8B HTTP measurement finishes in ~8 min
+    target = int(os.environ.get("LOCALAI_BENCH_TOKENS", "4096"))
 
     if "--engine" in sys.argv or "--kernel" in sys.argv:
         # engine-direct / kernel modes own the chip in-process
@@ -515,12 +516,14 @@ def main():
         return
 
     # DEFAULT: the BASELINE.json metric — /v1/chat/completions over real
-    # HTTP with SSE. The parent process pins itself to the CPU platform
-    # (config, not env — the spawned backend must still see the chip).
+    # HTTP with SSE, on the 8B (north-star model) preset. The parent
+    # process pins itself to the CPU platform (config, not env — the
+    # spawned backend must still see the chip). Add presets via
+    # LOCALAI_BENCH_PRESETS=8b,1b.
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b,1b").split(",")
+    presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
     errors = {}
@@ -534,11 +537,19 @@ def main():
     primary = "8b" if "8b" in results else sorted(results)[0]
     r = results[primary]
     qtag = "int8" if HTTP_PRESETS.get(primary, {}).get("quant") == "int8" else "bf16"
+    # BASELINE.json's north star is >2000 tok/s AGGREGATE on a v5e-8 for
+    # Llama-3.1-8B on /v1/chat/completions = 250 tok/s/chip; this bench
+    # measures tokens/sec/chip on one chip, so vs_baseline compares
+    # per-chip rates (request-level dp across 8 chips scales linearly)
+    per_chip_target = 250.0 if primary == "8b" else 2000.0
     line = {
         "metric": (f"http_chat_tok_s_per_chip_llama_{primary}_{qtag}_slots"
                    f"{int(os.environ.get('LOCALAI_BENCH_SLOTS', HTTP_PRESETS[primary]['slots']))}"),
         "value": round(r["tok_s"], 1), "unit": "tok/s",
-        "vs_baseline": round(r["tok_s"] / 2000.0, 3),
+        "vs_baseline": round(r["tok_s"] / per_chip_target, 3),
+        "baseline_note": ("north_star 2000 tok/s aggregate on v5e-8 = "
+                          "250 tok/s/chip" if primary == "8b" else
+                          "vs 2000 tok/s"),
         "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
         "p95_ttft_ms": round(r["p95_ttft_ms"], 1),
         "unloaded_ttft_ms": round(r["unloaded_ttft_ms"], 1),

@@ -376,16 +376,25 @@ async def images_generations(request):
                          "invalid_request_error")
     strength = body.get("strength")
     if strength is not None:
+        import math as _math
+
         try:
             strength = float(strength)
         except (TypeError, ValueError):
-            return api_error("strength must be a number", 400,
+            strength = None
+        if strength is None or not _math.isfinite(strength):
+            return api_error("strength must be a finite number", 400,
                              "invalid_request_error")
     src = ""
     if body.get("file"):
         data = body["file"]
         if isinstance(data, str) and data.startswith("data:"):
-            data = data.partition(",")[2]
+            # same contract as chatflow._fetch_media: only base64 data URIs
+            head, sep, payload = data.partition("base64,")
+            if not sep:
+                return api_error("unsupported data URI (base64 only)", 400,
+                                 "invalid_request_error")
+            data = payload
         try:
             raw = base64.b64decode(data)
         except Exception:
@@ -400,14 +409,18 @@ async def images_generations(request):
         for i in range(n):
             dst = os.path.join(tempfile.gettempdir(),
                                f"localai-img-{secrets.token_hex(8)}.png")
-            # n > 1 must produce n DIFFERENT samples: offset the seed per
-            # image (a fixed seed otherwise reseeds the sampler
-            # identically n times)
+            # n > 1 must produce n DIFFERENT samples: offset the seed
+            # per image (a fixed seed otherwise reseeds the sampler
+            # identically n times). Offsets wrap inside int32 (the proto
+            # field); negative = "pick for me" -> fresh entropy per image.
+            if base_seed >= 0:
+                seed_i = (base_seed + i) % 0x7FFFFFFF
+            else:
+                seed_i = secrets.randbits(31)
             await state.run_blocking(
                 state.caps.generate_image, mc, positive.strip(),
                 negative.strip(), width, height, int(body.get("step", 25)),
-                base_seed + i if base_seed >= 0 else base_seed - i,
-                dst, src, str(body.get("mode", "") or ""),
+                seed_i, dst, src, str(body.get("mode", "") or ""),
                 strength, scheduler)
             if body.get("response_format") == "b64_json":
                 with open(dst, "rb") as f:

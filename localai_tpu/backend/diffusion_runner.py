@@ -98,7 +98,10 @@ class DiffusionServicer(BackendServicer):
                             steps=request.step or 20,
                             cfg_scale=float(request.cfg_scale or 7),
                             seed=request.seed, scheduler=scheduler)
-                        h, w = img.shape[:2]
+                        # requested size still applies (resized below,
+                        # like the other branches); default = init size
+                        h = request.height or img.shape[0]
+                        w = request.width or img.shape[1]
                     else:
                         img = self.sd_pipe.txt2img(
                             request.positive_prompt,
@@ -108,6 +111,14 @@ class DiffusionServicer(BackendServicer):
                             cfg_scale=float(request.cfg_scale or 7),
                             seed=request.seed, scheduler=scheduler)
                 else:
+                    if request.src or request.scheduler or \
+                            request.HasField("strength"):
+                        # these are diffusers-pipeline features; silently
+                        # returning an unrelated txt2img would be worse
+                        return pb.Result(
+                            success=False,
+                            message="img2img/scheduler/strength require a "
+                                    "diffusers pipeline directory")
                     img = diffusion.ddim_sample(
                         self.params, self.cfg,
                         prompt=request.positive_prompt,

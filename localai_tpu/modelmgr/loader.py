@@ -53,6 +53,8 @@ class LoadedModel:
         self.server = server  # in-process grpc server (embedded backends)
         self.last_used = time.monotonic()
         self.busy = 0
+        self.health_fails = 0     # consecutive failed idle health probes
+        self.first_fail_t = 0.0   # when the current failure streak began
         self.watchdog = None  # set by ModelLoader when a watchdog is attached
         self._lock = threading.Lock()
 
@@ -68,6 +70,8 @@ class LoadedModel:
             self.busy = max(0, self.busy - 1)
             idle = self.busy == 0
             self.last_used = time.monotonic()
+            # a completed request is the strongest health signal there is
+            self.health_fails = 0
         if idle and self.watchdog is not None:
             self.watchdog.mark(self.model_id, False)
 
@@ -118,10 +122,42 @@ class ModelLoader:
             with self._lock:
                 lm = self.models.get(model_id)
             if lm is not None:
-                if self._healthy(lm):
-                    lm.last_used = time.monotonic()
+                # a BUSY backend is alive by definition (requests are
+                # streaming through it) — probing it with a short-timeout
+                # health RPC under load is how r4's bench watched the
+                # loader KILL a healthy, saturated backend mid-serving
+                # (the gRPC thread can answer slowly when the host core
+                # is contended). Idle backends are probed, but a single
+                # failed/timed-out probe must NOT kill a live process
+                # either (same failure mode, observed in a busy==0 gap):
+                # respawn only when the process is actually dead or three
+                # consecutive probes failed. A truly wedged-but-alive
+                # backend is the watchdog's job (busy-too-long kills).
+                dead = lm.process is not None and not lm.process.alive()
+                now = time.monotonic()
+                if not dead and lm.busy > 0:
+                    lm.last_used = now
                     return lm
-                log.warning("model %s backend unhealthy; respawning", model_id)
+                if not dead and self._healthy(lm):
+                    lm.health_fails = 0
+                    lm.last_used = now
+                    return lm
+                if lm.health_fails == 0:
+                    lm.first_fail_t = now
+                lm.health_fails += 1
+                # back-to-back probes inside one transient stall must not
+                # exhaust the strikes: require >= 3 failures SPREAD over
+                # >= 30s before replacing a live process
+                if not dead and (lm.health_fails < 3
+                                 or now - lm.first_fail_t < 30.0):
+                    log.warning("model %s health probe failed (%d); "
+                                "keeping the live backend", model_id,
+                                lm.health_fails)
+                    lm.last_used = now
+                    return lm
+                log.warning("model %s backend %s; respawning", model_id,
+                            "process died" if dead else
+                            "unhealthy repeatedly")
                 with self._lock:
                     self._drop(model_id)
             if self.single_active:
@@ -196,7 +232,7 @@ class ModelLoader:
     def _healthy(self, lm: LoadedModel) -> bool:
         if lm.process is not None and not lm.process.alive():
             return False
-        return lm.client.health(timeout=2.0)
+        return lm.client.health(timeout=5.0)
 
     # ---- queries ----
 
