@@ -58,16 +58,19 @@ PRESETS = {
                   num_layers=2, num_heads=8, num_kv_heads=8, head_dim=16),
 }
 
-# serving shape per preset: (slots, context, quantization)
+# serving shape per preset: (slots, context, quantization, kv dtype).
+# 8b runs int8 weights AND int8 KV: r4 pinned decode at this rig's HBM
+# roofline at 16 slots with the KV the capacity limiter — int8 KV halves
+# it, so 32 slots amortize the same weight read over 2x the tokens.
 HTTP_PRESETS = {
-    "1b": dict(slots=32, ctx=1024, quant=""),
-    "8b": dict(slots=16, ctx=1024, quant="int8"),
-    "smoke": dict(slots=2, ctx=128, quant=""),   # CPU-safe harness check
+    "1b": dict(slots=32, ctx=1024, quant="", kv=""),
+    "8b": dict(slots=32, ctx=1024, quant="int8", kv="int8"),
+    "smoke": dict(slots=2, ctx=128, quant="", kv=""),  # CPU-safe harness check
 }
 
 
 def _write_bench_model(models_dir: str, preset: str, slots: int, ctx: int,
-                       quant: str) -> None:
+                       quant: str, kv: str = "") -> None:
     """config.json-only checkpoint (random weights via the gated loader
     fallback) + a size-matched word-level tokenizer + model YAML."""
     import json as _json
@@ -116,6 +119,7 @@ context_size: {ctx}
 num_slots: {slots}
 dtype: bfloat16
 quantization: "{quant}"
+kv_cache_dtype: "{kv or 'bfloat16'}"
 prefill_buckets: [128, 512]
 template:
   completion: "{{{{ Input }}}}"
@@ -142,8 +146,9 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
 
     hp = HTTP_PRESETS[preset]
     S = int(os.environ.get("LOCALAI_BENCH_SLOTS", hp["slots"]))
+    kv = os.environ.get("LOCALAI_BENCH_KV", hp.get("kv", ""))
     models = tempfile.mkdtemp(prefix=f"bench-{preset}-")
-    _write_bench_model(models, preset, S, hp["ctx"], hp["quant"])
+    _write_bench_model(models, preset, S, hp["ctx"], hp["quant"], kv)
 
     os.environ["LOCALAI_ALLOW_RANDOM_WEIGHTS"] = "1"
     os.environ["LOCALAI_JAX_PLATFORM"] = os.environ.get(
@@ -190,9 +195,13 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
         ids = rng.integers(3, V, size=n)
         return " ".join(f"t{i}" for i in ids)
 
+    n_runs = int(os.environ.get("LOCALAI_BENCH_RUNS", "3"))
+
     async def drive():
-        results = {"completed": 0, "ttfts": [], "errors": []}
-        stop = asyncio.Event()
+        """Boot-once, measure n_runs times (median-of-n with min/max —
+        VERDICT r4 weak #7: one run's number is unattributable above the
+        tunnel-noise floor), then take the unloaded TTFT floor."""
+        errors = []  # shared across warmup / passes / unloaded probes
 
         async def one_stream(client, n_new):
             body = {"model": model, "stream": True, "ignore_eos": True,
@@ -205,7 +214,7 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
             async with client.stream("POST", f"{base}/v1/chat/completions",
                                      json=body) as r:
                 if r.status_code != 200:
-                    results["errors"].append(await r.aread())
+                    errors.append(await r.aread())
                     return 0, None
                 async for line in r.aiter_lines():
                     if not line.startswith("data: "):
@@ -223,18 +232,27 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
                                                       usage_ct)
             return usage_ct, ttft
 
-        async def consumer(client, tid):
-            first = True
-            while not stop.is_set():
-                n_new = (max(8, max_new - (tid * max_new) // S)
-                         if first else max_new)
-                first = False
-                ct, ttft = await one_stream(client, n_new)
-                results["completed"] += ct
-                if ttft is not None:
-                    results["ttfts"].append(ttft)
-                if results["completed"] >= target_tokens or results["errors"]:
-                    stop.set()
+        async def one_pass(client):
+            results = {"completed": 0, "ttfts": []}
+            stop = asyncio.Event()
+
+            async def consumer(tid):
+                first = True
+                while not stop.is_set():
+                    n_new = (max(8, max_new - (tid * max_new) // S)
+                             if first else max_new)
+                    first = False
+                    ct, ttft = await one_stream(client, n_new)
+                    results["completed"] += ct
+                    if ttft is not None:
+                        results["ttfts"].append(ttft)
+                    if results["completed"] >= target_tokens or errors:
+                        stop.set()
+
+            t0 = time.monotonic()
+            tasks = [asyncio.create_task(consumer(i)) for i in range(S)]
+            await asyncio.gather(*tasks)
+            return results, time.monotonic() - t0
 
         timeout = httpx.Timeout(connect=60, read=3600, write=60, pool=3600)
         limits = httpx.Limits(max_connections=S + 4)
@@ -242,34 +260,38 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
             # warmup: trigger model load + jit warm, one full round
             warm = [one_stream(client, max_new) for _ in range(S)]
             await asyncio.gather(*warm)
-            t0 = time.monotonic()
-            tasks = [asyncio.create_task(consumer(client, i))
-                     for i in range(S)]
-            await asyncio.gather(*tasks)
-            wall = time.monotonic() - t0
+            passes = []
+            for _ in range(n_runs):
+                passes.append(await one_pass(client))
+                if errors:
+                    break
             # unloaded TTFT floor: single stream against the idle server
             unloaded = []
             for _ in range(3):
                 _, ttft = await one_stream(client, 4)
                 if ttft is not None:
                     unloaded.append(ttft)
-        return results, wall, unloaded
+        return passes, unloaded, errors
 
     try:
-        results, wall, unloaded = asyncio.run(drive())
+        passes, unloaded, errors = asyncio.run(drive())
     finally:
         loader.stop_all()
         loop.call_soon_threadsafe(loop.stop)
-    if results["errors"]:
-        raise RuntimeError(str(results["errors"][0])[:500])
-    ttfts = results["ttfts"]
+    if errors:
+        raise RuntimeError(str(errors[0])[:500])
+    rates = [res["completed"] / wall for res, wall in passes]
+    ttfts = [t for res, _ in passes for t in res["ttfts"]]
     return {
-        "tok_s": results["completed"] / wall,
+        "tok_s": float(np.median(rates)),
+        "tok_s_min": float(np.min(rates)),
+        "tok_s_max": float(np.max(rates)),
+        "n_runs": len(rates),
         "p50_ttft_ms": float(np.percentile(ttfts, 50) * 1e3),
         "p95_ttft_ms": float(np.percentile(ttfts, 95) * 1e3),
         "unloaded_ttft_ms": float(np.median(unloaded) * 1e3) if unloaded else 0.0,
-        "completion_tokens": results["completed"],
-        "wall_s": wall,
+        "completion_tokens": int(sum(res["completed"] for res, _ in passes)),
+        "wall_s": float(sum(w for _, w in passes)),
     }
 
 
@@ -294,9 +316,13 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         # serving tunnel's per-op/prefill overheads outweigh it end-to-end,
         # so bf16 is the default headline; int8 remains opt-in
         params = llama.quantize_params(params)
+    import jax.numpy as jnp
+    cache_dtype = (jnp.int8 if os.environ.get("LOCALAI_BENCH_KV", "") == "int8"
+                   else jnp.bfloat16)
     ecfg = eng.EngineConfig(num_slots=S, max_context=C,
                             prefill_buckets=(prompt_len, 512),
-                            prefill_chunk=512, decode_burst=burst)
+                            prefill_chunk=512, decode_burst=burst,
+                            cache_dtype=cache_dtype)
     engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                         eos_token_ids={cfg.vocab_size - 1})
     engine.start(precompile=True)
@@ -536,24 +562,80 @@ def main():
         raise RuntimeError(f"no preset completed: {errors}")
     primary = "8b" if "8b" in results else sorted(results)[0]
     r = results[primary]
+    # effective config = preset value unless env-overridden (bench_http
+    # honors the same overrides; labels and the engine-direct subprocess
+    # must describe what actually ran)
+    eff_kv = os.environ.get("LOCALAI_BENCH_KV",
+                            HTTP_PRESETS[primary].get("kv", ""))
     qtag = "int8" if HTTP_PRESETS.get(primary, {}).get("quant") == "int8" else "bf16"
+    kvtag = "kvint8" if eff_kv == "int8" else ""
+
+    # engine-direct same-preset measurement in a FRESH subprocess (the
+    # HTTP backend subprocess released the chip when the loader stopped):
+    # makes the HTTP-path overhead computable on the 8B (VERDICT r4 #2 —
+    # r4 published engine-direct numbers for the 1b only)
+    engine_direct = None
+    engine_direct_err = None
+    if os.environ.get("LOCALAI_BENCH_DIRECT", "1") != "0":
+        import subprocess
+
+        env = dict(os.environ)
+        env.update({
+            "LOCALAI_BENCH_PRESET": primary,
+            "LOCALAI_BENCH_SLOTS": str(int(os.environ.get(
+                "LOCALAI_BENCH_SLOTS", HTTP_PRESETS[primary]["slots"]))),
+            "LOCALAI_BENCH_CTX": str(HTTP_PRESETS[primary]["ctx"]),
+            "LOCALAI_BENCH_QUANT": HTTP_PRESETS[primary]["quant"],
+            "LOCALAI_BENCH_KV": eff_kv,
+            "LOCALAI_JAX_PLATFORM": "",
+        })
+        env.pop("JAX_PLATFORMS", None)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--engine"],
+                env=env, capture_output=True, text=True, timeout=3600)
+            for ln in out.stdout.splitlines():
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    engine_direct = json.loads(ln)
+            if engine_direct is None:
+                engine_direct_err = (f"rc={out.returncode} "
+                                     f"stderr={out.stderr[-300:]}")
+        except Exception as e:
+            engine_direct_err = f"{type(e).__name__}: {e}"
+        if engine_direct_err:
+            print(f"engine-direct subprocess failed: {engine_direct_err}",
+                  file=sys.stderr)
     # BASELINE.json's north star is >2000 tok/s AGGREGATE on a v5e-8 for
     # Llama-3.1-8B on /v1/chat/completions = 250 tok/s/chip; this bench
     # measures tokens/sec/chip on one chip, so vs_baseline compares
     # per-chip rates (request-level dp across 8 chips scales linearly)
     per_chip_target = 250.0 if primary == "8b" else 2000.0
     line = {
-        "metric": (f"http_chat_tok_s_per_chip_llama_{primary}_{qtag}_slots"
+        "metric": (f"http_chat_tok_s_per_chip_llama_{primary}_{qtag}{kvtag}_slots"
                    f"{int(os.environ.get('LOCALAI_BENCH_SLOTS', HTTP_PRESETS[primary]['slots']))}"),
         "value": round(r["tok_s"], 1), "unit": "tok/s",
         "vs_baseline": round(r["tok_s"] / per_chip_target, 3),
         "baseline_note": ("north_star 2000 tok/s aggregate on v5e-8 = "
                           "250 tok/s/chip" if primary == "8b" else
                           "vs 2000 tok/s"),
+        "n_runs": r.get("n_runs", 1),
+        "tok_s_min": round(r.get("tok_s_min", r["tok_s"]), 1),
+        "tok_s_max": round(r.get("tok_s_max", r["tok_s"]), 1),
         "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
         "p95_ttft_ms": round(r["p95_ttft_ms"], 1),
         "unloaded_ttft_ms": round(r["unloaded_ttft_ms"], 1),
+        "weights_note": ("random weights via gated loader fallback "
+                         "(no-egress rig); compute path identical to a "
+                         "real checkpoint"),
     }
+    if engine_direct is not None:
+        line["engine_direct_tok_s"] = engine_direct.get("value")
+        if engine_direct.get("value"):
+            line["http_vs_engine_direct_pct"] = round(
+                100.0 * r["tok_s"] / engine_direct["value"], 1)
+    elif engine_direct_err:
+        line["engine_direct_error"] = engine_direct_err[:200]
     for p, rr in results.items():
         if p != primary:
             line[f"{p}_tok_s"] = round(rr["tok_s"], 1)

@@ -364,7 +364,13 @@ async def images_generations(request):
         return api_error(f"invalid size {size}", 400, "invalid_request_error")
     prompt = body.get("prompt", "")
     positive, _, negative = prompt.partition("|")
-    n = int(body.get("n") or 1)
+    try:
+        n = int(body.get("n") or 1)
+        step = int(body.get("step", 25))
+        base_seed = int(body.get("seed", 0))
+    except (TypeError, ValueError):
+        return api_error("n, step and seed must be integers", 400,
+                         "invalid_request_error")
     # img2img (reference: OpenAIRequest.File -> request.src,
     # endpoints/openai/image.go): base64 init image (optionally a data
     # URL) + "strength"; scheduler override rides the same body
@@ -405,7 +411,6 @@ async def images_generations(request):
             f.write(raw)
     out = []
     try:
-        base_seed = int(body.get("seed", 0))
         for i in range(n):
             dst = os.path.join(tempfile.gettempdir(),
                                f"localai-img-{secrets.token_hex(8)}.png")
@@ -419,7 +424,7 @@ async def images_generations(request):
                 seed_i = secrets.randbits(31)
             await state.run_blocking(
                 state.caps.generate_image, mc, positive.strip(),
-                negative.strip(), width, height, int(body.get("step", 25)),
+                negative.strip(), width, height, step,
                 seed_i, dst, src, str(body.get("mode", "") or ""),
                 strength, scheduler)
             if body.get("response_format") == "b64_json":

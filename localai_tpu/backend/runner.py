@@ -138,6 +138,32 @@ class EngineServicer(BackendServicer):
             else:
                 cfg = llama.LlamaConfig.from_hf_config(cfg_dict, dtype=dtype)
 
+        # kv_cache_dtype (YAML -> capabilities.py:31 -> here): the memory
+        # knob that buys batch — int8 KV halves the cache so slot count
+        # can double on a bandwidth-bound chip (reference analogue:
+        # llama.cpp cache-type-k q8_0 / vLLM kv_cache_dtype,
+        # /root/reference/backend/python/vllm/backend.py:92-111).
+        # Validated BEFORE the weight load so a bad knob fails fast.
+        from localai_tpu.config.model_config import KV_CACHE_DTYPES
+
+        kv_dt_name = (request.kv_cache_dtype or "bfloat16").lower()
+        kv_dt_map = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                     "float16": jnp.float16, "f16": jnp.float16,
+                     "float32": jnp.float32, "f32": jnp.float32,
+                     "int8": jnp.int8, "q8_0": jnp.int8}
+        assert set(kv_dt_map) == set(KV_CACHE_DTYPES)  # schema <-> runner sync
+        if kv_dt_name not in kv_dt_map:
+            raise ValueError(
+                f"unknown kv_cache_dtype {kv_dt_name!r} "
+                f"(one of {sorted(kv_dt_map)})")
+        cache_dtype = kv_dt_map[kv_dt_name]
+        if family is not None and cache_dtype != jnp.bfloat16:
+            # mamba cache lanes hold conv/ssm recurrent STATE, not KV rows;
+            # quantizing recurrent state accumulates error every step
+            raise ValueError(
+                "kv_cache_dtype is llama-family only (mamba cache lanes "
+                "carry recurrent state)")
+
         n_dev = len(jax.devices())
         tp = request.mesh_tp or n_dev
         dp = request.mesh_dp or 1
@@ -173,6 +199,7 @@ class EngineServicer(BackendServicer):
             num_slots=request.num_slots or 8,
             max_context=request.context_size or min(cfg.max_position_embeddings, 4096),
             prefill_buckets=tuple(request.prefill_buckets) or (32, 128, 512, 2048),
+            cache_dtype=cache_dtype,
             # self-extend (model YAML group_attn_n/group_attn_w via the
             # options k=v escape hatch, reference backend.proto Options).
             # Sanitized here too: external gRPC clients bypass the YAML

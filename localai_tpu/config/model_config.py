@@ -23,6 +23,12 @@ import yaml
 # importable without pulling in jax)
 SCHEDULERS = ("ddim", "euler", "euler_a", "dpmpp_2m")
 
+# Accepted kv_cache_dtype names — the SINGLE source of truth; the backend
+# (backend/runner.py) maps these to jnp dtypes and asserts it covers
+# exactly this set, so the YAML validator and the runner can't drift.
+KV_CACHE_DTYPES = ("bfloat16", "bf16", "float16", "f16", "float32", "f32",
+                   "int8", "q8_0")
+
 
 class Usecase(enum.Flag):
     """Routing flags (reference: backend_config.go:432-548)."""
@@ -159,6 +165,9 @@ class ModelConfig:
             problems.append(f"num_slots must be positive, got {self.num_slots}")
         if self.scheduler and self.scheduler not in SCHEDULERS:
             problems.append(f"unknown scheduler {self.scheduler!r}")
+        if self.kv_cache_dtype.lower() not in KV_CACHE_DTYPES:
+            problems.append(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}")
         if self.group_attn_n < 1:
             problems.append(
                 f"group_attn_n must be >= 1, got {self.group_attn_n}")

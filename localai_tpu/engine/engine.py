@@ -46,6 +46,7 @@ import numpy as np
 from localai_tpu.engine import sampling
 from localai_tpu.engine.detok import IncrementalDetokenizer
 from localai_tpu.models import llama
+from localai_tpu.ops import kvcache
 
 
 @dataclasses.dataclass
@@ -445,7 +446,10 @@ class Engine:
             return NamedSharding(self.mesh, P(*spec))
 
         return {
-            "cache": ns(None, slot_ax, None, kv_ax, None),  # [L, S, C, KV, hd]
+            # [L, S, C, KV, hd]; kept as a raw spec tuple because the int8
+            # cache is a pytree whose scale leaf drops the hd axis
+            # (kvcache.device_put builds both NamedShardings from it)
+            "cache_spec": (None, slot_ax, None, kv_ax, None),
             "slot_vec": ns(slot_ax),                        # [S]
             "slot_mat": ns(slot_ax, None),                  # [S, V] / [S, 2]
         }
@@ -459,8 +463,8 @@ class Engine:
         sh = self._state_shardings
         if sh is None:
             return
-        self.ck = jax.device_put(self.ck, sh["cache"])
-        self.cv = jax.device_put(self.cv, sh["cache"])
+        self.ck = kvcache.device_put(self.ck, self.mesh, sh["cache_spec"])
+        self.cv = kvcache.device_put(self.cv, self.mesh, sh["cache_spec"])
         self.bias = jax.device_put(self.bias, sh["slot_mat"])
         self.rng_keys = jax.device_put(self.rng_keys, sh["slot_mat"])
 
@@ -1373,11 +1377,14 @@ class Engine:
         fn = self._fork_fns.get(shape_key)
         if fn is None:
             def body(ck, cv, src, dst, n):
-                C = ck.shape[2]
-                mask = (jnp.arange(C, dtype=jnp.int32) < n)[None, :, None, None]
-                nk = jnp.where(mask, ck[:, src], ck[:, dst])
-                nv = jnp.where(mask, cv[:, src], cv[:, dst])
-                return ck.at[:, dst].set(nk), cv.at[:, dst].set(nv)
+                C = kvcache.shape(ck)[2]
+                mask = jnp.arange(C, dtype=jnp.int32) < n
+                nk = kvcache.where_rows(mask, kvcache.slot_rows(ck, src),
+                                        kvcache.slot_rows(ck, dst))
+                nv = kvcache.where_rows(mask, kvcache.slot_rows(cv, src),
+                                        kvcache.slot_rows(cv, dst))
+                return (kvcache.tree_slot_update(ck, dst, nk),
+                        kvcache.tree_slot_update(cv, dst, nv))
 
             fn = jax.jit(body, donate_argnums=(0, 1))
             self._fork_fns[shape_key] = fn
@@ -1433,11 +1440,14 @@ class Engine:
         fn = self._fork_fns.get("restore")
         if fn is None:
             def body(ck, cv, kfull, vfull, slot, n):
-                C = ck.shape[2]
-                mask = (jnp.arange(C, dtype=jnp.int32) < n)[None, :, None, None]
-                nk = jnp.where(mask, kfull.astype(ck.dtype), ck[:, slot])
-                nv = jnp.where(mask, vfull.astype(cv.dtype), cv[:, slot])
-                return ck.at[:, slot].set(nk), cv.at[:, slot].set(nv)
+                C = kvcache.shape(ck)[2]
+                mask = jnp.arange(C, dtype=jnp.int32) < n
+                nk = kvcache.where_rows(mask, kvcache.rows_from_float(kfull, ck),
+                                        kvcache.slot_rows(ck, slot))
+                nv = kvcache.where_rows(mask, kvcache.rows_from_float(vfull, cv),
+                                        kvcache.slot_rows(cv, slot))
+                return (kvcache.tree_slot_update(ck, slot, nk),
+                        kvcache.tree_slot_update(cv, slot, nv))
 
             fn = jax.jit(body, donate_argnums=(0, 1))
             self._fork_fns["restore"] = fn
@@ -1467,7 +1477,7 @@ class Engine:
         m = min(m, len(ids) - 1, self.ecfg.max_context - 1)
         if m <= common or m < 16:
             return common
-        L, _, C, KV, hd = self.ck.shape
+        L, _, C, KV, hd = kvcache.shape(self.ck)
         # float16 staging (matches the file; halves the host alloc +
         # host->device transfer vs float32 — this runs on the engine loop)
         kfull = np.zeros((L, C, KV, hd), np.float16)
@@ -1505,15 +1515,31 @@ class Engine:
             while n2 < n:
                 n2 *= 2
             n2 = min(n2, self.ecfg.max_context)
-            k_dev = self.ck[:, slot, :n2]
-            v_dev = self.cv[:, slot, :n2]
+            if kvcache.is_quant(self.ck):
+                # slice int8 rows + scales on device; dequantize on the
+                # background thread (files stay dense f16 so a bf16-cache
+                # engine can restore what an int8-cache engine saved)
+                k_dev = {"q": self.ck["q"][:, slot, :n2],
+                         "s": self.ck["s"][:, slot, :n2]}
+                v_dev = {"q": self.cv["q"][:, slot, :n2],
+                         "s": self.cv["s"][:, slot, :n2]}
+            else:
+                k_dev = self.ck[:, slot, :n2]
+                v_dev = self.cv[:, slot, :n2]
             path = req.prompt_cache_path
             toks = np.asarray(tokens[:n], np.int32)
 
+            def _host_rows(dev):
+                if isinstance(dev, dict):
+                    q = np.asarray(dev["q"], np.float32)[:, :n]
+                    s = np.asarray(dev["s"], np.float32)[:, :n]
+                    return (q * s[..., None]).astype(np.float16)
+                return np.asarray(dev)[:, :n].astype(np.float16)
+
             def write():
                 try:
-                    k = np.asarray(k_dev)[:, :n].astype(np.float16)
-                    v = np.asarray(v_dev)[:, :n].astype(np.float16)
+                    k = _host_rows(k_dev)
+                    v = _host_rows(v_dev)
                     tmp = path + ".tmp"
                     with open(tmp, "wb") as f:
                         np.savez(f, tokens=toks, k=k, v=v)
@@ -2432,6 +2458,12 @@ class Engine:
         self._prefill_queue.append(slot)
         # prefix matching against a mid-shift slot cannot happen (occupied)
         self._cache_tokens[slot] = list(new_ids)
+        # every in-flight burst dispatched before the shift sampled tokens
+        # conditioned on the discarded context — drop this slot from them
+        # (same invalidation rule as _rollback_grammar / self-extend)
+        for b in self._fifo:
+            if isinstance(b, _Burst):
+                b.skip_slots.add(slot)
 
     def _check_stops(self, s: _Slot, delta: str) -> Optional[str]:
         """If a stop sequence completes in emitted+delta text, return the

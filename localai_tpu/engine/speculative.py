@@ -40,10 +40,12 @@ def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
     Returns (out [S, D+1] emitted tokens, n_out [S] valid counts,
     ck, cv, dck, dcv, lengths_new).
     """
+    from localai_tpu.ops import kvcache
+
     S = tokens.shape[0]
     D = n_draft
-    C = ck.shape[2]
-    dC = dck.shape[2]
+    C = kvcache.shape(ck)[2]
+    dC = kvcache.shape(dck)[2]
 
     # 1. draft proposes D tokens (its cache ingests current + ALL proposals:
     # D+1 steps so the last proposal's KV row exists when fully accepted —

@@ -33,6 +33,7 @@ import numpy as np
 
 from localai_tpu.ops.rope import apply_rope, rope_frequencies
 from localai_tpu.ops.norms import rms_norm
+from localai_tpu.ops import kvcache
 from localai_tpu.ops.attention import (
     causal_attention,
     decode_attention,
@@ -302,9 +303,14 @@ def prefill(
             # read BEFORE this chunk's scatter (attention combines them
             # with the in-register chunk keys) — reading the same-step
             # scattered rows forces XLA to materialize a full layer copy
-            # (measured +8 ms/step at decode; same hazard here).
-            k_rows = ck[li][slot_ids].astype(cfg.dtype)  # [B, C, KV, hd]
-            v_rows = cv[li][slot_ids].astype(cfg.dtype)
+            # (measured +8 ms/step at decode; same hazard here). int8
+            # caches pass the {"q","s"} rows straight through — the
+            # attention op folds scales without a dequantized copy.
+            k_rows = kvcache.gather_layer_rows(kvcache.layer(ck, li), slot_ids)
+            v_rows = kvcache.gather_layer_rows(kvcache.layer(cv, li), slot_ids)
+            if not kvcache.is_quant(k_rows):
+                k_rows = k_rows.astype(cfg.dtype)
+                v_rows = v_rows.astype(cfg.dtype)
             attn = mixed_prefill_attention(q, k, v, k_rows, v_rows,
                                            start_pos, seq_lens, cfg.q_per_kv)
         else:
@@ -316,8 +322,8 @@ def prefill(
         # slot entries (engine batch padding) write identical rows.
         rows = slot_ids[:, None] * jnp.ones((1, T), jnp.int32)              # [B, T]
         cols = start_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
-        ck = ck.at[li, rows, cols].set(k.astype(ck.dtype), mode="drop")
-        cv = cv.at[li, rows, cols].set(v.astype(cv.dtype), mode="drop")
+        ck = kvcache.scatter_prefill(ck, li, rows, cols, k)
+        cv = kvcache.scatter_prefill(cv, li, rows, cols, v)
         x = x + jnp.einsum("bth,hd->btd", attn.reshape(B, T, -1), _mat(layer["wo"], x.dtype))
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h, layer)
@@ -365,7 +371,7 @@ def decode_step(
         positions = positions - pos_offset[:, None]
     sin, cos = rope_frequencies(cfg, positions)
     x = _embed_rows(params["embed"], tokens, cfg.dtype)[:, None, :]  # [S,1,D]
-    C = cache_k.shape[2]
+    C = kvcache.shape(cache_k)[2]
 
     def layer_fn(carry, layer):
         x, ck, cv = carry
@@ -386,29 +392,30 @@ def decode_step(
         #     | pallas) because the balance may flip off the axon tunnel.
         slot_idx = jnp.arange(S, dtype=jnp.int32)
         mode = _decode_attn_mode()
-        if mode == "pallas" and _pallas_decode():
+        lck, lcv = kvcache.layer(ck, li), kvcache.layer(cv, li)
+        if mode == "pallas" and _pallas_decode() and not kvcache.is_quant(lck):
             from localai_tpu.ops.pallas.decode_attention import (
                 decode_attention_append_pallas)
 
             attn = decode_attention_append_pallas(
-                q[:, 0], k[:, 0], v[:, 0], ck[li], cv[li], lengths,
+                q[:, 0], k[:, 0], v[:, 0], lck, lcv, lengths,
                 cfg.q_per_kv)
-            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
-            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
-        elif mode == "append":
-            attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0], ck[li],
-                                           cv[li], lengths, cfg.q_per_kv)
-            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
-            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
+            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
+        elif mode == "append" or (mode == "pallas" and kvcache.is_quant(lck)):
+            attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0], lck,
+                                           lcv, lengths, cfg.q_per_kv)
+            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
+            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
         else:
             # scatter new k/v at [slot, lengths[slot]], then attend over the
             # updated rows ([0, lengths]); out-of-range positions
             # (lengths==C) are dropped, preserving the capacity invariant
-            lk = ck[li].at[slot_idx, lengths].set(k[:, 0].astype(ck.dtype), mode="drop")
-            lv = cv[li].at[slot_idx, lengths].set(v[:, 0].astype(cv.dtype), mode="drop")
+            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
+            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
             attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)
-        ck = ck.at[li].set(lk)
-        cv = cv.at[li].set(lv)
+        ck = kvcache.set_layer(ck, li, lk)
+        cv = kvcache.set_layer(cv, li, lv)
         x = x + jnp.einsum("sh,hd->sd", attn.reshape(S, -1), _mat(layer["wo"], x.dtype))[:, None, :]
         h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h, layer)
@@ -427,7 +434,7 @@ def engine_decode(params, cfg, tokens, lengths, active, cache_k, cache_v,
     """Engine adapter (shared contract with models/mamba.py): one decode
     step for all slots; inactive slots must not write KV — their write
     position is forced to C so the scatter's mode=\"drop\" discards it."""
-    C = cache_k.shape[2]
+    C = kvcache.shape(cache_k)[2]
     write_lengths = jnp.where(active, lengths, C)
     return decode_step(params, cfg, tokens, write_lengths, cache_k, cache_v,
                        pos_offset=pos_offset)
@@ -446,13 +453,22 @@ def shift_cache_positions(cache_k: jax.Array, cfg: LlamaConfig,
     from localai_tpu.ops.rope import rope_delta_terms, rotate_by_delta
 
     sin, cos = rope_delta_terms(cfg, deltas)            # [C, hd]
-    rows = cache_k[:, slot]                             # [L, C, KV, hd]
+    rows = kvcache.slot_rows(cache_k, slot)             # [L, C, KV, hd]
+    if kvcache.is_quant(rows):
+        # dequant -> rotate -> requant for the ONE slot being compressed
+        # (slot-local, off the hot path; one extra quantization rounding)
+        dense = kvcache.dequantize(rows["q"], rows["s"], cfg.dtype)
+        out = rotate_by_delta(dense, sin[None, :, None, :],
+                              cos[None, :, None, :])
+        return kvcache.tree_slot_update(cache_k, slot,
+                                        kvcache.rows_from_float(out, cache_k))
     out = rotate_by_delta(rows, sin[None, :, None, :], cos[None, :, None, :])
     return cache_k.at[:, slot].set(out)
 
 
 def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int, dtype=None):
-    """KV cache: ([L, S, C, KV, hd], [L, S, C, KV, hd])."""
+    """KV cache: ([L, S, C, KV, hd], [L, S, C, KV, hd]); ``dtype=int8``
+    selects the quantized {"q","s"} pytree (ops/kvcache.py)."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    return kvcache.init(shape, dtype), kvcache.init(shape, dtype)

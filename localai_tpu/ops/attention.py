@@ -30,6 +30,17 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _split_cache(cache):
+    """A cache operand is either a plain float array or the int8 pytree
+    {"q": int8[..., hd], "s": f32[...]} (ops/kvcache.py). Returns
+    (rows, scales|None); the scales are folded OUTSIDE the contraction
+    (scores for K, probs for V) so no dequantized cache materializes —
+    HBM reads stay int8."""
+    if isinstance(cache, dict):
+        return cache["q"], cache["s"]
+    return cache, None
+
+
 def causal_attention(q, k, v, valid, q_per_kv: int):
     """Prefill attention.
 
@@ -58,17 +69,25 @@ def mixed_prefill_attention(q, chunk_k, chunk_v, k_rows, v_rows, start_pos,
     reading the same-step scattered rows costs a full-layer copy).
 
     q, chunk_k, chunk_v: [B, T, {H|KV|KV}, hd]; k_rows/v_rows: [B, C, KV, hd]
-    (cache contents BEFORE this chunk's scatter); start_pos, seq_lens: [B].
+    (cache contents BEFORE this chunk's scatter — plain float or the int8
+    {"q","s"} pytree); start_pos, seq_lens: [B].
     Cache position kp is visible iff kp < start_pos (committed prefix);
     chunk position t' is visible to query t iff t' <= t AND t' < seq_lens.
     """
     dtype = q.dtype
     B, T, H, hd = q.shape
+    k_rows, sk = _split_cache(k_rows)
+    v_rows, sv = _split_cache(v_rows)
     C = k_rows.shape[1]
     KV = k_rows.shape[2]
     qg = q.reshape(B, T, KV, q_per_kv, hd)
     scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
-    sc_cache = jnp.einsum("btkgd,bskd->bkgts", qg, k_rows).astype(jnp.float32) * scale
+    sc_cache = jnp.einsum("btkgd,bskd->bkgts", qg,
+                          k_rows.astype(dtype)).astype(jnp.float32) * scale
+    if sk is not None:
+        # per-(row, kv-head) key scale folded into the logits: [B,C,KV] ->
+        # [B,KV,1,1,C] against scores [B,KV,G,T,C]
+        sc_cache = sc_cache * sk.transpose(0, 2, 1)[:, :, None, None, :]
     kp = jnp.arange(C, dtype=jnp.int32)                                       # [C]
     m_cache = kp[None, None, :] < start_pos[:, None, None]                    # [B, T, C]
     sc_cache = jnp.where(m_cache[:, None, None, :, :], sc_cache, _NEG_INF)
@@ -79,7 +98,11 @@ def mixed_prefill_attention(q, chunk_k, chunk_v, k_rows, v_rows, start_pos,
     sc_chunk = jnp.where(m_chunk[:, None, None, :, :], sc_chunk, _NEG_INF)
     scores = jnp.concatenate([sc_cache, sc_chunk], axis=-1)                   # [B,KV,G,T,C+T]
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    out = (jnp.einsum("bkgts,bskd->btkgd", probs[..., :C], v_rows)
+    p_cache = probs[..., :C]
+    if sv is not None:
+        # value scale folded into the (small) probs tensor, not the cache
+        p_cache = p_cache * sv.transpose(0, 2, 1)[:, :, None, None, :].astype(dtype)
+    out = (jnp.einsum("bkgts,bskd->btkgd", p_cache, v_rows.astype(dtype))
            + jnp.einsum("bkgts,bskd->btkgd", probs[..., C:], chunk_v))
     return out.reshape(B, T, H, hd)
 
@@ -87,20 +110,28 @@ def mixed_prefill_attention(q, chunk_k, chunk_v, k_rows, v_rows, start_pos,
 def decode_attention(q, cache_k, cache_v, lengths, q_per_kv: int):
     """Single-token decode attention over the cache for all slots.
 
-    q: [S, H, hd]; cache_k/v: [S, C, KV, hd]; lengths: [S] (valid cache
-    positions are [0, lengths[s])). Returns [S, H, hd].
+    q: [S, H, hd]; cache_k/v: [S, C, KV, hd] (plain float or int8 {"q","s"});
+    lengths: [S] (valid cache positions are [0, lengths[s))).
+    Returns [S, H, hd].
     """
     dtype = q.dtype
     S, H, hd = q.shape
+    cache_k, sk = _split_cache(cache_k)
+    cache_v, sv = _split_cache(cache_v)
     C = cache_k.shape[1]
     KV = cache_k.shape[2]
     qg = q.reshape(S, KV, q_per_kv, hd)
     scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
-    scores = jnp.einsum("skgd,sckd->skgc", qg, cache_k).astype(jnp.float32) * scale
+    scores = jnp.einsum("skgd,sckd->skgc", qg,
+                        cache_k.astype(dtype)).astype(jnp.float32) * scale
+    if sk is not None:
+        scores = scores * sk.transpose(0, 2, 1)[:, :, None, :]  # [S,KV,1,C]
     mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]  # [S, C]
     scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    out = jnp.einsum("skgc,sckd->skgd", probs, cache_v)
+    if sv is not None:
+        probs = probs * sv.transpose(0, 2, 1)[:, :, None, :].astype(dtype)
+    out = jnp.einsum("skgc,sckd->skgd", probs, cache_v.astype(dtype))
     return out.reshape(S, H, hd)
 
 
@@ -110,22 +141,31 @@ def decode_attention_append(q, new_k, new_v, cache_k, cache_v, lengths,
     own key/value (which the caller scatters into the cache separately —
     see module doc for why the read must not see the scatter).
 
-    q, new_k, new_v: [S, {H|KV|KV}, hd]; cache_k/v: [S, C, KV, hd] holding
-    rows [0, lengths[s]) — row lengths[s] is written this step but read
-    from ``new_k``/``new_v`` instead. Returns [S, H, hd].
+    q, new_k, new_v: [S, {H|KV|KV}, hd]; cache_k/v: [S, C, KV, hd] (plain
+    float or int8 {"q","s"}) holding rows [0, lengths[s]) — row lengths[s]
+    is written this step but read from ``new_k``/``new_v`` instead.
+    Returns [S, H, hd].
     """
     dtype = q.dtype
     S, H, hd = q.shape
+    cache_k, sk = _split_cache(cache_k)
+    cache_v, sv = _split_cache(cache_v)
     C = cache_k.shape[1]
     KV = cache_k.shape[2]
     qg = q.reshape(S, KV, q_per_kv, hd)
     scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
-    scores = jnp.einsum("skgd,sckd->skgc", qg, cache_k).astype(jnp.float32) * scale
+    scores = jnp.einsum("skgd,sckd->skgc", qg,
+                        cache_k.astype(dtype)).astype(jnp.float32) * scale
+    if sk is not None:
+        scores = scores * sk.transpose(0, 2, 1)[:, :, None, :]  # [S,KV,1,C]
     mask = jnp.arange(C, dtype=jnp.int32)[None, :] < lengths[:, None]  # [S, C]
     scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
     sc_self = jnp.einsum("skgd,skd->skg", qg, new_k).astype(jnp.float32) * scale
     scores = jnp.concatenate([scores, sc_self[..., None]], axis=-1)    # [S,KV,G,C+1]
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    out = (jnp.einsum("skgc,sckd->skgd", probs[..., :C], cache_v)
+    p_cache = probs[..., :C]
+    if sv is not None:
+        p_cache = p_cache * sv.transpose(0, 2, 1)[:, :, None, :].astype(dtype)
+    out = (jnp.einsum("skgc,sckd->skgd", p_cache, cache_v.astype(dtype))
            + probs[..., C] [..., None] * new_v[:, :, None, :])
     return out.reshape(S, H, hd)

@@ -1,0 +1,200 @@
+"""Quantized KV-cache representation (int8, per-row-per-head scales).
+
+`kv_cache_dtype: int8` in the model YAML (reference analogue: llama.cpp's
+`cache-type-k q8_0`, plumbed via backend.proto ModelOptions and vLLM's
+kv_cache_dtype knob, /root/reference/backend/python/vllm/backend.py:92-111)
+switches the engine cache from a plain bf16 array to this pytree:
+
+    {"q": int8 [L, S, C, KV, hd], "s": float32 [L, S, C, KV]}
+
+i.e. symmetric int8 with one scale per (layer, slot, position, kv-head),
+quantized over head_dim. At hd=128 the scale overhead is 4/128 = 3%, so
+the cache shrinks ~1.94x vs bf16 — which is the whole point: decode on
+one chip is HBM-bandwidth-bound and slot count is capped by KV size, so
+halving the KV doubles the concurrent slots the weight read amortizes
+over (VERDICT r4 headline math).
+
+TPU-first numerics: the scales NEVER produce a dequantized cache tensor.
+Attention folds them outside the contraction —
+    scores[s,kv,g,c] = (q . k_q[c]) * s_k[s,c,kv]         (per-key logit scale)
+    out = einsum(probs * s_v[s,c,kv], v_q)                 (scale into probs)
+— so the MXU consumes the int8 rows cast in-register (the same fusion the
+int8 weight path relies on, models/llama.py:_mat) and HBM reads stay 1
+byte/element. See ops/attention.py for the score-side folding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Cache = Union[jax.Array, dict]
+
+_EPS = 1e-8
+
+
+def wants_quant(dtype) -> bool:
+    """True when the configured cache dtype selects the int8 representation."""
+    return dtype == jnp.int8
+
+
+def is_quant(cache: Any) -> bool:
+    return isinstance(cache, dict)
+
+
+def init(shape: Tuple[int, ...], dtype) -> Cache:
+    """Zeros cache of the given logical shape; int8 -> quantized pytree."""
+    if wants_quant(dtype):
+        return {"q": jnp.zeros(shape, jnp.int8),
+                "s": jnp.zeros(shape[:-1], jnp.float32)}
+    return jnp.zeros(shape, dtype)
+
+
+def shape(cache: Cache) -> Tuple[int, ...]:
+    if is_quant(cache):
+        return cache["q"].shape
+    return cache.shape
+
+
+def store_dtype(cache: Cache):
+    """The dtype new rows must be cast to before a raw scatter (plain
+    caches only; quantized caches go through quantize())."""
+    if is_quant(cache):
+        return jnp.int8
+    return cache.dtype
+
+
+def quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over the trailing (head_dim) axis.
+
+    x: [..., hd] -> (q int8 [..., hd], s float32 [...]).
+    """
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1) / 127.0, _EPS)
+    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    """Materialize float rows (slot-local ops only: prompt-cache export,
+    self-extend re-rotation — never the attention hot path)."""
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype)
+
+
+def gather_slots(cache: Cache, slot_ids: jax.Array) -> Cache:
+    """cache[:, slot_ids] per leaf (continued-prefill row read)."""
+    if is_quant(cache):
+        return {"q": cache["q"][:, slot_ids], "s": cache["s"][:, slot_ids]}
+    return cache[:, slot_ids]
+
+
+def layer(cache: Cache, li) -> Cache:
+    """Select one layer (inside the lax.scan over layers)."""
+    if is_quant(cache):
+        return {"q": cache["q"][li], "s": cache["s"][li]}
+    return cache[li]
+
+
+def set_layer(cache: Cache, li, lcache: Cache) -> Cache:
+    if is_quant(cache):
+        return {"q": cache["q"].at[li].set(lcache["q"]),
+                "s": cache["s"].at[li].set(lcache["s"])}
+    return cache.at[li].set(lcache)
+
+
+def gather_layer_rows(lcache: Cache, slot_ids: jax.Array) -> Cache:
+    """lcache[slot_ids] for a single-layer cache [S, C, KV, hd]."""
+    if is_quant(lcache):
+        return {"q": lcache["q"][slot_ids], "s": lcache["s"][slot_ids]}
+    return lcache[slot_ids]
+
+
+def scatter_decode(lcache: Cache, slot_idx: jax.Array, lengths: jax.Array,
+                   new_kv: jax.Array) -> Cache:
+    """Write one token per slot at [slot, lengths[slot]] (mode=drop).
+
+    lcache: single-layer [S, C, KV, hd]; new_kv: [S, KV, hd] float.
+    """
+    if is_quant(lcache):
+        q, s = quantize(new_kv)
+        return {"q": lcache["q"].at[slot_idx, lengths].set(q, mode="drop"),
+                "s": lcache["s"].at[slot_idx, lengths].set(s, mode="drop")}
+    return lcache.at[slot_idx, lengths].set(
+        new_kv.astype(lcache.dtype), mode="drop")
+
+
+def scatter_prefill(cache: Cache, li, rows: jax.Array, cols: jax.Array,
+                    new_kv: jax.Array) -> Cache:
+    """Batched prompt scatter: cache[li, rows[b,t], cols[b,t]] = new_kv[b,t].
+
+    cache: full [L, S, C, KV, hd]; rows/cols: [B, T]; new_kv: [B, T, KV, hd].
+    """
+    if is_quant(cache):
+        q, s = quantize(new_kv)
+        return {"q": cache["q"].at[li, rows, cols].set(q, mode="drop"),
+                "s": cache["s"].at[li, rows, cols].set(s, mode="drop")}
+    return cache.at[li, rows, cols].set(
+        new_kv.astype(cache.dtype), mode="drop")
+
+
+def tree_slot_update(cache: Cache, dst, new_rows: Cache) -> Cache:
+    """cache[:, dst] = new_rows per leaf (fork / restore bodies)."""
+    if is_quant(cache):
+        return {"q": cache["q"].at[:, dst].set(new_rows["q"]),
+                "s": cache["s"].at[:, dst].set(new_rows["s"])}
+    return cache.at[:, dst].set(new_rows)
+
+
+def slot_rows(cache: Cache, slot) -> Cache:
+    """cache[:, slot] per leaf -> [L, C, KV, hd] (+ scales)."""
+    if is_quant(cache):
+        return {"q": cache["q"][:, slot], "s": cache["s"][:, slot]}
+    return cache[:, slot]
+
+
+def where_rows(mask_c: jax.Array, a: Cache, b: Cache) -> Cache:
+    """Select rows along the C axis between two row sets [L, C, KV, hd].
+
+    mask_c: [C] bool (True -> a). Scales select with the same row mask.
+    """
+    if is_quant(a):
+        return {"q": jnp.where(mask_c[None, :, None, None], a["q"], b["q"]),
+                "s": jnp.where(mask_c[None, :, None], a["s"], b["s"])}
+    return jnp.where(mask_c[None, :, None, None], a, b)
+
+
+def rows_to_float(rows: Cache, dtype) -> jax.Array:
+    """[L, C, KV, hd] row set -> dense float (prompt-cache save path)."""
+    if is_quant(rows):
+        return dequantize(rows["q"], rows["s"], dtype)
+    return rows.astype(dtype)
+
+
+def rows_from_float(rows: jax.Array, like: Cache) -> Cache:
+    """Dense float [L, C, KV, hd] -> the cache's representation."""
+    if is_quant(like):
+        q, s = quantize(rows)
+        return {"q": q, "s": s}
+    return rows.astype(like.dtype)
+
+
+def cache_sharding(mesh, spec5):
+    """NamedShardings for the cache under a 5-dim PartitionSpec; the scale
+    leaf ([L, S, C, KV]) drops the trailing head_dim entry."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    full = NamedSharding(mesh, P(*spec5))
+    scales = NamedSharding(mesh, P(*spec5[:-1]))
+    return full, scales
+
+
+def device_put(cache: Cache, mesh, spec5) -> Cache:
+    if is_quant(cache):
+        full, scales = cache_sharding(mesh, spec5)
+        return {"q": jax.device_put(cache["q"], full),
+                "s": jax.device_put(cache["s"], scales)}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(cache, NamedSharding(mesh, P(*spec5)))

@@ -72,6 +72,12 @@ class LeaderBus:
         self._n = n_followers
         self._socks = []
         self._ready = threading.Event()
+        # A dropped descriptor permanently desyncs that follower's replayed
+        # program order and the next cross-process collective deadlocks the
+        # whole mesh — so a failed send is FATAL, not skippable: the pump
+        # marks the bus broken and the next engine send() raises, which the
+        # engine loop turns into fail-active-requests + shutdown.
+        self.broken = threading.Event()
         self._q: "queue.Queue" = queue.Queue()
         threading.Thread(target=self._accept, daemon=True,
                          name="lockstep-accept").start()
@@ -87,6 +93,7 @@ class LeaderBus:
         self._ready.set()
 
     def _pump(self):
+        import logging
         self._ready.wait()
         while True:
             msg = self._q.get()
@@ -94,16 +101,25 @@ class LeaderBus:
                 try:
                     _send_msg(s, msg)
                 except OSError:
-                    pass
+                    logging.getLogger(__name__).error(
+                        "lockstep: descriptor send to follower failed — "
+                        "bus is broken, mesh cannot continue")
+                    self.broken.set()
+                    return
             if msg and msg.get("op") == "shutdown":
                 return
 
     def send(self, op: str, **payload):
+        if self.broken.is_set():
+            raise ConnectionError(
+                "lockstep bus broken: a follower stopped receiving "
+                "descriptors; the mesh program order has diverged")
         payload["op"] = op
         self._q.put(payload)
 
     def close(self):
-        self.send("shutdown")
+        if not self.broken.is_set():
+            self.send("shutdown")
         self._thread.join(timeout=10)
         for s in self._socks:
             s.close()
