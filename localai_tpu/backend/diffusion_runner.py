@@ -47,10 +47,24 @@ class DiffusionServicer(BackendServicer):
                 self.scheduler = request.scheduler or "ddim"
                 if model_dir and os.path.isdir(os.path.join(model_dir, "unet")):
                     # diffusers pipeline directory (reference:
-                    # backend/python/diffusers/backend.py LoadModel)
+                    # backend/python/diffusers/backend.py LoadModel, incl.
+                    # ControlNet attach + LoRA fuse-at-load :297-314)
                     from localai_tpu.models import sd
 
-                    self.sd_pipe = sd.SDPipeline.load(model_dir)
+                    from localai_tpu.backend.service import parse_options
+
+                    extra = parse_options(request.options)
+                    loras = []
+                    if request.lora_adapter:
+                        lp = request.lora_adapter
+                        if request.model_path and not os.path.isabs(lp):
+                            lp = os.path.join(request.model_path, lp)
+                        loras.append(lp)
+                    self.sd_pipe = sd.SDPipeline.load(
+                        model_dir,
+                        controlnet=extra.get("controlnet", ""),
+                        lora_paths=tuple(loras),
+                        lora_scale=request.lora_scale or 1.0)
                     self.cfg = diffusion.DiffusionConfig()
                     self.params = self.sd_pipe.unet
                 elif model_dir and os.path.exists(
@@ -82,7 +96,23 @@ class DiffusionServicer(BackendServicer):
                     scheduler = (request.scheduler
                                  or getattr(self, "scheduler", "")
                                  or "ddim")
-                    if request.src:
+                    if request.src and request.mode == "controlnet":
+                        # src is the CONTROL image (canny/pose map), not
+                        # an init image: structure-conditioned txt2img
+                        # (reference: diffusers backend.py:297-314)
+                        from PIL import Image
+
+                        ctrl = np.asarray(Image.open(request.src)
+                                          .convert("RGB"))
+                        img = self.sd_pipe.txt2img(
+                            request.positive_prompt,
+                            negative_prompt=request.negative_prompt,
+                            height=h, width=w,
+                            steps=request.step or 20,
+                            cfg_scale=float(request.cfg_scale or 7),
+                            seed=request.seed, scheduler=scheduler,
+                            control_image=ctrl)
+                    elif request.src:
                         # img2img (reference: diffusers backend
                         # backend.py:399-424 — src image + strength)
                         from PIL import Image

@@ -226,8 +226,13 @@ def _attn_block(p: _P, x, ctx, heads, groups=32):
     return h + res
 
 
-def unet_forward(params: dict, cfg: UNetConfig, latents, t, ctx):
-    """latents [B, 4, h, w]; t [B]; ctx [B, T, cross_dim] -> noise pred."""
+def unet_forward(params: dict, cfg: UNetConfig, latents, t, ctx,
+                 ctrl_down=None, ctrl_mid=None):
+    """latents [B, 4, h, w]; t [B]; ctx [B, T, cross_dim] -> noise pred.
+
+    ctrl_down/ctrl_mid: ControlNet residuals (one per skip sample + one
+    mid), added exactly where diffusers UNet2DConditionModel adds its
+    down_block_additional_residuals / mid_block_additional_residual."""
     p = _P(params)
     g = cfg.norm_num_groups
     ch0 = cfg.block_out_channels[0]
@@ -253,11 +258,17 @@ def unet_forward(params: dict, cfg: UNetConfig, latents, t, ctx):
                         bp("downsamplers.0.conv.bias"), stride=2)
             skips.append(x)
 
+    if ctrl_down is not None:
+        assert len(ctrl_down) == len(skips), (len(ctrl_down), len(skips))
+        skips = [s + r for s, r in zip(skips, ctrl_down)]
+
     mp = p.sub("mid_block.")
     x = _resnet(mp.sub("resnets.0."), x, temb, g)
     x = _attn_block(mp.sub("attentions.0."), x, ctx,
                     heads(len(cfg.block_out_channels) - 1), g)
     x = _resnet(mp.sub("resnets.1."), x, temb, g)
+    if ctrl_mid is not None:
+        x = x + ctrl_mid
 
     for bi, btype in enumerate(cfg.up_block_types):
         bp = p.sub(f"up_blocks.{bi}.")
@@ -276,6 +287,197 @@ def unet_forward(params: dict, cfg: UNetConfig, latents, t, ctx):
 
     x = _group_norm(x, p("conv_norm_out.weight"), p("conv_norm_out.bias"), g)
     return _conv2d(jax.nn.silu(x), p("conv_out.weight"), p("conv_out.bias"))
+
+
+# ---------------- ControlNet (diffusers ControlNetModel) ----------------
+
+@dataclasses.dataclass(frozen=True)
+class ControlNetConfig:
+    in_channels: int = 4
+    block_out_channels: tuple = (320, 640, 1280, 1280)
+    down_block_types: tuple = ("CrossAttnDownBlock2D",) * 3 + ("DownBlock2D",)
+    layers_per_block: int = 2
+    attention_head_dim: Any = 8
+    cross_attention_dim: int = 768
+    norm_num_groups: int = 32
+    conditioning_embedding_out_channels: tuple = (16, 32, 96, 256)
+
+    @staticmethod
+    def from_json(path: str) -> "ControlNetConfig":
+        with open(path) as f:
+            d = json.load(f)
+        fields = {f.name for f in dataclasses.fields(ControlNetConfig)}
+        kw = {k: tuple(v) if isinstance(v, list) else v
+              for k, v in d.items() if k in fields}
+        return ControlNetConfig(**kw)
+
+
+def controlnet_forward(params: dict, cfg: ControlNetConfig, latents, t, ctx,
+                       cond):
+    """ControlNet conditioning pass (reference semantics:
+    /root/reference/backend/python/diffusers/backend.py:297-314 attaches a
+    diffusers ControlNetModel; this is that model's forward). Structure =
+    the UNet's down+mid stack with a conditioning-image embedding added
+    after conv_in and zero-conv projections on every skip.
+
+    latents [B, 4, h, w]; cond [B, 3, H, W] full-resolution control image
+    in [0, 1] (canny/pose/etc). Returns (down_res list, mid_res)."""
+    p = _P(params)
+    g = cfg.norm_num_groups
+    ch0 = cfg.block_out_channels[0]
+    temb = _timestep_embedding(t, ch0)
+    temb = _linear(p, "time_embedding.linear_1", temb)
+    temb = _linear(p, "time_embedding.linear_2", jax.nn.silu(temb))
+
+    def heads(bi):
+        ahd = cfg.attention_head_dim
+        return ahd[bi] if isinstance(ahd, (tuple, list)) else ahd
+
+    x = _conv2d(latents, p("conv_in.weight"), p("conv_in.bias"))
+    # conditioning embedding: conv_in -> (s1, s2) conv pairs -> conv_out;
+    # downsamples the full-res control image to latent resolution
+    ce = p.sub("controlnet_cond_embedding.")
+    c = jax.nn.silu(_conv2d(cond, ce("conv_in.weight"), ce("conv_in.bias")))
+    i = 0
+    while ce.has(f"blocks.{i}.weight"):
+        c = jax.nn.silu(_conv2d(c, ce(f"blocks.{i}.weight"),
+                                ce(f"blocks.{i}.bias"),
+                                stride=2 if i % 2 else 1))
+        i += 1
+    c = _conv2d(c, ce("conv_out.weight"), ce("conv_out.bias"))
+    x = x + c
+
+    skips = [x]
+    for bi, btype in enumerate(cfg.down_block_types):
+        bp = p.sub(f"down_blocks.{bi}.")
+        for li in range(cfg.layers_per_block):
+            x = _resnet(bp.sub(f"resnets.{li}."), x, temb, g)
+            if btype.startswith("CrossAttn"):
+                x = _attn_block(bp.sub(f"attentions.{li}."), x, ctx,
+                                heads(bi), g)
+            skips.append(x)
+        if bp.has("downsamplers.0.conv.weight"):
+            x = _conv2d(x, bp("downsamplers.0.conv.weight"),
+                        bp("downsamplers.0.conv.bias"), stride=2)
+            skips.append(x)
+
+    mp = p.sub("mid_block.")
+    x = _resnet(mp.sub("resnets.0."), x, temb, g)
+    x = _attn_block(mp.sub("attentions.0."), x, ctx,
+                    heads(len(cfg.block_out_channels) - 1), g)
+    x = _resnet(mp.sub("resnets.1."), x, temb, g)
+
+    down_res = [
+        _conv2d(s, p(f"controlnet_down_blocks.{i}.weight"),
+                p(f"controlnet_down_blocks.{i}.bias"), padding=0)
+        for i, s in enumerate(skips)
+    ]
+    mid_res = _conv2d(x, p("controlnet_mid_block.weight"),
+                      p("controlnet_mid_block.bias"), padding=0)
+    return down_res, mid_res
+
+
+# ---------------- diffusion LoRA (safetensors add-on checkpoints) --------
+
+_KOHYA_FIXUPS = (
+    ("down.blocks", "down_blocks"), ("up.blocks", "up_blocks"),
+    ("mid.block", "mid_block"), ("transformer.blocks", "transformer_blocks"),
+    ("to.q", "to_q"), ("to.k", "to_k"), ("to.v", "to_v"),
+    ("to.out", "to_out"), ("proj.in", "proj_in"), ("proj.out", "proj_out"),
+    ("conv.in", "conv_in"), ("conv.out", "conv_out"),
+    ("conv.shortcut", "conv_shortcut"), ("time.emb.proj", "time_emb_proj"),
+    ("ff.net", "ff.net"), ("text.model", "text_model"),
+    ("self.attn", "self_attn"), ("q.proj", "q_proj"), ("k.proj", "k_proj"),
+    ("v.proj", "v_proj"), ("out.proj", "out_proj"), ("fc.1", "fc1"),
+    ("fc.2", "fc2"), ("layer.norm", "layer_norm"),
+)
+
+
+def _kohya_to_module(key: str) -> str:
+    """'lora_unet_down_blocks_0_attentions_0_...to_q' (underscore soup) ->
+    dotted diffusers module path. The fixup table restores the module
+    names that legitimately contain underscores — the same trick
+    diffusers' kohya converter uses."""
+    name = key.replace("_", ".")
+    for a, b in _KOHYA_FIXUPS:
+        name = name.replace(a, b)
+    return name
+
+
+def load_sd_lora(path: str):
+    """Read a diffusion LoRA safetensors file into
+    {(target, module_path): (down [r, in], up [out, r], alpha)} with
+    target in {"unet", "text_encoder"}. Supports the two ecosystem
+    layouts: kohya ('lora_unet_*.lora_down/up.weight' + '.alpha') and
+    peft/diffusers ('unet.*.lora_A/B.weight')."""
+    from safetensors import safe_open
+
+    raw = {}
+    with safe_open(path, framework="np") as f:
+        for k in f.keys():
+            raw[k] = np.asarray(f.get_tensor(k), np.float32)
+
+    pairs: dict = {}
+
+    def slot(target, module):
+        return pairs.setdefault((target, module), {})
+
+    for k, v in raw.items():
+        if k.startswith("lora_unet_") or k.startswith("lora_te_"):
+            target = "unet" if k.startswith("lora_unet_") else "text_encoder"
+            base = k[len("lora_unet_"):] if target == "unet" \
+                else k[len("lora_te_"):]
+            if base.endswith(".lora_down.weight"):
+                slot(target, _kohya_to_module(
+                    base[: -len(".lora_down.weight")]))["down"] = v
+            elif base.endswith(".lora_up.weight"):
+                slot(target, _kohya_to_module(
+                    base[: -len(".lora_up.weight")]))["up"] = v
+            elif base.endswith(".alpha"):
+                slot(target, _kohya_to_module(
+                    base[: -len(".alpha")]))["alpha"] = float(v)
+        elif k.startswith(("unet.", "text_encoder.")):
+            target, rest = k.split(".", 1)
+            for tag, role in ((".lora_A.weight", "down"),
+                              (".lora_B.weight", "up"),
+                              (".lora.down.weight", "down"),
+                              (".lora.up.weight", "up")):
+                if rest.endswith(tag):
+                    slot(target, rest[: -len(tag)])[role] = v
+                    break
+    out = {}
+    for (target, module), d in pairs.items():
+        if "down" in d and "up" in d:
+            out[(target, module)] = (d["down"], d["up"], d.get("alpha"))
+    if not out:
+        raise ValueError(f"no LoRA weight pairs recognized in {path}")
+    return out
+
+
+def apply_sd_lora(unet: dict, clip: dict, path: str, scale: float = 1.0):
+    """Fuse a LoRA into the unet/text-encoder weight dicts at load
+    (W += scale * (alpha/r) * up @ down — the reference fuses at load
+    too, /root/reference/backend/python/diffusers/backend.py:297-314).
+    Mutates the dicts in place; returns (n_fused, n_skipped)."""
+    pairs = load_sd_lora(path)
+    fused = skipped = 0
+    for (target, module), (down, up, alpha) in pairs.items():
+        params = unet if target == "unet" else clip
+        key = module + ".weight"
+        if key not in params:
+            skipped += 1
+            continue
+        w = np.asarray(params[key], np.float32)
+        r = down.shape[0]
+        eff = scale * ((alpha / r) if alpha else 1.0)
+        d2, u2 = down.reshape(r, -1), up.reshape(up.shape[0], -1)
+        delta = (u2 @ d2).reshape(w.shape) * eff
+        params[key] = jnp.asarray(w + delta, jnp.float32)
+        fused += 1
+    if not fused:
+        raise ValueError(f"LoRA {path}: no target module matched the "
+                         f"loaded pipeline (skipped {skipped})")
+    return fused, skipped
 
 
 # ---------------- AutoencoderKL ----------------
@@ -481,7 +683,8 @@ def sample_latents(fwd, lat, ctx2, ts, alphas_cum, cfg_scale, rng,
 
 @dataclasses.dataclass
 class SDPipeline:
-    """Loaded diffusers-layout pipeline (text encoder + unet + vae)."""
+    """Loaded diffusers-layout pipeline (text encoder + unet + vae,
+    optional controlnet subdir, optional fused LoRAs)."""
     clip_cfg: ClipTextConfig
     clip: dict
     unet_cfg: UNetConfig
@@ -489,10 +692,14 @@ class SDPipeline:
     vae_cfg: VaeConfig
     vae: dict
     tokenizer: Any = None
+    ctrl_cfg: Any = None     # ControlNetConfig when a controlnet is loaded
+    ctrl: Any = None
     _fwd: Any = None    # cached jitted UNet (weights passed as an argument)
+    _fwd_ctrl: Any = None
 
     @staticmethod
-    def load(pipe_dir: str) -> "SDPipeline":
+    def load(pipe_dir: str, controlnet: str = "",
+             lora_paths: tuple = (), lora_scale: float = 1.0) -> "SDPipeline":
         def flat(path):
             from safetensors import safe_open
 
@@ -513,7 +720,16 @@ class SDPipeline:
                 os.path.join(pipe_dir, "tokenizer"))
         except Exception:
             pass
-        return SDPipeline(
+        # controlnet: explicit path, or the conventional pipe subdir
+        cn = controlnet or os.path.join(pipe_dir, "controlnet")
+        if not os.path.isabs(cn) and controlnet:
+            cn = os.path.join(pipe_dir, cn)
+        ctrl_cfg = ctrl = None
+        if os.path.exists(os.path.join(cn, "config.json")):
+            ctrl_cfg = ControlNetConfig.from_json(
+                os.path.join(cn, "config.json"))
+            ctrl = flat(os.path.join(cn, "diffusion_pytorch_model.safetensors"))
+        pipe = SDPipeline(
             clip_cfg=ClipTextConfig.from_json(os.path.join(te, "config.json")),
             clip=flat(os.path.join(te, "model.safetensors")),
             unet_cfg=UNetConfig.from_json(os.path.join(un, "config.json")),
@@ -521,7 +737,13 @@ class SDPipeline:
             vae_cfg=VaeConfig.from_json(os.path.join(va, "config.json")),
             vae=flat(os.path.join(va, "diffusion_pytorch_model.safetensors")),
             tokenizer=tok,
+            ctrl_cfg=ctrl_cfg, ctrl=ctrl,
         )
+        for lp in lora_paths:
+            if not os.path.isabs(lp):
+                lp = os.path.join(pipe_dir, lp)
+            apply_sd_lora(pipe.unet, pipe.clip, lp, lora_scale)
+        return pipe
 
     def encode_prompt(self, prompt: str) -> jnp.ndarray:
         if self.tokenizer is not None:
@@ -545,6 +767,25 @@ class SDPipeline:
                 lambda p_, l, t, c: unet_forward(p_, cfg_, l, t, c))
         return lambda l, t, c: self._fwd(self.unet, l, t, c)
 
+    def _get_fwd_controlled(self, cond, ctrl_scale: float):
+        """eps function with the ControlNet pass fused in: the cond image
+        is fixed per request and CFG-duplicated to the latent batch."""
+        if self._fwd_ctrl is None:
+            ucfg, ccfg = self.unet_cfg, self.ctrl_cfg
+
+            def f(up, cp, l, t, c, cond_, scale):
+                dres, mres = controlnet_forward(cp, ccfg, l, t, c, cond_)
+                dres = [d * scale for d in dres]
+                return unet_forward(up, ucfg, l, t, c,
+                                    ctrl_down=dres, ctrl_mid=mres * scale)
+
+            self._fwd_ctrl = jax.jit(f)
+        cond = jnp.asarray(cond, jnp.float32)
+        scale = jnp.float32(ctrl_scale)
+        return lambda l, t, c: self._fwd_ctrl(
+            self.unet, self.ctrl, l, t, c,
+            jnp.broadcast_to(cond, (l.shape[0],) + cond.shape[1:]), scale)
+
     def _ctx2(self, prompt: str, negative_prompt: str):
         ctx = self.encode_prompt(prompt)
         ctx_neg = self.encode_prompt(negative_prompt)
@@ -561,12 +802,30 @@ class SDPipeline:
         img = np.asarray(jnp.clip((img + 1) / 2, 0, 1))[0]
         return (img.transpose(1, 2, 0) * 255).astype(np.uint8)
 
+    def _control_fwd(self, control_image, controlnet_scale, height, width):
+        """Pick the eps function: plain UNet, or UNet+ControlNet when a
+        control image is given (loudly rejected without a controlnet)."""
+        if control_image is None:
+            return self._get_fwd()
+        if self.ctrl is None:
+            raise ValueError(
+                "control image given but no controlnet is loaded (put a "
+                "diffusers ControlNetModel under <pipe>/controlnet or set "
+                "the controlnet option)")
+        img01 = control_image.astype(np.float32) / 255.0
+        cond = jax.image.resize(
+            jnp.asarray(img01.transpose(2, 0, 1)[None]),
+            (1, 3, height, width), "bilinear")
+        return self._get_fwd_controlled(cond, controlnet_scale)
+
     def txt2img(self, prompt: str, negative_prompt: str = "",
                 height: int = 512, width: int = 512, steps: int = 20,
                 cfg_scale: float = 7.5, seed: int = 0,
-                scheduler: str = "ddim") -> np.ndarray:
+                scheduler: str = "ddim", control_image: np.ndarray = None,
+                controlnet_scale: float = 1.0) -> np.ndarray:
         """-> uint8 image [H, W, 3] (dims rounded DOWN to the VAE's
-        spatial factor). CFG + selectable scheduler, SD semantics."""
+        spatial factor). CFG + selectable scheduler, SD semantics;
+        optional ControlNet conditioning on ``control_image``."""
         ctx2 = self._ctx2(prompt, negative_prompt)
         # proto seed is signed int32; negative means "pick for me"
         rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
@@ -577,14 +836,17 @@ class SDPipeline:
             (1, self.unet_cfg.in_channels, height // vsf, width // vsf)
         ).astype(np.float32))
         ts, alphas = ddim_timesteps_and_alphas(steps=steps)
-        lat = sample_latents(self._get_fwd(), lat, ctx2, ts, alphas,
+        fwd = self._control_fwd(control_image, controlnet_scale,
+                                height, width)
+        lat = sample_latents(fwd, lat, ctx2, ts, alphas,
                              cfg_scale, rng, scheduler=scheduler)
         return self._decode_image(lat)
 
     def img2img(self, prompt: str, init_image: np.ndarray,
                 negative_prompt: str = "", strength: float = 0.75,
                 steps: int = 20, cfg_scale: float = 7.5, seed: int = 0,
-                scheduler: str = "ddim") -> np.ndarray:
+                scheduler: str = "ddim", control_image: np.ndarray = None,
+                controlnet_scale: float = 1.0) -> np.ndarray:
         """init_image uint8 [H, W, 3] -> uint8 image (same VAE-rounded
         dims). Diffusers img2img semantics (reference:
         backend/python/diffusers/backend.py:399-424): the init image is
@@ -615,7 +877,8 @@ class SDPipeline:
             np.shape(lat0)).astype(np.float32))
         a_start = float(alphas[ts[start]])
         lat = math.sqrt(a_start) * lat0 + math.sqrt(1 - a_start) * noise
-        lat = sample_latents(self._get_fwd(), lat, ctx2, ts, alphas,
+        fwd = self._control_fwd(control_image, controlnet_scale, H, W)
+        lat = sample_latents(fwd, lat, ctx2, ts, alphas,
                              cfg_scale, rng, scheduler=scheduler,
                              start_index=start)
         return self._decode_image(lat)
@@ -745,6 +1008,61 @@ def init_unet_params(cfg: UNetConfig, seed=0) -> dict:
     return p
 
 
+def init_controlnet_params(cfg: ControlNetConfig, seed=0) -> dict:
+    """diffusers-named random ControlNet (mirrors controlnet_forward).
+    The zero-convs are RANDOM here (a real checkpoint trains them away
+    from zero; zeros would make conditioning a no-op in tests)."""
+    rng = np.random.default_rng(seed)
+    p: dict = {}
+    ch = cfg.block_out_channels
+    temb = 4 * ch[0]
+    p["conv_in.weight"] = _rand(rng, ch[0], cfg.in_channels, 3, 3)
+    p["conv_in.bias"] = jnp.zeros((ch[0],))
+    p["time_embedding.linear_1.weight"] = _rand(rng, temb, ch[0])
+    p["time_embedding.linear_1.bias"] = jnp.zeros((temb,))
+    p["time_embedding.linear_2.weight"] = _rand(rng, temb, temb)
+    p["time_embedding.linear_2.bias"] = jnp.zeros((temb,))
+
+    ce = cfg.conditioning_embedding_out_channels
+    pre = "controlnet_cond_embedding."
+    p[pre + "conv_in.weight"] = _rand(rng, ce[0], 3, 3, 3)
+    p[pre + "conv_in.bias"] = jnp.zeros((ce[0],))
+    for i in range(len(ce) - 1):
+        p[pre + f"blocks.{2 * i}.weight"] = _rand(rng, ce[i], ce[i], 3, 3)
+        p[pre + f"blocks.{2 * i}.bias"] = jnp.zeros((ce[i],))
+        p[pre + f"blocks.{2 * i + 1}.weight"] = _rand(rng, ce[i + 1], ce[i], 3, 3)
+        p[pre + f"blocks.{2 * i + 1}.bias"] = jnp.zeros((ce[i + 1],))
+    p[pre + "conv_out.weight"] = _rand(rng, ch[0], ce[-1], 3, 3)
+    p[pre + "conv_out.bias"] = jnp.zeros((ch[0],))
+
+    skips = [ch[0]]
+    cur = ch[0]
+    for bi, btype in enumerate(cfg.down_block_types):
+        bp = f"down_blocks.{bi}."
+        for li in range(cfg.layers_per_block):
+            _init_resnet(p, rng, bp + f"resnets.{li}.", cur, ch[bi], temb)
+            cur = ch[bi]
+            if btype.startswith("CrossAttn"):
+                _init_attn(p, rng, bp + f"attentions.{li}.", cur,
+                           cfg.cross_attention_dim)
+            skips.append(cur)
+        if bi < len(ch) - 1:
+            p[bp + "downsamplers.0.conv.weight"] = _rand(rng, cur, cur, 3, 3)
+            p[bp + "downsamplers.0.conv.bias"] = jnp.zeros((cur,))
+            skips.append(cur)
+
+    _init_resnet(p, rng, "mid_block.resnets.0.", cur, cur, temb)
+    _init_attn(p, rng, "mid_block.attentions.0.", cur, cfg.cross_attention_dim)
+    _init_resnet(p, rng, "mid_block.resnets.1.", cur, cur, temb)
+
+    for i, c in enumerate(skips):
+        p[f"controlnet_down_blocks.{i}.weight"] = _rand(rng, c, c, 1, 1)
+        p[f"controlnet_down_blocks.{i}.bias"] = jnp.zeros((c,))
+    p["controlnet_mid_block.weight"] = _rand(rng, cur, cur, 1, 1)
+    p["controlnet_mid_block.bias"] = jnp.zeros((cur,))
+    return p
+
+
 def init_vae_params(cfg: VaeConfig, seed=0) -> dict:
     rng = np.random.default_rng(seed)
     p: dict = {}
@@ -820,7 +1138,8 @@ def init_vae_params(cfg: VaeConfig, seed=0) -> dict:
 
 
 def save_tiny_pipeline(pipe_dir: str, clip_cfg: ClipTextConfig,
-                       unet_cfg: UNetConfig, vae_cfg: VaeConfig, seed=0):
+                       unet_cfg: UNetConfig, vae_cfg: VaeConfig, seed=0,
+                       controlnet_cfg: "ControlNetConfig" = None):
     """Write a complete diffusers-LAYOUT pipeline directory (tests)."""
     from safetensors.numpy import save_file
 
@@ -839,3 +1158,7 @@ def save_tiny_pipeline(pipe_dir: str, clip_cfg: ClipTextConfig,
          "diffusion_pytorch_model.safetensors")
     dump("vae", vae_cfg, init_vae_params(vae_cfg, seed + 2),
          "diffusion_pytorch_model.safetensors")
+    if controlnet_cfg is not None:
+        dump("controlnet", controlnet_cfg,
+             init_controlnet_params(controlnet_cfg, seed + 3),
+             "diffusion_pytorch_model.safetensors")
