@@ -72,6 +72,7 @@ class TTSServicer(BackendServicer):
             model_dir = request.model
             if request.model_path and model_dir and not os.path.isabs(model_dir):
                 model_dir = os.path.join(request.model_path, model_dir)
+            self.model_dir = model_dir
             cfg_path = os.path.join(model_dir or "", "config.json")
             cfg_dict = {}
             if model_dir and os.path.exists(cfg_path):
@@ -104,6 +105,25 @@ class TTSServicer(BackendServicer):
                 vcfg, vparams = jvits.load_params(
                     model_dir, jvits.VitsConfig.from_dict(cfg_dict))
                 self.vits = (vcfg, vparams)
+                # voice clone (r5, VERDICT r4 #4): a tone-color encoder in
+                # the model dir + ModelOptions.audio_path (the reference's
+                # audio-prompt field, vall-e-x/backend.py:61-68) condition
+                # synthesis on a reference recording
+                from localai_tpu.models import voice_clone as vc
+
+                self.tone = vc.load_params(model_dir)
+                self.ref_embedding = None
+                if request.audio_path:
+                    ref = request.audio_path
+                    if request.model_path and not os.path.isabs(ref):
+                        ref = os.path.join(request.model_path, ref)
+                    if self.tone[0] is None:
+                        raise ValueError(
+                            "audio_path given but the model has no tone "
+                            "encoder (tone_encoder.safetensors) — voice "
+                            "cloning needs one")
+                    self.ref_embedding = vc.embed_reference(
+                        self.tone[0], self.tone[1], ref)
                 try:
                     from transformers import AutoTokenizer
 
@@ -133,6 +153,36 @@ class TTSServicer(BackendServicer):
         ids = self.vits_tokenizer(text)["input_ids"] \
             if callable(self.vits_tokenizer) else \
             self.vits_tokenizer.encode(text)
+        # voice clone: a WAV path as the voice (per-request reference
+        # audio — ElevenLabs voice_id / TTSRequest.voice) or the
+        # load-time audio_path embedding
+        ref_emb = getattr(self, "ref_embedding", None)
+        tone = getattr(self, "tone", (None, None))
+        if voice and voice.lower().endswith(".wav"):
+            if tone[0] is None:
+                raise ValueError(
+                    "reference-audio voice given but the model has no "
+                    "tone encoder (tone_encoder.safetensors)")
+            # the voice field arrives from the HTTP API: confine it to the
+            # model dir so it can't read (or existence-probe) arbitrary
+            # server paths
+            base = os.path.realpath(getattr(self, "model_dir", "") or ".")
+            ref = os.path.realpath(os.path.join(base, voice))
+            if ref != base and not ref.startswith(base + os.sep):
+                raise ValueError(
+                    "reference-audio voice must name a WAV inside the "
+                    "model directory")
+            if not os.path.exists(ref):
+                raise ValueError(f"reference audio not found: {voice}")
+            from localai_tpu.models import voice_clone as vc
+
+            ref_emb = vc.embed_reference(tone[0], tone[1], ref)
+        if ref_emb is not None:
+            wave = jvits.synthesize(vparams, vcfg,
+                                    np.asarray(ids, np.int32),
+                                    speaker_embedding=ref_emb,
+                                    frame_pad_to=64)
+            return wave, vcfg.sampling_rate
         speaker = None
         if vcfg.num_speakers > 1:
             try:

@@ -41,8 +41,11 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         lora_base=mc.lora_base,
         lora_scale=mc.lora_scale,
         scheduler=mc.scheduler,
-        options=(f"ga_n={mc.group_attn_n},ga_w={mc.group_attn_w}"
-                 if mc.group_attn_n > 1 else ""),
+        audio_path=mc.audio_path,
+        options=",".join(
+            ([f"ga_n={mc.group_attn_n},ga_w={mc.group_attn_w}"]
+             if mc.group_attn_n > 1 else [])
+            + ([f"controlnet={mc.controlnet}"] if mc.controlnet else [])),
     )
 
 
@@ -83,6 +86,8 @@ def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict
         opts.images.append(img)
     for aud in o.get("audios", []) or []:
         opts.audios.append(aud)
+    for vid in o.get("videos", []) or []:
+        opts.videos.append(vid)
     return opts
 
 

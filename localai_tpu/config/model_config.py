@@ -137,6 +137,13 @@ class ModelConfig:
     # backend.py:169-357): default scheduler for this model
     # (one of SCHEDULERS below; models/sd.py implements them)
     scheduler: str = ""
+    # ControlNet dir (diffusers ControlNetModel layout), absolute or
+    # relative to the pipeline dir (reference: diffusers backend
+    # controlnet attach, backend.py:297-314)
+    controlnet: str = ""
+    # voice clone: reference audio for tone-color conditioning
+    # (reference: ModelOptions.AudioPath, vall-e-x/backend.py:61-68)
+    audio_path: str = ""
     # speculative decoding (future)
     draft_model: str = ""
     # LoRA (reference: backend.proto LoraAdapter/LoraBase/LoraScale)

@@ -462,7 +462,8 @@ def synthesize(params: dict, cfg: VitsConfig, input_ids: np.ndarray,
                noise_scale: Optional[float] = None,
                noise_scale_duration: Optional[float] = None,
                speaking_rate: Optional[float] = None,
-               frame_pad_to: Optional[int] = None) -> np.ndarray:
+               frame_pad_to: Optional[int] = None,
+               speaker_embedding: Optional[np.ndarray] = None) -> np.ndarray:
     """input_ids [T] -> waveform float32 [samples].
 
     Host-side orchestration: the duration pass determines the (data-
@@ -483,7 +484,11 @@ def synthesize(params: dict, cfg: VitsConfig, input_ids: np.ndarray,
     hidden_ct = hidden.transpose(0, 2, 1)
 
     cond = None
-    if cfg.num_speakers > 1 and speaker_id is not None:
+    if speaker_embedding is not None:
+        # voice clone (models/voice_clone.py): a tone-color embedding
+        # replaces the speaker-id table lookup on the SAME cond pathway
+        cond = jnp.asarray(speaker_embedding, jnp.float32)[None, :, None]
+    elif cfg.num_speakers > 1 and speaker_id is not None:
         emb = p("embed_speaker.weight")[speaker_id]
         cond = emb[None, :, None]
 
