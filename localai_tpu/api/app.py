@@ -167,15 +167,35 @@ async def sse_response(request, chunks: "asyncio.Queue"):
     })
     await resp.prepare(request)
     try:
-        while True:
-            item = await chunks.get()
-            if item is chunks.sentinel:
-                break
-            if isinstance(item, Exception):
-                payload = {"error": {"message": str(item), "type": "server_error"}}
-                await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
-                break
-            await resp.write(f"data: {json.dumps(item, ensure_ascii=False)}\n\n".encode())
+        done = False
+        while not done:
+            # greedy drain: one socket write per batch of queued chunks.
+            # A decode burst delivers many tokens at once, and per-token
+            # write+flush is the dominant host cost of the SSE path on a
+            # 1-core rig (VERDICT r4 #2)
+            batch = [await chunks.get()]
+            while True:
+                try:
+                    batch.append(chunks.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            out = bytearray()
+            for item in batch:
+                if item is chunks.sentinel:
+                    done = True
+                    break
+                if isinstance(item, Exception):
+                    payload = {"error": {"message": str(item),
+                                         "type": "server_error"}}
+                    out += f"data: {json.dumps(payload)}\n\n".encode()
+                    done = True
+                    break
+                if isinstance(item, (bytes, bytearray)):
+                    out += item   # pre-framed by the route (already "data: ...\n\n")
+                else:
+                    out += f"data: {json.dumps(item, ensure_ascii=False)}\n\n".encode()
+            if out:
+                await resp.write(bytes(out))
         await resp.write(b"data: [DONE]\n\n")
     except (ConnectionResetError, asyncio.CancelledError):
         raise

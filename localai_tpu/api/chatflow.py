@@ -68,6 +68,8 @@ def build_chat_prompt(mc: ModelConfig, messages: list, tokenizer=None,
             text = T.multimodal_placeholders(
                 mc.template.multimodal, text,
                 n_images=len(imgs), n_audios=len(auds), n_videos=len(vids),
+                img_offset=len(all_images), audio_offset=len(all_audios),
+                vid_offset=len(all_videos),
             )
         all_images += imgs
         all_audios += auds

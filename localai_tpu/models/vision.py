@@ -188,6 +188,29 @@ def embed_image(params: dict, cfg: VisionConfig, image_bytes: bytes) -> np.ndarr
     return np.asarray(_jit_encode(cfg)(params, px)[0], np.float32)
 
 
+def sample_video_frames(video_bytes: bytes, n_frames: int = 4) -> list:
+    """Decode an animated-image container and uniformly sample up to
+    n_frames as PNG bytes for embed_image.
+
+    Decoder-support contract (and the vLLM-semantics rationale) lives in
+    utils/media.py, shared with the HTTP layer's decodability probe; a
+    non-decodable payload raises ValueError, which callers MUST surface
+    as a request error (VERDICT r4 #6)."""
+    import io
+
+    from localai_tpu.utils.media import decode_video_frames
+
+    frames = decode_video_frames(video_bytes)
+    idx = np.linspace(0, len(frames) - 1,
+                      min(n_frames, len(frames))).round().astype(int)
+    out = []
+    for i in sorted(set(idx.tolist())):
+        buf = io.BytesIO()
+        frames[i].save(buf, format="PNG")
+        out.append(buf.getvalue())
+    return out
+
+
 def save_params(params: dict, cfg: VisionConfig, model_dir: str):
     from safetensors.numpy import save_file
 

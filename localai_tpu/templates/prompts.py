@@ -89,12 +89,22 @@ def render_edit(template: str, instruction: str, prompt: str) -> str:
 
 
 def multimodal_placeholders(template: str, text: str, n_images: int = 0,
-                            n_audios: int = 0, n_videos: int = 0) -> str:
+                            n_audios: int = 0, n_videos: int = 0,
+                            img_offset: int = 0, audio_offset: int = 0,
+                            vid_offset: int = 0) -> str:
     """Inject [img-N]/[audio-N]/[vid-N] placeholders before the text
-    (reference: pkg/templates/multimodal.go:24-26 default template)."""
-    imgs = "".join(f"[img-{i}]" for i in range(n_images))
-    auds = "".join(f"[audio-{i}]" for i in range(n_audios))
-    vids = "".join(f"[vid-{i}]" for i in range(n_videos))
+    (reference: pkg/templates/multimodal.go:24-26 default template).
+
+    N is GLOBAL across the whole chat (offsets = media count in earlier
+    messages): the backend resolves [vid-N] against one request-wide
+    media list, so per-message numbering would alias every message's
+    first video onto index 0."""
+    imgs = "".join(f"[img-{i}]"
+                   for i in range(img_offset, img_offset + n_images))
+    auds = "".join(f"[audio-{i}]"
+                   for i in range(audio_offset, audio_offset + n_audios))
+    vids = "".join(f"[vid-{i}]"
+                   for i in range(vid_offset, vid_offset + n_videos))
     if template:
         return render(template, Text=text, ImagesCount=n_images, AudiosCount=n_audios,
                       VideosCount=n_videos, Images=imgs, Audios=auds, Videos=vids)

@@ -92,6 +92,39 @@ def test_chat_completion_nonstream(server):
     assert body["usage"]["total_tokens"] > 0
 
 
+def test_media_parts_rejected_loudly(server):
+    """r5 (VERDICT r4 #6): audio parts and image/video parts on a model
+    without a vision projector return 400 — never a silent drop."""
+    base = f"{server.base}/v1/chat/completions"
+    r = httpx.post(base, json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "what does this say"},
+            {"type": "input_audio", "input_audio": {"data": "aGk="}}]}],
+    }, timeout=60)
+    assert r.status_code == 400, r.text
+    assert "audio" in r.json()["error"]["message"]
+    tiny_png = ("iVBORw0KGgoAAAANSUhEUgAAAAEAAAABCAYAAAAfFcSJAAAADUlEQVR4"
+                "2mP8z8BQDwAEhQGAhKmMIQAAAABJRU5ErkJggg==")
+    r2 = httpx.post(base, json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "describe"},
+            {"type": "image_url",
+             "image_url": {"url": f"data:image/png;base64,{tiny_png}"}}]}],
+    }, timeout=60)
+    assert r2.status_code == 400, r2.text
+    assert "mmproj" in r2.json()["error"]["message"]
+    r3 = httpx.post(base, json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "describe"},
+            {"type": "video_url",
+             "video_url": {"url": f"data:video/mp4;base64,{tiny_png}"}}]}],
+    }, timeout=60)
+    assert r3.status_code == 400, r3.text
+
+
 def test_chat_completion_stream_sse(server):
     with httpx.stream("POST", f"{server.base}/v1/chat/completions", json={
         "model": "tiny", "stream": True,

@@ -39,6 +39,29 @@ def test_multimodal_placeholders_default():
     assert out == "[img-0][img-1]\ndescribe this"
 
 
+def test_multimodal_numbering_is_global_across_messages():
+    """Media lists are accumulated request-wide, so placeholder indices
+    must continue across messages — per-message restart would alias every
+    message's first video onto opts.videos[0] (r5 code-review finding)."""
+    from localai_tpu.api.chatflow import build_chat_prompt
+    from localai_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig(name="m")
+    msgs = [
+        {"role": "user", "content": [
+            {"type": "text", "text": "first"},
+            {"type": "video_url", "video_url": {"url": "data:video/gif;base64,QUFB"}}]},
+        {"role": "user", "content": [
+            {"type": "text", "text": "second"},
+            {"type": "video_url", "video_url": {"url": "data:video/gif;base64,QkJC"}},
+            {"type": "image_url", "image_url": {"url": "data:image/png;base64,Q0ND"}}]},
+    ]
+    prompt, images, audios, videos = build_chat_prompt(mc, msgs)
+    assert "[vid-0]" in prompt and "[vid-1]" in prompt
+    assert "[img-0]" in prompt
+    assert len(videos) == 2 and len(images) == 1
+
+
 def test_multimodal_custom_template():
     out = T.multimodal_placeholders(
         "{{ Images }} TEXT: {{ Text }}", "hello", n_images=1)

@@ -91,5 +91,79 @@ def test_multimodal_chat_through_engine(tmp_path):
         r4 = sv.Predict(pb.PredictOptions(
             prompt="hello", max_tokens=4, ignore_eos=True, temperature=0.0), None)
         assert r4.tokens == 4
+
+        # r5 (VERDICT r4 #6): VIDEO parts are consumed — a GIF's frames
+        # ride the same tower; different videos -> different generations
+        def gif_b64(colors):
+            from PIL import Image
+
+            frames = [Image.new("RGB", (20, 20), c) for c in colors]
+            buf = io.BytesIO()
+            frames[0].save(buf, format="GIF", save_all=True,
+                           append_images=frames[1:], duration=100)
+            return base64.b64encode(buf.getvalue()).decode()
+
+        def ask_vid(vid, prompt):
+            return sv.Predict(pb.PredictOptions(
+                prompt=prompt, videos=[vid], max_tokens=6, ignore_eos=True,
+                temperature=0.0), None)
+
+        v1 = ask_vid(gif_b64(["red", "green", "blue"]), "[vid-0]\nwhat")
+        v2 = ask_vid(gif_b64(["red", "green", "blue"]), "[vid-0]\nwhat")
+        v3 = ask_vid(gif_b64(["black", "white"]), "[vid-0]\nwhat")
+        assert v1.tokens == 6
+        assert v1.message == v2.message
+        assert v1.message != v3.message  # video content matters
+        # each sampled frame injects num_patches rows
+        assert v1.prompt_tokens >= 3 * TINY_VCFG.num_patches
     finally:
         sv.engine.shutdown()
+
+
+def test_media_parts_rejected_loudly(tmp_path):
+    """The forbidden outcome is a silent drop: audio parts and media on a
+    vision-less model must error at the backend boundary (the HTTP layer
+    400s first; this is the gRPC backstop)."""
+    os.environ["LOCALAI_PRECOMPILE"] = "0"
+    import localai_tpu.backend.runner as runner
+    from tests.tinymodel import write_tiny_checkpoint
+
+    mdir = str(tmp_path / "llm")
+    os.makedirs(mdir)
+    write_tiny_checkpoint(mdir)
+    sv = runner.EngineServicer()
+    res = sv.LoadModel(pb.ModelOptions(
+        model=mdir, num_slots=2, context_size=64,
+        prefill_buckets=[16], mesh_tp=1, mesh_dp=1), None)
+    assert res.success, res.message
+    try:
+        with pytest.raises(ValueError, match="audio content parts"):
+            sv._build_request(pb.PredictOptions(
+                prompt="x", audios=["aGk="], max_tokens=2))
+        with pytest.raises(ValueError, match="vision-capable"):
+            sv._build_request(pb.PredictOptions(
+                prompt="x", images=["aGk="], max_tokens=2))
+        with pytest.raises(ValueError, match="vision-capable"):
+            sv._build_request(pb.PredictOptions(
+                prompt="x", videos=["aGk="], max_tokens=2))
+    finally:
+        sv.engine.shutdown()
+
+
+def test_undecodable_video_raises():
+    with pytest.raises(ValueError, match="undecodable video"):
+        vision.sample_video_frames(b"\x00\x00\x00\x18ftypmp42 not a real mp4")
+
+
+def test_video_frame_sampling():
+    from PIL import Image
+
+    frames = [Image.new("RGB", (8, 8), (i * 20, 0, 0)) for i in range(10)]
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True, append_images=frames[1:],
+                   duration=50)
+    out = vision.sample_video_frames(buf.getvalue(), n_frames=4)
+    assert len(out) == 4
+    # uniform coverage: first and last frames always included
+    first = Image.open(io.BytesIO(out[0])).convert("RGB")
+    assert first.getpixel((0, 0))[0] <= 30
