@@ -44,6 +44,14 @@ METHODS = {
 }
 
 
+def parse_options(options: str) -> dict:
+    """ModelOptions.options wire format ("k=v,k2=v2", produced by
+    capabilities.build_model_options) -> dict. The ONE parser every
+    backend shares."""
+    return dict(kv.split("=", 1) for kv in (options or "").split(",")
+                if "=" in kv)
+
+
 class BackendServicer:
     """Base servicer: every RPC answers UNIMPLEMENTED unless overridden.
 

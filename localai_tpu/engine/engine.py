@@ -106,6 +106,7 @@ class GenRequest:
     request_id: str = ""
     # filled by engine:
     out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
+    t_submit: float = 0.0      # stamped by Engine.submit (TTFT decomposition)
 
     def __post_init__(self):
         if not self.request_id:
@@ -266,6 +267,8 @@ class Engine:
         # fork-dedup, multimodal injection, speculative draft, ga).
         self.family = family if family is not None else llama
         self._fam_llama = self.family is llama
+        self._fam_name = getattr(self.family, "__name__",
+                                 "llama").rsplit(".", 1)[-1]
         if not self._fam_llama:
             assert draft is None, "draft speculation is llama-family only"
             assert self.ecfg.ga_n <= 1, "self-extend is llama-family only"
@@ -331,6 +334,13 @@ class Engine:
         self._load_time = time.monotonic()
         self._total_tokens = 0
         self._reused_total = 0
+        # (queue_wait_ms, admit_to_first_ms, prefill_ms) per finished
+        # request — rolling window for the TTFT decomposition in metrics()
+        from collections import deque
+        self._ttft_decomp: "deque" = deque(maxlen=512)
+        # at maxlen every append mutates the deque, and metrics() reads it
+        # from gRPC handler threads — unsynchronized iteration raises
+        self._decomp_lock = threading.Lock()
         self._rollbacks = 0     # grammar rollbacks (test observability)
 
         self._burst_fns: dict[int, Callable] = {}
@@ -433,23 +443,35 @@ class Engine:
         Falls back to replication per axis when sizes don't divide — a
         wrong-but-silent replicated cache is exactly the HBM waste this
         exists to avoid, so only shard what divides evenly."""
-        if self.mesh is None or not self._fam_llama:
-            return None   # non-llama cache layouts are replicated for now
+        if self.mesh is None:
+            return None
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         dp = self.mesh.shape.get("dp", 1)
         tp = self.mesh.shape.get("tp", 1)
         slot_ax = "dp" if dp > 1 and self.ecfg.num_slots % dp == 0 else None
-        kv_ax = "tp" if tp > 1 and self.cfg.num_kv_heads % tp == 0 else None
+        if self._fam_llama:
+            # [L, S, C, KV, hd]: kv heads on tp
+            kv_ax = "tp" if tp > 1 and self.cfg.num_kv_heads % tp == 0 \
+                else None
+            cache_spec = (None, slot_ax, None, kv_ax, None)
+        elif self._fam_name == "mamba":
+            # mamba conv/ssm state [L, S, Di, {K-1|N}]: d_inner on tp,
+            # matching mamba_param_specs so the recurrence is shard-local
+            di_ax = "tp" if tp > 1 and self.cfg.d_inner % tp == 0 else None
+            cache_spec = (None, slot_ax, di_ax, None)
+        else:
+            # rwkv state [L, S, {4|1}, D]: D is the trailing axis; params
+            # are replicated for this family, so keep D unsharded too
+            cache_spec = (None, slot_ax, None, None)
 
         def ns(*spec):
             return NamedSharding(self.mesh, P(*spec))
 
         return {
-            # [L, S, C, KV, hd]; kept as a raw spec tuple because the int8
-            # cache is a pytree whose scale leaf drops the hd axis
-            # (kvcache.device_put builds both NamedShardings from it)
-            "cache_spec": (None, slot_ax, None, kv_ax, None),
+            # raw spec tuple: the int8 llama cache is a pytree whose scale
+            # leaf drops the hd axis (kvcache.device_put builds both)
+            "cache_spec": cache_spec,
             "slot_vec": ns(slot_ax),                        # [S]
             "slot_mat": ns(slot_ax, None),                  # [S, V] / [S, 2]
         }
@@ -903,6 +925,7 @@ class Engine:
         self._gbias_flush = set()
 
     def submit(self, req: GenRequest) -> "queue.Queue":
+        req.t_submit = time.monotonic()
         self._queue.put(req)
         self._wake.set()
         return req.out
@@ -939,7 +962,7 @@ class Engine:
             dt = time.monotonic() - (s.t_first_token or s.t_start)
             if s.n_decoded and dt > 0:
                 tok_s += s.n_decoded / dt
-        return {
+        out = {
             "slots_total": self.ecfg.num_slots,
             "slots_active": len(active),
             "queued": self._queue.qsize(),
@@ -948,6 +971,18 @@ class Engine:
             "prompt_tokens_reused": self._reused_total,
             "uptime_s": time.monotonic() - self._load_time,
         }
+        with self._decomp_lock:
+            d = list(self._ttft_decomp)
+        if d:
+            qw, af, pf = (sorted(x[i] for x in d) for i in range(3))
+            mid = len(d) // 2
+            out["ttft_decomp_p50_ms"] = {
+                "queue_wait": round(qw[mid], 1),
+                "admit_to_first": round(af[mid], 1),
+                "prefill_dispatch": round(pf[mid], 1),
+                "n": len(d),
+            }
+        return out
 
     # ---------- grammar-constrained decoding ----------
 
@@ -1022,6 +1057,11 @@ class Engine:
                          for i in padded])
         self.bias = self.bias.at[np.asarray(padded, np.int32)].set(
             jnp.asarray(rows))
+        if self._bus is not None:
+            from localai_tpu.parallel.lockstep import encode_bias_row
+
+            self._bus.send("bias_rows", slots=list(padded),
+                           rows=[encode_bias_row(r) for r in rows])
         for i in slots:
             self._bias_dirty[i] = True
 
@@ -1212,12 +1252,10 @@ class Engine:
     def _start_request(self, req: GenRequest):
         """Admit a request: install sampling state and queue its prompt for
         chunked prefill. No model compute happens here."""
-        if self._bus is not None and (
-                req.grammar or req.params.logit_bias
-                or req.mm_vectors is not None or req.prompt_cache_path):
+        if self._bus is not None and req.mm_vectors is not None:
             raise ValueError(
-                "grammar/logit_bias/multimodal/prompt-cache are not "
-                "supported in multi-host lockstep mode")
+                "multimodal injection is not supported in multi-host "
+                "lockstep mode")
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
         # truncate the prompt head, keeping the tail (reference semantics:
@@ -1291,15 +1329,26 @@ class Engine:
                     bias_base[t] = float(b)
             penalty0 = self._mask_builder.penalty_row(grammar, gstate)
             self.bias = self.bias.at[slot].set(jnp.asarray(bias_base + penalty0))
+            if self._bus is not None:
+                from localai_tpu.parallel.lockstep import encode_bias_row
+
+                self._bus.send("bias_rows", slots=[slot],
+                               rows=[encode_bias_row(bias_base + penalty0)])
             self._bias_dirty[slot] = True
         elif req.params.logit_bias:
             self.bias = sampling.set_slot_logit_bias(self.bias, slot, req.params)
+            if self._bus is not None:
+                self._bus.send("bias_sparse", slot=slot,
+                               pairs={int(t): float(b) for t, b in
+                                      req.params.logit_bias.items()})
             self._bias_dirty[slot] = True
         elif self._bias_dirty[slot]:
             # clear a previous request's grammar mask / bias row; skipping
             # the device write for never-biased slots keeps admission free of
             # dispatches in the common case
             self.bias = self.bias.at[slot].set(0.0)
+            if self._bus is not None:
+                self._bus.send("bias_clear", slot=slot)
             self._bias_dirty[slot] = False
 
         # penalty ring covers the prompt tail (llama.cpp last-n semantics
@@ -1453,6 +1502,32 @@ class Engine:
             self._fork_fns["restore"] = fn
         return fn
 
+    def _load_prompt_cache_rows(self, path: str, m: int):
+        """Read a prompt-cache file into float16 staging arrays sized to
+        the full cache row shape with rows [:m] filled. Returns
+        (kfull, vfull, tokens) or (None, None, None) if unreadable.
+        Shared by the leader's restore path and the lockstep follower's
+        cache_restore replay (both must build IDENTICAL inputs)."""
+        L, _, C, KV, hd = kvcache.shape(self.ck)
+        try:
+            data = np.load(path)
+            ctoks = data["tokens"].tolist()
+            # float16 staging (matches the file; halves the host alloc +
+            # host->device transfer vs float32 — runs on the engine loop).
+            # The row copies stay INSIDE the try: a concurrent re-save
+            # (shorter prefix) or a different-config file surfaces as a
+            # shape-mismatch ValueError here, and must degrade to
+            # no-reuse, not fail the engine loop / kill a follower
+            kfull = np.zeros((L, C, KV, hd), np.float16)
+            vfull = np.zeros((L, C, KV, hd), np.float16)
+            kfull[:, :m] = data["k"][:, :m]
+            vfull[:, :m] = data["v"][:, :m]
+        except Exception:
+            __import__("logging").getLogger(__name__).exception(
+                "unreadable prompt cache %s", path)
+            return None, None, None
+        return kfull, vfull, ctoks
+
     def _restore_prompt_cache(self, slot: int, req: GenRequest, ids: list,
                               common: int) -> int:
         """If the request names a prompt-cache file whose saved prefix beats
@@ -1463,8 +1538,7 @@ class Engine:
         if not path or not os.path.exists(path):
             return common
         try:
-            data = np.load(path)
-            ctoks = data["tokens"].tolist()
+            ctoks = np.load(path)["tokens"].tolist()
         except Exception:
             log_ = __import__("logging").getLogger(__name__)
             log_.exception("unreadable prompt cache %s", path)
@@ -1477,16 +1551,52 @@ class Engine:
         m = min(m, len(ids) - 1, self.ecfg.max_context - 1)
         if m <= common or m < 16:
             return common
-        L, _, C, KV, hd = kvcache.shape(self.ck)
-        # float16 staging (matches the file; halves the host alloc +
-        # host->device transfer vs float32 — this runs on the engine loop)
-        kfull = np.zeros((L, C, KV, hd), np.float16)
-        vfull = np.zeros((L, C, KV, hd), np.float16)
-        kfull[:, :m] = data["k"][:, :m]
-        vfull[:, :m] = data["v"][:, :m]
+        # re-compare the second read's tokens: a concurrent atomic re-save
+        # between the two np.load calls would otherwise install KV rows
+        # from a different file version than the prefix validated above
+        kfull, vfull, ctoks2 = self._load_prompt_cache_rows(path, m)
+        if kfull is None or ctoks2[:m] != ids[:m]:
+            return common
+        if self._bus is not None:
+            # followers replay the same restore body from the same file
+            # (shared filesystem); the token prefix rides along so a
+            # follower seeing a DIFFERENT file version fails loudly
+            # instead of silently diverging the mesh
+            self._bus.send("cache_restore", slot=slot, m=m, path=path,
+                           tokens=ctoks[:m])
         self.ck, self.cv = self._get_restore_fn()(
             self.ck, self.cv, kfull, vfull, slot, m)
         return m
+
+    def _get_cache_export_fn(self, n2: int):
+        """Jitted (ck, cv, slot) -> dense float16 rows [L, n2, KV, hd],
+        REPLICATED on the mesh: in multi-process serving the slot's rows
+        live sharded across processes, so exporting them is a collective
+        every process must issue (lockstep op "cache_save")."""
+        key = ("export", n2)
+        fn = self._fork_fns.get(key)
+        if fn is None:
+            out_sh = None
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                out_sh = NamedSharding(self.mesh, P())
+
+            def body(ck, cv, slot):
+                kr = kvcache.slot_rows(ck, slot)
+                vr = kvcache.slot_rows(cv, slot)
+                if kvcache.is_quant(kr):
+                    kr = {"q": kr["q"][:, :n2], "s": kr["s"][:, :n2]}
+                    vr = {"q": vr["q"][:, :n2], "s": vr["s"][:, :n2]}
+                else:
+                    kr, vr = kr[:, :n2], vr[:, :n2]
+                return (kvcache.rows_to_float(kr, jnp.float16),
+                        kvcache.rows_to_float(vr, jnp.float16))
+
+            fn = jax.jit(body, static_argnums=(),
+                         out_shardings=(out_sh, out_sh) if out_sh else None)
+            self._fork_fns[key] = fn
+        return fn
 
     def _save_prompt_cache(self, slot: int, s: "_Slot"):
         """Persist the slot's committed rows + tokens on finish."""
@@ -1515,31 +1625,21 @@ class Engine:
             while n2 < n:
                 n2 *= 2
             n2 = min(n2, self.ecfg.max_context)
-            if kvcache.is_quant(self.ck):
-                # slice int8 rows + scales on device; dequantize on the
-                # background thread (files stay dense f16 so a bf16-cache
-                # engine can restore what an int8-cache engine saved)
-                k_dev = {"q": self.ck["q"][:, slot, :n2],
-                         "s": self.ck["s"][:, slot, :n2]}
-                v_dev = {"q": self.cv["q"][:, slot, :n2],
-                         "s": self.cv["s"][:, slot, :n2]}
-            else:
-                k_dev = self.ck[:, slot, :n2]
-                v_dev = self.cv[:, slot, :n2]
+            # dense-f16 export on device (dequantizes int8 rows in-jit, so
+            # the file format is cache-dtype independent); in lockstep
+            # mode the export is a replicated all-gather COLLECTIVE, so
+            # the descriptor goes out first and every process issues it
+            if self._bus is not None:
+                self._bus.send("cache_save", slot=slot, n2=n2)
+            k_dev, v_dev = self._get_cache_export_fn(n2)(
+                self.ck, self.cv, np.int32(slot))
             path = req.prompt_cache_path
             toks = np.asarray(tokens[:n], np.int32)
 
-            def _host_rows(dev):
-                if isinstance(dev, dict):
-                    q = np.asarray(dev["q"], np.float32)[:, :n]
-                    s = np.asarray(dev["s"], np.float32)[:, :n]
-                    return (q * s[..., None]).astype(np.float16)
-                return np.asarray(dev)[:, :n].astype(np.float16)
-
             def write():
                 try:
-                    k = _host_rows(k_dev)
-                    v = _host_rows(v_dev)
+                    k = np.asarray(k_dev)[:, :n]
+                    v = np.asarray(v_dev)[:, :n]
                     tmp = path + ".tmp"
                     with open(tmp, "wb") as f:
                         np.savez(f, tokens=toks, k=k, v=v)
@@ -2415,11 +2515,24 @@ class Engine:
         buf = self._sink_buf
         if finish:
             dt = time.monotonic() - s.t_first_token
+            # TTFT decomposition (VERDICT r4 #9): how long the request sat
+            # in the admission queue vs the admit->first-token span (which
+            # itself splits into prefill dispatch time, t_prefill_ms, and
+            # waiting on other slots' work)
+            queue_wait_ms = max(0.0, (s.t_start - s.req.t_submit) * 1e3) \
+                if s.req.t_submit else 0.0
+            admit_to_first_ms = max(0.0, (s.t_first_token - s.t_start) * 1e3) \
+                if s.t_first_token else 0.0
             ev.timings = {
                 "prefill_ms": s.t_prefill_ms,
+                "queue_wait_ms": queue_wait_ms,
+                "admit_to_first_ms": admit_to_first_ms,
                 "reused_prompt_tokens": s.reused,
                 "decode_tokens_per_s": (s.n_decoded - 1) / dt if dt > 0 and s.n_decoded > 1 else 0.0,
             }
+            with self._decomp_lock:
+                self._ttft_decomp.append(
+                    (queue_wait_ms, admit_to_first_ms, s.t_prefill_ms))
             self._save_prompt_cache(slot, s)
             self._release_slot(slot)
             if buf is not None:

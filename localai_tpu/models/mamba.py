@@ -83,6 +83,24 @@ def _rms(x, w, eps):
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
+# the {q, s} int8 contract is shared by every family — see ops/quant.py
+from localai_tpu.ops.quant import mat as _mat  # noqa: E402
+
+QUANT_NAMES = ("in_proj_x", "in_proj_z", "x_proj", "dt_proj_w", "out_proj")
+
+
+def quantize_params(params: dict) -> dict:
+    """Weight-only per-out-channel int8 for the mixer projections (the
+    bulk of mamba's weights; conv/norm/A/D stay dense — tiny, and the SSM
+    recurrence itself is precision-sensitive)."""
+    from localai_tpu.ops.quant import quantize_weight as q
+
+    out = dict(params)
+    out["layers"] = {k: (q(v) if k in QUANT_NAMES else v)
+                     for k, v in params["layers"].items()}
+    return out
+
+
 def init_params(cfg: MambaConfig, key: jax.Array, dtype=None) -> dict:
     dtype = dtype or cfg.dtype
     L, D, Di = cfg.num_layers, cfg.hidden_size, cfg.d_inner
@@ -100,7 +118,12 @@ def init_params(cfg: MambaConfig, key: jax.Array, dtype=None) -> dict:
         "final_norm": jnp.ones((D,), dtype),
         "layers": {
             "norm": jnp.ones((L, D), dtype),
-            "in_proj": init(ks[1], (L, D, 2 * Di), D),
+            # HF stores in_proj as one [D, 2*Di] matrix ([x; z] halves);
+            # kept SPLIT here so tensor parallelism shards each half's
+            # d_inner axis evenly (a contiguous split of the concatenated
+            # axis would put all x on some devices and all z on others)
+            "in_proj_x": init(ks[1], (L, D, Di), D),
+            "in_proj_z": init(ks[7], (L, D, Di), D),
             "conv_w": init(ks[2], (L, Di, K), K),
             "conv_b": jnp.zeros((L, Di), dtype),
             "x_proj": init(ks[3], (L, Di, R + 2 * N), Di),
@@ -136,12 +159,16 @@ def load_hf_params(model_dir: str, cfg: MambaConfig, dtype=jnp.float32) -> dict:
         return jnp.asarray(np.stack(mats), dtype)
 
     ly = "layers.{i}.mixer."
+    in_proj = np.stack([get((ly + "in_proj.weight").format(i=i)).T
+                        for i in range(L)])          # [L, D, 2*Di]
+    Di = cfg.d_inner
     params = {
         "embed": jnp.asarray(get("embeddings.weight"), dtype),
         "final_norm": jnp.asarray(get("norm_f.weight"), dtype),
         "layers": {
             "norm": stack("layers.{i}.norm.weight"),
-            "in_proj": stack(ly + "in_proj.weight", True),
+            "in_proj_x": jnp.asarray(in_proj[:, :, :Di], dtype),
+            "in_proj_z": jnp.asarray(in_proj[:, :, Di:], dtype),
             # conv1d weight [Di, 1, K] -> [Di, K] (depthwise)
             "conv_w": jnp.asarray(np.stack(
                 [get((ly + "conv1d.weight").format(i=i))[:, 0, :]
@@ -182,14 +209,15 @@ def _mixer_step(h, conv_st, ssm_st, ly, cfg):
     """One token through one mixer. h [B, D]; conv_st [B, Di, K-1];
     ssm_st [B, Di, N]. Returns (out [B, D], conv_st, ssm_st)."""
     R, N = cfg.time_step_rank, cfg.state_size
-    xz = h @ ly["in_proj"]                       # [B, 2*Di]
-    x, z = jnp.split(xz, 2, axis=-1)
+    dt_ = h.dtype
+    x = h @ _mat(ly["in_proj_x"], dt_)           # [B, Di]
+    z = h @ _mat(ly["in_proj_z"], dt_)
     window = jnp.concatenate([conv_st, x[:, :, None]], axis=-1)  # [B,Di,K]
     conv_st = window[:, :, 1:]
     x = jnp.sum(window * ly["conv_w"][None], axis=-1) + ly["conv_b"][None]
     x = jax.nn.silu(x)                           # [B, Di]
-    proj = x @ ly["x_proj"]                      # [B, R+2N]
-    dt = proj[:, :R] @ ly["dt_proj_w"] + ly["dt_proj_b"][None]
+    proj = x @ _mat(ly["x_proj"], x.dtype)       # [B, R+2N]
+    dt = proj[:, :R] @ _mat(ly["dt_proj_w"], proj.dtype) + ly["dt_proj_b"][None]
     dt = jax.nn.softplus(dt)                     # [B, Di]
     Bm = proj[:, R:R + N]                        # [B, N]
     Cm = proj[:, R + N:]
@@ -202,7 +230,8 @@ def _mixer_step(h, conv_st, ssm_st, ly, cfg):
     # conv/ssm state stays fp32 (recurrences are precision-sensitive) but
     # the residual path must return to the model dtype — otherwise the
     # fp32 state promotes every later layer's matmuls to f32
-    return (y @ ly["out_proj"]).astype(cfg.dtype), conv_st, ssm_st
+    return ((y @ _mat(ly["out_proj"], y.dtype)).astype(cfg.dtype),
+            conv_st, ssm_st)
 
 
 def _layer_scan(params, cfg, h, conv, ssm, active=None):

@@ -44,6 +44,39 @@ def llama_param_specs(tie_word_embeddings: bool = False) -> dict:
     return specs
 
 
+def mamba_param_specs(tie_word_embeddings: bool = True) -> dict:
+    """Megatron-style tp for the mamba mixer (VERDICT r4 #7): d_inner is
+    the parallel axis — in_proj_x/z column-parallel, out_proj
+    row-parallel (psum), conv/x_proj/dt/A/D sharded on their Di axis so
+    the whole recurrence stays device-local per Di shard."""
+    specs = {
+        "embed": P("tp", None),
+        "layers": {
+            "norm": P(None, None),
+            "in_proj_x": P(None, None, "tp"),
+            "in_proj_z": P(None, None, "tp"),
+            "conv_w": P(None, "tp", None),
+            "conv_b": P(None, "tp"),
+            "x_proj": P(None, "tp", None),
+            "dt_proj_w": P(None, None, "tp"),
+            "dt_proj_b": P(None, "tp"),
+            "A_log": P(None, "tp", None),
+            "D": P(None, "tp"),
+            "out_proj": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def mamba_state_spec() -> P:
+    # conv [L, S, Di, K-1] / ssm [L, S, Di, N]: slots on dp, Di on tp —
+    # matches the param sharding so each device's recurrence is local
+    return P(None, "dp", "tp", None)
+
+
 def cache_spec() -> P:
     # [L, S, C, KV, hd]: slots on dp, kv heads on tp
     return P(None, "dp", None, "tp", None)
@@ -60,13 +93,15 @@ def to_named(mesh: Mesh, tree):
     )
 
 
-def shard_params(mesh: Mesh, params: dict, tie_word_embeddings: bool = False) -> dict:
-    """Device_put a param pytree onto the mesh with the llama specs.
+def shard_params(mesh: Mesh, params: dict, tie_word_embeddings: bool = False,
+                 specs: dict = None) -> dict:
+    """Device_put a param pytree onto the mesh (llama specs by default;
+    pass specs=mamba_param_specs(...) for the mamba family).
 
     int8-quantized leaves ({"q": int8 weight, "s": per-out-channel scale})
     shard q with the weight's spec and s with the spec's trailing axes
     (scales follow the output-channel partitioning)."""
-    specs = llama_param_specs(tie_word_embeddings)
+    specs = specs or llama_param_specs(tie_word_embeddings)
 
     def put(x, spec):
         if isinstance(x, dict) and "q" in x:
