@@ -330,7 +330,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
 
     lock = threading.Lock()
     state = {"completed": 0, "ttfts": [], "errors": [], "stop": False,
-             "launched": 0}
+             "launched": 0, "decomp": []}
     done = threading.Event()
 
     # constrained-decode mode (LOCALAI_BENCH_GRAMMAR=1): every request
@@ -372,6 +372,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
             out = engine.submit(r)
             ttft = None
             completion = 0
+            decomp = None
             while True:
                 ev = out.get()
                 if ev is None:
@@ -383,10 +384,16 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
                         state["errors"].append(ev.error)
                 if ev.finish_reason:
                     completion = ev.completion_tokens
+                    if ev.timings:
+                        decomp = (ev.timings.get("queue_wait_ms", 0.0),
+                                  ev.timings.get("admit_to_first_ms", 0.0),
+                                  ev.timings.get("prefill_ms", 0.0))
             with lock:
                 state["completed"] += completion
                 if ttft is not None:
                     state["ttfts"].append(ttft)
+                if decomp is not None:
+                    state["decomp"].append(decomp)
                 if state["completed"] >= target_tokens or state["errors"]:
                     state["stop"] = True
                     done.set()
@@ -415,6 +422,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     with lock:
         completed, ttfts, errors = (state["completed"], list(state["ttfts"]),
                                     list(state["errors"]))
+        decomp = list(state["decomp"])
     for t in threads:
         t.join(timeout=10)
 
@@ -433,7 +441,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     engine.shutdown()
     if errors:
         raise RuntimeError(errors[0])
-    return {
+    out = {
         "tok_s": completed / wall,
         "p50_ttft_ms": float(np.percentile(ttfts, 50) * 1e3),
         "p95_ttft_ms": float(np.percentile(ttfts, 95) * 1e3),
@@ -441,6 +449,14 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         "completion_tokens": completed,
         "wall_s": wall,
     }
+    if decomp:
+        d = np.asarray(decomp)
+        out["ttft_decomp_p50_ms"] = {
+            "queue_wait": round(float(np.percentile(d[:, 0], 50)), 1),
+            "admit_to_first": round(float(np.percentile(d[:, 1], 50)), 1),
+            "prefill_dispatch": round(float(np.percentile(d[:, 2], 50)), 1),
+        }
+    return out
 
 
 def bench_kernel(cfg, S, C, steps, inner):
@@ -538,6 +554,8 @@ def main():
             "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
             "p95_ttft_ms": round(r["p95_ttft_ms"], 1),
             "unloaded_ttft_ms": round(r["unloaded_ttft_ms"], 1),
+            **({"ttft_decomp_p50_ms": r["ttft_decomp_p50_ms"]}
+               if "ttft_decomp_p50_ms" in r else {}),
         }))
         return
 

@@ -17,11 +17,18 @@ servers receiving individual tensor ops over TCP
 descriptors (~KBs per burst), never tensors; all tensor traffic rides the
 mesh inside jit.
 
-Feature restrictions in lockstep mode (enforced at admission): no
-grammar/logit-bias (device bias writes), no multimodal, no speculative
-draft, no prompt-cache persistence, no self-extend, no fork-dedup. Each
-is per-slot host logic that would need its own descriptor; the core
-serving path (chat/completions with the full sampler) is covered.
+Feature coverage (r5): grammar-constrained decoding and logit-bias ride
+"bias_*" descriptors (the leader's host-side grammar automaton computes
+mask rows; followers replay the device writes bit-identically via the
+packed encoding below), and prompt-cache persistence rides
+"cache_save"/"cache_restore" descriptors — save runs a replicated
+all-gather of the slot's rows on every process (the leader alone cannot
+fetch remote shards) and the leader writes the file; restore has every
+process read the SAME file (multi-host deployments need the prompt-cache
+dir on a shared filesystem, like the model dir) and replay the same
+restore body. Still restricted (enforced at admission): multimodal
+injection; speculative draft + self-extend (asserted at engine init);
+fork-dedup (leader-internal, disabled when a bus is present).
 """
 
 from __future__ import annotations
@@ -34,6 +41,37 @@ import queue
 from typing import Optional
 
 import numpy as np
+
+_NEG = -1e9  # grammar mask value (functions/grammars/automaton.py:240)
+
+
+def encode_bias_row(row: np.ndarray) -> dict:
+    """Pack a [V] f32 bias row for the wire: a bitmask for entries that
+    are EXACTLY the grammar mask value (-1e9 — the overwhelming majority
+    of a constrained row) + sparse (idx, val) for everything else nonzero.
+    Reconstruction is BIT-exact: follower device state must match the
+    leader's bit-for-bit or the replayed sampling programs diverge."""
+    row = np.asarray(row, np.float32)
+    neg = row == np.float32(_NEG)
+    sparse = np.nonzero(~neg & (row != 0.0))[0].astype(np.int32)
+    return {
+        "n": int(row.shape[0]),
+        "mask": np.packbits(neg).tobytes(),
+        "idx": sparse.tobytes(),
+        "val": row[sparse].tobytes(),
+    }
+
+
+def decode_bias_row(enc: dict) -> np.ndarray:
+    n = enc["n"]
+    row = np.zeros((n,), np.float32)
+    neg = np.unpackbits(np.frombuffer(enc["mask"], np.uint8),
+                        count=n).astype(bool)
+    row[neg] = np.float32(_NEG)
+    idx = np.frombuffer(enc["idx"], np.int32)
+    if idx.size:
+        row[idx] = np.frombuffer(enc["val"], np.float32)
+    return row
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -193,6 +231,38 @@ def follow(engine, bus: "FollowerBus") -> None:
             fn = e._get_chunk_fn(m["bucket"])
             e.ck, e.cv = fn(e.params, m["tokens"], m["seq_len"], e.ck, e.cv,
                             m["slot"], m["start"])
+        elif op == "bias_rows":
+            # grammar mask / combined bias rows: same batched scatter as
+            # the leader's _flush_grammar_bias
+            import jax.numpy as jnp
+
+            rows = np.stack([decode_bias_row(r) for r in m["rows"]])
+            e.bias = e.bias.at[np.asarray(m["slots"], np.int32)].set(
+                jnp.asarray(rows))
+        elif op == "bias_sparse":
+            # plain logit_bias admission write — replay the identical op
+            # sequence (engine.py _start_request logit_bias branch)
+            e.bias = sampling.set_slot_logit_bias(
+                e.bias, m["slot"],
+                sampling.SamplingParamsHost(logit_bias=dict(m["pairs"])))
+        elif op == "bias_clear":
+            e.bias = e.bias.at[m["slot"]].set(0.0)
+        elif op == "cache_save":
+            # replicated all-gather of the slot's rows: a COLLECTIVE, so
+            # every process must issue it; only the leader writes the file
+            e._get_cache_export_fn(m["n2"])(e.ck, e.cv, np.int32(m["slot"]))
+        elif op == "cache_restore":
+            # every process reads the SAME cache file (shared filesystem)
+            # and replays the same restore body with identical inputs
+            kfull, vfull, ctoks = e._load_prompt_cache_rows(
+                m["path"], m["m"])
+            if ctoks is None or ctoks[:m["m"]] != m["tokens"]:
+                raise RuntimeError(
+                    f"lockstep cache_restore: follower's view of "
+                    f"{m['path']} diverges from the leader's — shared "
+                    f"filesystem required for prompt-cache in multi-host")
+            e.ck, e.cv = e._get_restore_fn()(
+                e.ck, e.cv, kfull, vfull, m["slot"], m["m"])
         elif op == "reset":
             e._reset_device_state()
         else:

@@ -136,6 +136,61 @@ r2 = httpx.post(f"{base}/v1/chat/completions", json={
     "messages": [{"role": "user", "content": "again"}]}, timeout=300)
 assert r2.status_code == 200, r2.text
 assert r2.json()["usage"]["completion_tokens"] == 6
+
+# r5: grammar-constrained chat THROUGH the lockstep bus (bias_rows
+# descriptors replay the leader's mask writes on the follower)
+r3 = httpx.post(f"{base}/v1/chat/completions", json={
+    "model": "dist", "max_tokens": 8, "ignore_eos": True,
+    "grammar": 'root ::= [0-9]{40}',
+    "messages": [{"role": "user", "content": "count"}]}, timeout=300)
+assert r3.status_code == 200, r3.text
+txt3 = r3.json()["choices"][0]["message"]["content"]
+assert txt3 and all(c in "0123456789" for c in txt3), repr(txt3)
+
+# r5: logit-bias (bias_sparse descriptor): +100 on one token id makes
+# greedy sampling emit it every step
+import json as _json
+r4 = httpx.post(f"{base}/v1/chat/completions", json={
+    "model": "dist", "max_tokens": 4, "ignore_eos": True,
+    "temperature": 0.0, "logit_bias": {"7": 100},
+    "messages": [{"role": "user", "content": "bias"}]}, timeout=300)
+assert r4.status_code == 200, r4.text
+assert r4.json()["usage"]["completion_tokens"] == 4
+
+# r5: prompt-cache round-trip over the bus (cache_save = replicated
+# all-gather collective on BOTH processes; cache_restore = file replay)
+import time as _time
+from localai_tpu.engine import sampling as smp
+pc_path = os.path.join(os.path.dirname(ckpt), "pc.npz")
+ids = tok.encode("the quick brown fox jumps over the lazy dog again and again",
+                 add_special_tokens=False)[:24]
+assert len(ids) >= 16, len(ids)
+req1 = eng.GenRequest(prompt_ids=list(ids),
+                      params=smp.SamplingParamsHost(temperature=0.0),
+                      max_new_tokens=4, ignore_eos=True,
+                      prompt_cache_path=pc_path)
+out = engine.submit(req1)
+while out.get() is not None:
+    pass
+for _ in range(200):               # async background save
+    if os.path.exists(pc_path):
+        break
+    _time.sleep(0.1)
+assert os.path.exists(pc_path), "prompt cache file never appeared"
+# forget host-side slot prefixes: the restart scenario — restore must
+# come from the FILE, not slot prefix reuse
+engine._cache_tokens = [[] for _ in engine._cache_tokens]
+reused0 = engine._reused_total
+req2 = eng.GenRequest(prompt_ids=list(ids),
+                      params=smp.SamplingParamsHost(temperature=0.0),
+                      max_new_tokens=4, ignore_eos=True,
+                      prompt_cache_path=pc_path)
+out2 = engine.submit(req2)
+while out2.get() is not None:
+    pass
+assert engine._reused_total - reused0 >= 16, (
+    engine._reused_total, reused0)
+
 engine.shutdown()
 loader.stop_all()
 print("OK leader", flush=True)
