@@ -159,15 +159,32 @@ def load_llama_params(
         h = tensors[name]
         return h.get_tensor(name)
 
+    from localai_tpu.engine import gptq as gptqlib
+
+    qmeta = gptqlib.detect(model_dir)
+    if qmeta is not None and not quantize:
+        # a GPTQ/AWQ checkpoint carries a memory intent; default to the
+        # TPU-native weight-only int8 so loading it doesn't silently
+        # inflate to dense bf16 (set quantization explicitly to override)
+        quantize = "int8"
+
     put = _make_put(cfg, mesh, dtype, quantize, adapter)
 
     L = cfg.num_layers
 
+    def linear_T(name: str) -> np.ndarray:
+        """Linear weight as [in, out]; GPTQ/AWQ-packed modules are
+        dequantized host-side (engine/gptq.py) in that orientation."""
+        base = name[: -len(".weight")]
+        if qmeta is not None and base + ".qweight" in tensors:
+            return gptqlib.dequant_linear(get, base, qmeta)
+        return get(name).T
+
     def stack(fmt: str, transpose: bool = False) -> np.ndarray:
         mats = []
         for i in range(L):
-            m = get(fmt.format(i=i))
-            mats.append(m.T if transpose else m)
+            name = fmt.format(i=i)
+            mats.append(linear_T(name) if transpose else get(name))
         return np.stack(mats)
 
     p = "model.layers.{i}."
@@ -187,7 +204,7 @@ def load_llama_params(
         "final_norm": put(get("model.norm.weight"), ("final_norm",)),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = put(get("lm_head.weight").T, ("lm_head",))
+        params["lm_head"] = put(linear_T("lm_head.weight"), ("lm_head",))
     return params
 
 
