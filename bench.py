@@ -64,13 +64,16 @@ PRESETS = {
 # it, so 32 slots amortize the same weight read over 2x the tokens.
 HTTP_PRESETS = {
     "1b": dict(slots=32, ctx=1024, quant="", kv=""),
-    "8b": dict(slots=32, ctx=1024, quant="int8", kv="int8"),
+    # burst 8 (not the engine-default 16): r5 sweep at 32 slots measured
+    # 505 vs 463 tok/s AND p50 TTFT 1157 vs 1957 ms — smaller bursts
+    # release/admit slots sooner, which outweighs dispatch overhead here
+    "8b": dict(slots=32, ctx=1024, quant="int8", kv="int8", burst=8),
     "smoke": dict(slots=2, ctx=128, quant="", kv=""),  # CPU-safe harness check
 }
 
 
 def _write_bench_model(models_dir: str, preset: str, slots: int, ctx: int,
-                       quant: str, kv: str = "") -> None:
+                       quant: str, kv: str = "", burst: int = 0) -> None:
     """config.json-only checkpoint (random weights via the gated loader
     fallback) + a size-matched word-level tokenizer + model YAML."""
     import json as _json
@@ -120,6 +123,7 @@ num_slots: {slots}
 dtype: bfloat16
 quantization: "{quant}"
 kv_cache_dtype: "{kv or 'bfloat16'}"
+{f"decode_burst: {burst}" if burst else "# decode_burst: engine default"}
 prefill_buckets: [128, 512]
 template:
   completion: "{{{{ Input }}}}"
@@ -148,7 +152,8 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
     S = int(os.environ.get("LOCALAI_BENCH_SLOTS", hp["slots"]))
     kv = os.environ.get("LOCALAI_BENCH_KV", hp.get("kv", ""))
     models = tempfile.mkdtemp(prefix=f"bench-{preset}-")
-    _write_bench_model(models, preset, S, hp["ctx"], hp["quant"], kv)
+    burst = int(os.environ.get("LOCALAI_BENCH_BURST", hp.get("burst", 0)))
+    _write_bench_model(models, preset, S, hp["ctx"], hp["quant"], kv, burst)
 
     os.environ["LOCALAI_ALLOW_RANDOM_WEIGHTS"] = "1"
     os.environ["LOCALAI_JAX_PLATFORM"] = os.environ.get(
@@ -196,6 +201,11 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
         return " ".join(f"t{i}" for i in ids)
 
     n_runs = int(os.environ.get("LOCALAI_BENCH_RUNS", "3"))
+    # closed-loop concurrency: 1:1 with slots. Oversubscription was
+    # tried (r5: 1.25x at 32 slots) and LOWERED throughput 505->459 on
+    # this rig — the extra client threads steal the single host core from
+    # the engine loop; the knob stays for multi-core hosts
+    n_streams = int(os.environ.get("LOCALAI_BENCH_STREAMS", S))
 
     async def drive():
         """Boot-once, measure n_runs times (median-of-n with min/max —
@@ -239,7 +249,7 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
             async def consumer(tid):
                 first = True
                 while not stop.is_set():
-                    n_new = (max(8, max_new - (tid * max_new) // S)
+                    n_new = (max(8, max_new - (tid * max_new) // n_streams)
                              if first else max_new)
                     first = False
                     ct, ttft = await one_stream(client, n_new)
@@ -250,7 +260,8 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
                         stop.set()
 
             t0 = time.monotonic()
-            tasks = [asyncio.create_task(consumer(i)) for i in range(S)]
+            tasks = [asyncio.create_task(consumer(i))
+                     for i in range(n_streams)]
             await asyncio.gather(*tasks)
             return results, time.monotonic() - t0
 
@@ -278,6 +289,19 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
     finally:
         loader.stop_all()
         loop.call_soon_threadsafe(loop.stop)
+        # hard sweep of THIS bench's children: an orphaned backend that
+        # survives stop_all keeps the chip and wedges every later bench
+        # phase (observed r5). -P scopes the kill to our own spawns;
+        # the settle sleep is paid only when an orphan was actually found
+        import subprocess as _sp
+
+        try:
+            if _sp.run(["pkill", "-9", "-P", str(os.getpid()), "-f",
+                        "localai_tpu.backend.runner"],
+                       check=False).returncode == 0:
+                time.sleep(3)
+        except OSError:
+            pass  # no pkill binary — nothing to sweep with
     if errors:
         raise RuntimeError(str(errors[0])[:500])
     rates = [res["completed"] / wall for res, wall in passes]
@@ -310,19 +334,19 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     from localai_tpu.engine import sampling
     from localai_tpu.models import llama
 
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    if os.environ.get("LOCALAI_BENCH_QUANT", "") == "int8":
-        # int8 weight-only wins in isolated decode bursts (~1.8x) but the
-        # serving tunnel's per-op/prefill overheads outweigh it end-to-end,
-        # so bf16 is the default headline; int8 remains opt-in
-        params = llama.quantize_params(params)
     import jax.numpy as jnp
+
+    from localai_tpu.engine.weights import random_params
+
+    params = random_params(
+        cfg, quantize=os.environ.get("LOCALAI_BENCH_QUANT", ""))
     cache_dtype = (jnp.int8 if os.environ.get("LOCALAI_BENCH_KV", "") == "int8"
                    else jnp.bfloat16)
     ecfg = eng.EngineConfig(num_slots=S, max_context=C,
                             prefill_buckets=(prompt_len, 512),
-                            prefill_chunk=512, decode_burst=burst,
-                            cache_dtype=cache_dtype)
+                            prefill_chunk=512, cache_dtype=cache_dtype,
+                            # burst<=0 = keep the EngineConfig default
+                            **({"decode_burst": burst} if burst > 0 else {}))
     engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                         eos_token_ids={cfg.vocab_size - 1})
     engine.start(precompile=True)
@@ -332,6 +356,8 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     state = {"completed": 0, "ttfts": [], "errors": [], "stop": False,
              "launched": 0, "decomp": []}
     done = threading.Event()
+    # see bench_http: 1:1 with slots; oversubscription loses on a 1-core host
+    n_streams = int(os.environ.get("LOCALAI_BENCH_STREAMS", S))
 
     # constrained-decode mode (LOCALAI_BENCH_GRAMMAR=1): every request
     # carries a JSON-ish GBNF grammar — measures the speculative
@@ -365,7 +391,8 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
             # prefilled) — an artifact of the harness, not of serving.
             # Spreading first-request lengths desyncs the fleet so the
             # measurement reflects steady-state load.
-            n_new = max(8, max_new - (tid * max_new) // S) if first else None
+            n_new = max(8, max_new - (tid * max_new) // n_streams) \
+                if first else None
             first = False
             r = make_req(n_new)
             t_submit = time.monotonic()
@@ -414,7 +441,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=consume, args=(i,), daemon=True)
-               for i in range(S)]
+               for i in range(n_streams)]
     for t in threads:
         t.start()
     done.wait()
@@ -466,10 +493,13 @@ def bench_kernel(cfg, S, C, steps, inner):
     from localai_tpu.engine import sampling
     from localai_tpu.models import llama
 
-    params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    if os.environ.get("LOCALAI_BENCH_QUANT", "") == "int8":
-        params = llama.quantize_params(params)
-    ck, cv = llama.init_cache(cfg, S, C)
+    from localai_tpu.engine.weights import random_params
+
+    params = random_params(
+        cfg, quantize=os.environ.get("LOCALAI_BENCH_QUANT", ""))
+    kv_dtype = (jnp.int8 if os.environ.get("LOCALAI_BENCH_KV", "") == "int8"
+                else None)
+    ck, cv = llama.init_cache(cfg, S, C, kv_dtype)
     slot_params = sampling.make_slot_params(S)
     ring, rpos = sampling.make_ring(S)
     bias = jnp.zeros((S, cfg.vocab_size), jnp.float32)
@@ -542,7 +572,8 @@ def main():
             }))
             return
 
-        burst = int(os.environ.get("LOCALAI_BENCH_BURST", "16"))
+        # 0/unset = engine default (EngineConfig.decode_burst)
+        burst = int(os.environ.get("LOCALAI_BENCH_BURST") or 0)
         r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
         gtag = "_grammar" if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1" else ""
         print(json.dumps({
@@ -607,20 +638,39 @@ def main():
             "LOCALAI_BENCH_KV": eff_kv,
             "LOCALAI_JAX_PLATFORM": "",
         })
+        # forward the burst only when one is actually specified, so an
+        # unset knob means "engine default" in BOTH phases (no third
+        # hardcoded copy of the default)
+        eff_burst = int(os.environ.get("LOCALAI_BENCH_BURST")
+                        or HTTP_PRESETS[primary].get("burst", 0) or 0)
+        if eff_burst > 0:
+            env["LOCALAI_BENCH_BURST"] = str(eff_burst)
+        else:
+            env.pop("LOCALAI_BENCH_BURST", None)
         env.pop("JAX_PLATFORMS", None)
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--engine"],
-                env=env, capture_output=True, text=True, timeout=3600)
-            for ln in out.stdout.splitlines():
-                ln = ln.strip()
-                if ln.startswith("{"):
-                    engine_direct = json.loads(ln)
-            if engine_direct is None:
-                engine_direct_err = (f"rc={out.returncode} "
-                                     f"stderr={out.stderr[-300:]}")
-        except Exception as e:
-            engine_direct_err = f"{type(e).__name__}: {e}"
+        # the HTTP backend subprocess can take a few seconds to exit and
+        # release the chip; "UNAVAILABLE: TPU backend setup" here means
+        # we raced it — wait and retry
+        for attempt in range(3):
+            engine_direct_err = None
+            try:
+                if attempt:
+                    time.sleep(15)
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--engine"],
+                    env=env, capture_output=True, text=True, timeout=3600)
+                for ln in out.stdout.splitlines():
+                    ln = ln.strip()
+                    if ln.startswith("{"):
+                        engine_direct = json.loads(ln)
+                if engine_direct is None:
+                    engine_direct_err = (f"rc={out.returncode} "
+                                         f"stderr={out.stderr[-300:]}")
+            except Exception as e:
+                engine_direct_err = f"{type(e).__name__}: {e}"
+            if engine_direct is not None or (
+                    engine_direct_err and "UNAVAILABLE" not in engine_direct_err):
+                break
         if engine_direct_err:
             print(f"engine-direct subprocess failed: {engine_direct_err}",
                   file=sys.stderr)

@@ -239,6 +239,9 @@ class EngineServicer(BackendServicer):
             # or degrade the engine loop.
             ga_n=max(1, int(extra.get("ga_n", 1) or 1)),
             ga_w=self._sane_ga_w(extra),
+            # 0 (or absent) = engine default, matching the YAML contract
+            **({"decode_burst": db} if (db := int(
+                extra.get("decode_burst", 0) or 0)) > 0 else {}),
         )
         draft = None
         if request.draft_model:

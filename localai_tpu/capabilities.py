@@ -45,7 +45,9 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
         options=",".join(
             ([f"ga_n={mc.group_attn_n},ga_w={mc.group_attn_w}"]
              if mc.group_attn_n > 1 else [])
-            + ([f"controlnet={mc.controlnet}"] if mc.controlnet else [])),
+            + ([f"controlnet={mc.controlnet}"] if mc.controlnet else [])
+            + ([f"decode_burst={mc.decode_burst}"]
+               if mc.decode_burst > 0 else [])),
     )
 
 

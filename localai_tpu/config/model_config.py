@@ -122,10 +122,17 @@ class ModelConfig:
     # TPU-native knobs (replace gpu_layers/tensor_split/low_vram/...)
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"
-    quantization: str = ""            # "" | int8 (weight-only, per-channel)
+    # "" | int8 (weight-only per-channel) | int4 (weight-only group-128
+    # for layer matmuls, embed/lm_head int8 — llama-family only)
+    quantization: str = ""
     num_slots: int = 8                # reference: LLAMACPP_PARALLEL slots
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
+    # decode tokens per burst dispatch (0 = engine default). Trades
+    # per-dispatch overhead against finish-detection latency: smaller
+    # bursts admit/release slots sooner (r5 on the serving chip, 8B-int8
+    # at 32 slots: burst 8 beat 16 on BOTH throughput and TTFT)
+    decode_burst: int = 0
     max_batch_prefill: int = 1
     # capability routing
     known_usecases: Optional[list] = None
@@ -175,6 +182,10 @@ class ModelConfig:
         if self.kv_cache_dtype.lower() not in KV_CACHE_DTYPES:
             problems.append(
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r}")
+        if self.decode_burst < 0:
+            problems.append(
+                f"decode_burst must be >= 0 (0 = engine default), "
+                f"got {self.decode_burst}")
         if self.group_attn_n < 1:
             problems.append(
                 f"group_attn_n must be >= 1, got {self.group_attn_n}")

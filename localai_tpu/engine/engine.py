@@ -599,7 +599,16 @@ class Engine:
 
         Duplicate p_slots entries (pow2 batch padding repeats the last
         prompt) stay idempotent: every per-slot update is a .set() of
-        identical values (same inputs -> same sampled id)."""
+        identical values (same inputs -> same sampled id).
+
+        Admission cost note (r5 measurement, 8B-int8 + int8 KV, 32 slots
+        on the serving chip): this sequential prefill-then-burst body adds
+        only ~14 ms over a plain burst dispatch. A concatenated
+        prefill+decode forward sharing weight reads
+        (models/llama.py:fused_prefill_decode) was built and measured at
+        ~68 ms extra — the concat/slice layout copies cost far more than
+        the shared reads save on this stack — so the sequential form is
+        the keeper."""
         slot_params = sampling.unpack_slot_params(slot_params)
         tokens, lengths, ring, ring_pos, mu, pos_offset = \
             self._compose_overrides(tokens, lengths, ring, ring_pos, mu,

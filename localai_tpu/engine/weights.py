@@ -65,7 +65,15 @@ _QUANT_NAMES = {"embed", "lm_head", "wq", "wk", "wv", "wo",
 
 def _make_put(cfg, mesh, dtype, quantize, adapter=None):
     """Leaf placer: host array + pytree path -> (LoRA-merged) cast /
-    int8-quantized / mesh-sharded device leaf."""
+    int8/int4-quantized / mesh-sharded device leaf."""
+
+    def leaf_spec(spec_path: tuple):
+        from localai_tpu.parallel import sharding as shardlib
+
+        node = shardlib.llama_param_specs(cfg.tie_word_embeddings)
+        for k in spec_path:
+            node = node[k]
+        return node
 
     def put(arr: np.ndarray, spec_path: tuple):
         leaf_name = spec_path[-1]
@@ -76,24 +84,35 @@ def _make_put(cfg, mesh, dtype, quantize, adapter=None):
             # in-place per layer — no full-leaf delta buffer
             arr = np.array(arr, np.float32)  # always a fresh writable copy
             adapter.apply_to_leaf(leaf_name, cfg.num_layers, arr)
-        if quantize == "int8" and leaf_name in _QUANT_NAMES:
-            from localai_tpu.models.llama import quantize_params
+        if quantize in ("int8", "int4") and leaf_name in _QUANT_NAMES:
+            from localai_tpu.ops.quant import (quantize_weight,
+                                               quantize_weight_int4)
 
-            leaf = quantize_params({leaf_name: arr})[leaf_name]
+            # int4 applies to the layer matmuls only; embed/lm_head stay
+            # int8 (see models/llama.py quantize_params for why)
+            if quantize == "int4" and spec_path[0] == "layers":
+                # the group count must divide the tp degree on the
+                # contraction axis or the scale can't shard with its
+                # weight (e.g. llama-2's 11008 FFN: 86 groups vs tp=8)
+                divisor = 1
+                if mesh is not None:
+                    axis = leaf_spec(spec_path)[-2]
+                    if axis is not None:
+                        divisor = mesh.shape[axis]
+                leaf = quantize_weight_int4(arr, shard_divisor=divisor)
+            else:
+                leaf = quantize_weight(arr)
         else:
             leaf = jnp.asarray(arr, dtype)
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from localai_tpu.parallel import sharding as shardlib
+            from jax.sharding import NamedSharding
+            from localai_tpu.ops.quant import scale_spec
 
-            specs = shardlib.llama_param_specs(cfg.tie_word_embeddings)
-            node = specs
-            for k in spec_path:
-                node = node[k]
+            node = leaf_spec(spec_path)
             if isinstance(leaf, dict):
                 q = jax.device_put(leaf["q"], NamedSharding(mesh, node))
-                s_spec = P(*([None] * (leaf["s"].ndim - 1) + [node[-1]]))
-                s = jax.device_put(leaf["s"], NamedSharding(mesh, s_spec))
+                s = jax.device_put(leaf["s"], NamedSharding(
+                    mesh, scale_spec(leaf, node)))
                 return {"q": q, "s": s}
             return jax.device_put(leaf, NamedSharding(mesh, node))
         return leaf
@@ -206,6 +225,13 @@ def load_llama_params(
     if not cfg.tie_word_embeddings:
         params["lm_head"] = put(linear_T("lm_head.weight"), ("lm_head",))
     return params
+
+
+def random_params(cfg, dtype=jnp.bfloat16, quantize: str = "") -> dict:
+    """Public entry for benchmark-shaped random weights: leaf-at-a-time
+    host init streamed through the standard cast/quantize/place path, so
+    an 8B never exists densely in f32 (32 GB) on host or device."""
+    return _random_llama_params(cfg, _make_put(cfg, None, dtype, quantize))
 
 
 def _random_llama_params(cfg, put) -> dict:

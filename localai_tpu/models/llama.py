@@ -171,15 +171,26 @@ def _embed_rows(embed, tokens, dtype):
     return jnp.take(embed, tokens, axis=0).astype(dtype)
 
 
-def quantize_params(params: dict) -> dict:
-    """Weight-only per-out-channel symmetric int8 for every matmul weight
-    (norms stay as-is). Capability parity: the reference serves quantized
-    GGUF (Q4/Q8) by default; int8 is the TPU-native analogue — the MXU
-    consumes the dequantized tiles while HBM traffic halves vs bf16."""
-    from localai_tpu.ops.quant import quantize_weight as q
+def quantize_params(params: dict, bits: int = 8, group: int = 128) -> dict:
+    """Weight-only quantization for every matmul weight (norms stay
+    as-is). Capability parity: the reference serves quantized GGUF
+    (Q4/Q8) by default; these are the TPU-native analogues — the MXU
+    consumes dequantized tiles while HBM traffic halves (int8) or
+    quarters (int4) vs bf16.
+
+    bits=8: per-out-channel symmetric int8 everywhere.
+    bits=4: group-128 symmetric int4 for the LAYER matmuls (~85% of an
+    8B's weight bytes) while embed/lm_head stay int8 — the embedding
+    gather dequantizes row-wise (grouped scales don't compose with it)
+    and the unembed is the quality-critical matmul."""
+    import functools
+
+    from localai_tpu.ops.quant import quantize_weight, quantize_weight_int4
 
     quant_names = {"embed", "lm_head", "wq", "wk", "wv", "wo",
                    "w_gate", "w_up", "w_down"}
+    q = (functools.partial(quantize_weight_int4, group=group)
+         if bits == 4 else quantize_weight)
 
     out = {}
     for name, leaf in params.items():
@@ -187,7 +198,7 @@ def quantize_params(params: dict) -> dict:
             out[name] = {k: (q(v) if k in quant_names else v)
                          for k, v in leaf.items()}
         elif name in quant_names:
-            out[name] = q(leaf)
+            out[name] = quantize_weight(leaf) if bits == 4 else q(leaf)
         else:
             out[name] = leaf
     return out
@@ -331,6 +342,47 @@ def prefill(
     return logits, cache_k, cache_v
 
 
+def _decode_attend_write(q1, k1, v1, lck, lcv, lengths, cfg: LlamaConfig):
+    """One decode token per slot: attend + scatter the new K/V row.
+
+    q1 [S, H, hd]; k1/v1 [S, KV, hd]; returns (attn [S, H, hd], lk, lv).
+
+    Decode-attention path selection (r3 benchmark campaign,
+    scripts/profile_decode*.py on the serving chip):
+      * post-scatter einsum (this default): 11.4 ms/step model-only on
+        the 1B bench config — the best measured composition despite
+        XLA materializing relayouted layer copies around the dot;
+      * append-attention (pre-scatter read, jnp or the Pallas kernel
+        in ops/pallas/decode_attention.py): semantically identical,
+        measured 12.9-14.6 ms/step here — the relayout moves rather
+        than disappears. Kept selectable (LOCALAI_DECODE_ATTN=append
+        | pallas) because the balance may flip off the axon tunnel."""
+    S = q1.shape[0]
+    slot_idx = jnp.arange(S, dtype=jnp.int32)
+    mode = _decode_attn_mode()
+    if mode == "pallas" and _pallas_decode() and not kvcache.is_quant(lck):
+        from localai_tpu.ops.pallas.decode_attention import (
+            decode_attention_append_pallas)
+
+        attn = decode_attention_append_pallas(
+            q1, k1, v1, lck, lcv, lengths, cfg.q_per_kv)
+        lk = kvcache.scatter_decode(lck, slot_idx, lengths, k1)
+        lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v1)
+    elif mode == "append" or (mode == "pallas" and kvcache.is_quant(lck)):
+        attn = decode_attention_append(q1, k1, v1, lck, lcv, lengths,
+                                       cfg.q_per_kv)
+        lk = kvcache.scatter_decode(lck, slot_idx, lengths, k1)
+        lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v1)
+    else:
+        # scatter new k/v at [slot, lengths[slot]], then attend over the
+        # updated rows ([0, lengths]); out-of-range positions
+        # (lengths==C) are dropped, preserving the capacity invariant
+        lk = kvcache.scatter_decode(lck, slot_idx, lengths, k1)
+        lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v1)
+        attn = decode_attention(q1, lk, lv, lengths + 1, cfg.q_per_kv)
+    return attn, lk, lv
+
+
 def decode_step(
     params: dict,
     cfg: LlamaConfig,
@@ -368,40 +420,9 @@ def decode_step(
         q, k, v = _project_qkv(h, layer, cfg)  # q [S,1,H,hd], k/v [S,1,KV,hd]
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # Decode-attention path selection (r3 benchmark campaign,
-        # scripts/profile_decode*.py on the serving chip):
-        #   * post-scatter einsum (this default): 11.4 ms/step model-only on
-        #     the 1B bench config — the best measured composition despite
-        #     XLA materializing relayouted layer copies around the dot;
-        #   * append-attention (pre-scatter read, jnp or the Pallas kernel
-        #     in ops/pallas/decode_attention.py): semantically identical,
-        #     measured 12.9-14.6 ms/step here — the relayout moves rather
-        #     than disappears. Kept selectable (LOCALAI_DECODE_ATTN=append
-        #     | pallas) because the balance may flip off the axon tunnel.
-        slot_idx = jnp.arange(S, dtype=jnp.int32)
-        mode = _decode_attn_mode()
         lck, lcv = kvcache.layer(ck, li), kvcache.layer(cv, li)
-        if mode == "pallas" and _pallas_decode() and not kvcache.is_quant(lck):
-            from localai_tpu.ops.pallas.decode_attention import (
-                decode_attention_append_pallas)
-
-            attn = decode_attention_append_pallas(
-                q[:, 0], k[:, 0], v[:, 0], lck, lcv, lengths,
-                cfg.q_per_kv)
-            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
-            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
-        elif mode == "append" or (mode == "pallas" and kvcache.is_quant(lck)):
-            attn = decode_attention_append(q[:, 0], k[:, 0], v[:, 0], lck,
-                                           lcv, lengths, cfg.q_per_kv)
-            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
-            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
-        else:
-            # scatter new k/v at [slot, lengths[slot]], then attend over the
-            # updated rows ([0, lengths]); out-of-range positions
-            # (lengths==C) are dropped, preserving the capacity invariant
-            lk = kvcache.scatter_decode(lck, slot_idx, lengths, k[:, 0])
-            lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v[:, 0])
-            attn = decode_attention(q[:, 0], lk, lv, lengths + 1, cfg.q_per_kv)
+        attn, lk, lv = _decode_attend_write(q[:, 0], k[:, 0], v[:, 0],
+                                            lck, lcv, lengths, cfg)
         ck = kvcache.set_layer(ck, li, lk)
         cv = kvcache.set_layer(cv, li, lv)
         x = x + jnp.einsum("sh,hd->sd", attn.reshape(S, -1), _mat(layer["wo"], x.dtype))[:, None, :]
@@ -426,6 +447,102 @@ def engine_decode(params, cfg, tokens, lengths, active, cache_k, cache_v,
     write_lengths = jnp.where(active, lengths, C)
     return decode_step(params, cfg, tokens, write_lengths, cache_k, cache_v,
                        pos_offset=pos_offset)
+
+
+def fused_prefill_decode(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,      # [S] int32 — pending decode token per slot
+    lengths: jax.Array,     # [S] int32 — context length per slot
+    active: jax.Array,      # [S] bool — slots advancing this step
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    pr_tokens: jax.Array,   # [B, T] int32 fresh prompts, right-padded
+    pr_seq: jax.Array,      # [B] int32 true lengths
+    pr_slots: jax.Array,    # [B] int32 target slots (disjoint from active)
+    pr_start: jax.Array,    # [B] int32 position offset
+    pos_offset: jax.Array = None,   # [S] self-extend offset for decode
+):
+    """One decode step for all active slots AND a fresh-prompt prefill
+    batch, in a SINGLE forward whose activations are concatenated along
+    the token axis — so the two workloads share every weight read.
+
+    Packing prompt tokens and decode tokens into one batch is the
+    reference's llama_batch design (grpc-server.cpp:1671+); the TPU form
+    is a static-shape concat feeding shared matmuls, with per-segment
+    RoPE/attention after the projections.
+
+    MEASURED NEGATIVE RESULT on the current serving stack (r5, 8B-int8 +
+    int8 KV, 32 slots, axon tunnel): this fused forward costs ~68 ms
+    over a plain decode step, vs ~14 ms for the sequential
+    prefill-then-decode composition it replaces — the concat/slice
+    layout copies around every projection outweigh the shared weight
+    reads, so the engine keeps the sequential form (engine.py
+    _fused_body). Kept, parity-tested, because the balance is a property
+    of the interconnect: on a directly-attached chip the shared-read
+    saving should dominate.
+
+    Semantics match engine_decode(active-masked) followed by
+    prefill(continued=False) on disjoint slots. Returns
+    (dec_logits [S, V], pr_logits [B, V], cache_k, cache_v)."""
+    S = tokens.shape[0]
+    B, T = pr_tokens.shape
+    D = cfg.hidden_size
+    hd = cfg.head_dim_
+    C = kvcache.shape(cache_k)[2]
+    write_lengths = jnp.where(active, lengths, C)   # inactive writes drop
+
+    dpos = write_lengths[:, None]                   # [S, 1]
+    if pos_offset is not None:
+        dpos = dpos - pos_offset[:, None]
+    ppos = pr_start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    pos_all = jnp.concatenate([dpos.reshape(1, S), ppos.reshape(1, B * T)],
+                              axis=1)               # [1, S+B*T]
+    sin, cos = rope_frequencies(cfg, pos_all)
+    xd = _embed_rows(params["embed"], tokens, cfg.dtype)        # [S, D]
+    xp = _embed_rows(params["embed"], pr_tokens, cfg.dtype)     # [B, T, D]
+    x = jnp.concatenate([xd, xp.reshape(B * T, D)], axis=0)[None]  # [1,N,D]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < pr_seq[:, None]
+    rows = pr_slots[:, None] * jnp.ones((1, T), jnp.int32)
+    cols = ppos
+
+    def layer_fn(carry, layer):
+        x, ck, cv = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(h, layer, cfg)       # ONE weight read each
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        qd, qp = q[0, :S], q[0, S:].reshape(B, T, cfg.num_heads, hd)
+        kd, kp = k[0, :S], k[0, S:].reshape(B, T, cfg.num_kv_heads, hd)
+        vd, vp = v[0, :S], v[0, S:].reshape(B, T, cfg.num_kv_heads, hd)
+        lck, lcv = kvcache.layer(ck, li), kvcache.layer(cv, li)
+        attn_d, lk, lv = _decode_attend_write(qd, kd, vd, lck, lcv,
+                                              write_lengths, cfg)
+        ck = kvcache.set_layer(ck, li, lk)
+        cv = kvcache.set_layer(cv, li, lv)
+        attn_p = causal_attention(qp, kp, vp, valid, cfg.q_per_kv)
+        ck = kvcache.scatter_prefill(ck, li, rows, cols, kp)
+        cv = kvcache.scatter_prefill(cv, li, rows, cols, vp)
+        attn = jnp.concatenate([attn_d.reshape(S, -1),
+                                attn_p.reshape(B * T, -1)], axis=0)[None]
+        x = x + jnp.einsum("bth,hd->btd", attn, _mat(layer["wo"], x.dtype))
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, layer)
+        return (x, ck, cv), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, cache_k, cache_v), _ = jax.lax.scan(layer_fn, (x, cache_k, cache_v),
+                                            layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    xd = x[0, :S]                                   # [S, D]
+    xp = x[0, S:].reshape(B, T, D)
+    last = jnp.take_along_axis(
+        xp, (pr_seq - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    both = jnp.concatenate([xd, last], axis=0)[None]   # [1, S+B, D]
+    logits = _unembed(both, params, cfg)[0]
+    return logits[:S], logits[S:], cache_k, cache_v
 
 
 def shift_cache_positions(cache_k: jax.Array, cfg: LlamaConfig,

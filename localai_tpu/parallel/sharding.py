@@ -98,16 +98,29 @@ def shard_params(mesh: Mesh, params: dict, tie_word_embeddings: bool = False,
     """Device_put a param pytree onto the mesh (llama specs by default;
     pass specs=mamba_param_specs(...) for the mamba family).
 
-    int8-quantized leaves ({"q": int8 weight, "s": per-out-channel scale})
-    shard q with the weight's spec and s with the spec's trailing axes
-    (scales follow the output-channel partitioning)."""
+    Quantized leaves ({"q": int8/int4 weight, "s": scales}) shard q with
+    the weight's spec and s per ops.quant.scale_spec (flat int8 scales
+    follow the output-channel partitioning; grouped int4 scales
+    additionally follow the contraction axis on their group axis — the
+    group count must divide that axis's mesh degree; load-time
+    quantization picks such a group automatically, pick_int4_group)."""
+    from localai_tpu.ops.quant import is_grouped, scale_spec
+
     specs = specs or llama_param_specs(tie_word_embeddings)
 
     def put(x, spec):
         if isinstance(x, dict) and "q" in x:
+            if is_grouped(x) and spec[-2] is not None \
+                    and x["s"].shape[-3] % mesh.shape[spec[-2]]:
+                raise ValueError(
+                    f"int4 group count {x['s'].shape[-3]} does not divide "
+                    f"the {spec[-2]!r}-axis mesh degree "
+                    f"{mesh.shape[spec[-2]]}; re-quantize with "
+                    f"quantize_weight_int4(shard_divisor=...) or a "
+                    f"compatible group size")
             q = jax.device_put(x["q"], NamedSharding(mesh, spec))
-            s_spec = P(*([None] * (x["s"].ndim - 1) + [spec[-1]]))
-            s = jax.device_put(x["s"], NamedSharding(mesh, s_spec))
+            s = jax.device_put(x["s"],
+                               NamedSharding(mesh, scale_spec(x, spec)))
             return {"q": q, "s": s}
         return jax.device_put(x, NamedSharding(mesh, spec))
 

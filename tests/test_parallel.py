@@ -96,6 +96,51 @@ def test_sharded_prefill_decode_match_single_device(shard_cfg, mesh8, shard_para
                                rtol=2e-4, atol=2e-4)
 
 
+def test_sharded_int4_params_match_single_device(shard_cfg, mesh8):
+    """Group-scaled int4 {q, s} leaves shard correctly: the grouped scale
+    follows the contraction-axis partitioning (wo/w_down row-parallel), so
+    sharded logits equal the single-device quantized model's bit-for-bit."""
+    cfg = shard_cfg
+    params = llama.init_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    # group=32 so every contraction axis (64 or 128) divides, and the
+    # tp=4-sharded group axes stay divisible (wo: 128/32=4 groups / tp=4)
+    qparams = llama.quantize_params(params, bits=4, group=32)
+    assert qparams["layers"]["wo"]["q"].dtype == jnp.int4
+    sharded = shardlib.shard_params(mesh8, qparams, cfg.tie_word_embeddings)
+    # the grouped scale's group axis must carry the weight's tp sharding
+    assert sharded["layers"]["wo"]["s"].sharding.spec == P(None, "tp", None,
+                                                           None)
+
+    S, C, T = 4, 64, 12
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (S, T), 0,
+                                cfg.vocab_size, jnp.int32)
+    seq_lens = jnp.array([T, T - 3, T - 5, 2], jnp.int32)
+    slot_ids = jnp.arange(S, dtype=jnp.int32)
+    start = jnp.zeros((S,), jnp.int32)
+
+    def run(p, ck, cv):
+        logits, ck, cv = llama.prefill(p, cfg, tokens, seq_lens, ck, cv,
+                                       slot_ids, start)
+        dlogits, ck, cv = llama.decode_step(
+            p, cfg, jnp.argmax(logits, -1).astype(jnp.int32), seq_lens, ck,
+            cv)
+        return logits, dlogits
+
+    ck0, cv0 = llama.init_cache(cfg, S, C, jnp.float32)
+    ref_logits, ref_dlogits = jax.jit(run)(qparams, ck0, cv0)
+
+    cache_sh = NamedSharding(mesh8, shardlib.cache_spec())
+    ck1 = jax.device_put(jnp.zeros((cfg.num_layers, S, C, cfg.num_kv_heads,
+                                    cfg.head_dim_), jnp.float32), cache_sh)
+    cv1 = jax.device_put(jnp.zeros_like(ck1), cache_sh)
+    sh_logits, sh_dlogits = jax.jit(run)(sharded, ck1, cv1)
+
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(sh_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(ref_dlogits),
+                               np.asarray(sh_dlogits), rtol=2e-4, atol=2e-4)
+
+
 def _greedy_engine(cfg, params, mesh, num_slots=4):
     e = eng.Engine(
         cfg, params, ByteTokenizer(),
