@@ -60,6 +60,11 @@ class TTSServicer(BackendServicer):
         # real music generation (HF MusicgenForConditionalGeneration)
         self.musicgen = None   # (cfg, params)
         self.musicgen_tokenizer = None
+        # Bark three-stage pipeline (suno/bark-*; reference:
+        # backend/python/bark/backend.py)
+        self.bark = None       # (cfg, params, codec_cfg, codec_params)
+        self.bark_tokenizer = None
+        self.bark_history = None
 
     def LoadModel(self, request, context):
         try:
@@ -83,7 +88,26 @@ class TTSServicer(BackendServicer):
             self.vits_tokenizer = None
             self.musicgen = None
             self.musicgen_tokenizer = None
-            if cfg_dict.get("model_type") == "musicgen":
+            self.bark = None
+            self.bark_tokenizer = None
+            self.bark_history = None
+            if cfg_dict.get("model_type") == "bark":
+                # suno/bark-class checkpoint: semantic -> coarse -> fine
+                # GPTs + EnCodec decode, torch forward parity
+                # (models/bark.py; reference: backend/python/bark/
+                # backend.py:1-93)
+                from localai_tpu.models import bark as jbark
+
+                bcfg = jbark.BarkConfig.from_dir(model_dir)
+                params, codec_cfg, codec = jbark.load_hf_params(model_dir,
+                                                                bcfg)
+                self.bark = (bcfg, params, codec_cfg, codec)
+                from transformers import AutoTokenizer
+
+                self.bark_tokenizer = AutoTokenizer.from_pretrained(model_dir)
+                self.cfg = tts.TTSConfig()
+                self.params = params
+            elif cfg_dict.get("model_type") == "musicgen":
                 # published MusicGen checkpoint (facebook/musicgen-*):
                 # T5 text encoder + codebook LM + EnCodec decode, full
                 # torch parity (models/musicgen.py; reference:
@@ -197,6 +221,37 @@ class TTSServicer(BackendServicer):
                                 speaker_id=speaker, frame_pad_to=64)
         return wave, vcfg.sampling_rate
 
+    def _bark_synthesize(self, text: str, voice: str = "") -> tuple:
+        """Bark pipeline. ``voice`` may name a suno-format .npz speaker
+        preset (semantic_prompt/coarse_prompt/fine_prompt arrays) inside
+        the model dir; its semantic prompt conditions generation."""
+        from localai_tpu.models import bark as jbark
+
+        bcfg, params, codec_cfg, codec = self.bark
+        history = None
+        if voice:
+            base = os.path.realpath(getattr(self, "model_dir", "") or ".")
+            ref = os.path.realpath(os.path.join(
+                base, voice if voice.endswith(".npz") else voice + ".npz"))
+            # confine like the VITS voice-clone path: HTTP-supplied names
+            # must not probe arbitrary server paths
+            if ref != base and not ref.startswith(base + os.sep):
+                raise ValueError(
+                    "bark voice preset must name an .npz inside the "
+                    "model directory")
+            if not os.path.exists(ref):
+                raise ValueError(f"voice preset not found: {voice}")
+            npz = np.load(ref)
+            history = {k: npz[k] for k in npz.files}
+        enc = self.bark_tokenizer(text)
+        ids = np.asarray(enc["input_ids"], np.int64)[None]
+        max_sem = int(os.environ.get("LOCALAI_BARK_MAX_SEMANTIC", "0")) or None
+        wave = jbark.generate_speech(
+            params, bcfg, codec_cfg, codec, ids,
+            np.asarray([ids.shape[1]]), history=history,
+            max_semantic=max_sem)
+        return wave[0], codec_cfg.sampling_rate
+
     def _params_for_voice(self, voice: str):
         if not voice:
             return self.params
@@ -228,6 +283,11 @@ class TTSServicer(BackendServicer):
                                                   duration=8.0))
                     tts.write_wav(request.dst, wave, sample_rate=rate)
                     return pb.Result(success=True, message="ok")
+                if self.bark is not None:
+                    wave, rate = self._bark_synthesize(request.text,
+                                                       request.voice)
+                    tts.write_wav(request.dst, wave, sample_rate=rate)
+                    return pb.Result(success=True, message="ok")
                 if self.vits is not None:
                     wave, rate = self._vits_synthesize(request.text,
                                                        request.voice)
@@ -252,7 +312,9 @@ class TTSServicer(BackendServicer):
                     wave, rate = self._musicgen_generate(request)
                     tts.write_wav(request.dst, wave, sample_rate=rate)
                     return pb.Result(success=True, message="ok")
-                if self.vits is not None:
+                if self.bark is not None:
+                    wave, rate = self._bark_synthesize(request.text)
+                elif self.vits is not None:
                     wave, rate = self._vits_synthesize(request.text)
                 else:
                     wave = tts.synthesize(self._params_for_voice(""), self.cfg,

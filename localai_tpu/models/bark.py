@@ -1,0 +1,713 @@
+"""Bark text-to-speech — the three-stage GPT pipeline in functional JAX.
+
+Capability parity with the reference's bark backend
+(/root/reference/backend/python/bark/backend.py:1-93 — a gRPC wrapper
+around the suno-bark package); the architecture/layout spec is the HF
+`BarkModel` (public transformers library):
+
+  1. semantic ("text") model: causal GPT over text tokens -> semantic
+     tokens (the prompt is text-embeds + voice-history-embeds summed,
+     plus an infer token);
+  2. coarse acoustics model: causal GPT regressing the first two EnCodec
+     codebooks, interleaved per step, over a sliding semantic window;
+  3. fine acoustics model: NON-causal GPT with one embedding table per
+     codebook and one lm_head per predicted codebook, iteratively
+     filling codebooks 2..8 over 1024-position windows;
+  4. EnCodec decode (models/encodec.py — shared with MusicGen).
+
+TPU-first shape: each causal stage's generation is ONE jitted
+lax.scan over a fixed-size KV cache (prefill + decode fused in a single
+device program — no per-token host round-trip); the fine stage is a
+host loop over a handful of whole-window forwards. Sampling (greedy or
+temperature) happens on-device inside the scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BarkSubConfig:
+    input_vocab_size: int = 10_048
+    output_vocab_size: int = 10_048
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    block_size: int = 1024
+    bias: bool = True
+    n_codes_total: int = 8     # fine only
+    n_codes_given: int = 1     # fine only
+
+    @staticmethod
+    def from_hf(d: dict) -> "BarkSubConfig":
+        return BarkSubConfig(
+            input_vocab_size=d.get("input_vocab_size", 10_048),
+            output_vocab_size=d.get("output_vocab_size", 10_048),
+            num_layers=d.get("num_layers", 12),
+            num_heads=d.get("num_heads", 12),
+            hidden_size=d.get("hidden_size", 768),
+            block_size=d.get("block_size", 1024),
+            bias=d.get("bias", True),
+            n_codes_total=d.get("n_codes_total", 8),
+            n_codes_given=d.get("n_codes_given", 1),
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class BarkGenConfig:
+    """Generation-time constants (HF Bark{Semantic,Coarse,Fine}
+    GenerationConfig defaults; overridable for tiny test models)."""
+    # semantic
+    text_encoding_offset: int = 10_048
+    text_pad_token: int = 129_595
+    semantic_infer_token: int = 129_599
+    semantic_vocab_size: int = 10_000
+    semantic_pad_token: int = 10_000        # == eos token
+    max_input_semantic_length: int = 256
+    semantic_rate_hz: float = 49.9
+    semantic_max_new: int = 768
+    min_eos_p: Optional[float] = None
+    # coarse
+    codebook_size: int = 1024
+    n_coarse_codebooks: int = 2
+    coarse_semantic_pad_token: int = 12_048
+    coarse_infer_token: int = 12_050
+    max_coarse_input_length: int = 256
+    max_coarse_history: int = 630
+    sliding_window_len: int = 60
+    coarse_rate_hz: float = 75.0
+    # fine
+    n_fine_codebooks: int = 8
+    max_fine_history_length: int = 512
+    max_fine_input_length: int = 1024
+
+    @property
+    def semantic_to_coarse_ratio(self) -> float:
+        return (self.coarse_rate_hz / self.semantic_rate_hz
+                * self.n_coarse_codebooks)
+
+
+def gen_from_hf(d: dict) -> BarkGenConfig:
+    """BarkGenConfig from an HF `generation_config.json` dict (the
+    BarkGenerationConfig layout real suno/bark checkpoints ship)."""
+    s = d.get("semantic_config", {})
+    c = d.get("coarse_acoustics_config", {})
+    f = d.get("fine_acoustics_config", {})
+    base = BarkGenConfig()
+    return BarkGenConfig(
+        text_encoding_offset=s.get("text_encoding_offset",
+                                   base.text_encoding_offset),
+        text_pad_token=s.get("text_pad_token", base.text_pad_token),
+        semantic_infer_token=s.get("semantic_infer_token",
+                                   base.semantic_infer_token),
+        semantic_vocab_size=s.get("semantic_vocab_size",
+                                  base.semantic_vocab_size),
+        semantic_pad_token=s.get("eos_token_id", base.semantic_pad_token),
+        max_input_semantic_length=s.get("max_input_semantic_length",
+                                        base.max_input_semantic_length),
+        semantic_rate_hz=s.get("semantic_rate_hz", base.semantic_rate_hz),
+        semantic_max_new=s.get("max_new_tokens", base.semantic_max_new),
+        min_eos_p=s.get("min_eos_p", base.min_eos_p),
+        codebook_size=d.get("codebook_size", base.codebook_size),
+        n_coarse_codebooks=c.get("n_coarse_codebooks",
+                                 base.n_coarse_codebooks),
+        coarse_semantic_pad_token=c.get("coarse_semantic_pad_token",
+                                        base.coarse_semantic_pad_token),
+        coarse_infer_token=c.get("coarse_infer_token",
+                                 base.coarse_infer_token),
+        max_coarse_input_length=c.get("max_coarse_input_length",
+                                      base.max_coarse_input_length),
+        max_coarse_history=c.get("max_coarse_history",
+                                 base.max_coarse_history),
+        sliding_window_len=c.get("sliding_window_len",
+                                 base.sliding_window_len),
+        coarse_rate_hz=c.get("coarse_rate_hz", base.coarse_rate_hz),
+        n_fine_codebooks=f.get("n_fine_codebooks", base.n_fine_codebooks),
+        max_fine_history_length=f.get("max_fine_history_length",
+                                      base.max_fine_history_length),
+        max_fine_input_length=f.get("max_fine_input_length",
+                                    base.max_fine_input_length),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BarkConfig:
+    semantic: BarkSubConfig
+    coarse: BarkSubConfig
+    fine: BarkSubConfig
+    gen: BarkGenConfig = dataclasses.field(default_factory=BarkGenConfig)
+
+    @staticmethod
+    def from_hf_config(d: dict, gen: Optional[dict] = None) -> "BarkConfig":
+        return BarkConfig(
+            semantic=BarkSubConfig.from_hf(d.get("semantic_config", {})),
+            coarse=BarkSubConfig.from_hf(
+                d.get("coarse_acoustics_config", {})),
+            fine=BarkSubConfig.from_hf(d.get("fine_acoustics_config", {})),
+            gen=gen_from_hf(gen or {}),
+        )
+
+    @staticmethod
+    def from_dir(model_dir: str) -> "BarkConfig":
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = json.load(f)
+        gen = {}
+        gpath = os.path.join(model_dir, "generation_config.json")
+        if os.path.exists(gpath):
+            with open(gpath) as f:
+                gen = json.load(f)
+        return BarkConfig.from_hf_config(cfg, gen)
+
+
+# ---------------------------------------------------------------- params
+
+def _ln(t, w, b):
+    mu = jnp.mean(t, -1, keepdims=True)
+    var = jnp.var(t, -1, keepdims=True)
+    out = (t - mu) / jnp.sqrt(var + 1e-5) * w
+    return out + b if b is not None else out
+
+
+def _collect_submodel(get, prefix: str, cfg: BarkSubConfig, fine: bool):
+    """Stack one GPT submodel's torch tensors into a scanned pytree."""
+    L = cfg.num_layers
+
+    def stack(fmt, transpose=False, optional=False):
+        mats = []
+        for i in range(L):
+            name = fmt.format(i=i)
+            t = get(name, optional)
+            if t is None:
+                return None
+            mats.append(t.T if transpose else t)
+        return jnp.asarray(np.stack(mats), jnp.float32)
+
+    p = prefix + "layers.{i}."
+    params = {
+        "pos": jnp.asarray(get(prefix + "position_embeds_layer.weight"),
+                           jnp.float32),
+        "ln1_w": stack(p + "layernorm_1.weight"),
+        "ln1_b": stack(p + "layernorm_1.bias", optional=True),
+        "ln2_w": stack(p + "layernorm_2.weight"),
+        "ln2_b": stack(p + "layernorm_2.bias", optional=True),
+        "qkv_w": stack(p + "attn.att_proj.weight", transpose=True),
+        "qkv_b": stack(p + "attn.att_proj.bias", optional=True),
+        "wo": stack(p + "attn.out_proj.weight", transpose=True),
+        "wo_b": stack(p + "attn.out_proj.bias", optional=True),
+        "mlp_in": stack(p + "mlp.in_proj.weight", transpose=True),
+        "mlp_in_b": stack(p + "mlp.in_proj.bias", optional=True),
+        "mlp_out": stack(p + "mlp.out_proj.weight", transpose=True),
+        "mlp_out_b": stack(p + "mlp.out_proj.bias", optional=True),
+        "lnf_w": jnp.asarray(get(prefix + "layernorm_final.weight"),
+                             jnp.float32),
+        "lnf_b": (jnp.asarray(b, jnp.float32) if (b := get(
+            prefix + "layernorm_final.bias", True)) is not None else None),
+    }
+    if fine:
+        params["embed"] = jnp.asarray(np.stack(
+            [get(f"{prefix}input_embeds_layers.{i}.weight")
+             for i in range(cfg.n_codes_total)]), jnp.float32)
+
+        def head(i):
+            # tie_word_embeddings (HF default): lm_heads[i] shares
+            # input_embeds_layers[i + n_codes_given] and is not saved
+            w = get(f"{prefix}lm_heads.{i}.weight", optional=True)
+            if w is None:
+                w = get(f"{prefix}input_embeds_layers."
+                        f"{i + cfg.n_codes_given}.weight")
+            return w.T
+
+        params["lm_head"] = jnp.asarray(np.stack(
+            [head(i) for i in range(cfg.n_codes_total - cfg.n_codes_given)]),
+            jnp.float32)
+    else:
+        params["embed"] = jnp.asarray(
+            get(prefix + "input_embeds_layer.weight"), jnp.float32)
+        params["lm_head"] = jnp.asarray(
+            get(prefix + "lm_head.weight").T, jnp.float32)
+    return params
+
+
+def load_hf_params(model_dir: str, cfg: BarkConfig):
+    """(params, encodec_cfg, encodec_params) from a BarkModel save dir."""
+    from localai_tpu.engine.weights import _open_shards
+    from localai_tpu.models import encodec as enc
+
+    tensors = _open_shards(model_dir)
+
+    def get(name, optional=False):
+        if name not in tensors:
+            if optional:
+                return None
+            raise KeyError(name)
+        return tensors[name].get_tensor(name)
+
+    params = {
+        "semantic": _collect_submodel(get, "semantic.", cfg.semantic, False),
+        "coarse": _collect_submodel(get, "coarse_acoustics.", cfg.coarse,
+                                    False),
+        "fine": _collect_submodel(get, "fine_acoustics.", cfg.fine, True),
+    }
+    with open(os.path.join(model_dir, "config.json")) as f:
+        codec_cfg = enc.EncodecConfig.from_hf_config(
+            json.load(f).get("codec_config", {}))
+    codec = enc.load_hf_params(
+        {k[len("codec_model."):]: get(k) for k in tensors
+         if k.startswith("codec_model.")}, codec_cfg)
+    return params, codec_cfg, codec
+
+
+# --------------------------------------------------------------- forward
+
+def _attn_qkv(h, layer, cfg: BarkSubConfig):
+    qkv = jnp.einsum("btd,de->bte", h, layer["qkv_w"])
+    if layer["qkv_b"] is not None:
+        qkv = qkv + layer["qkv_b"]
+    B, T, _ = qkv.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return (q.reshape(B, T, H, hd), k.reshape(B, T, H, hd),
+            v.reshape(B, T, H, hd))
+
+
+def _block(x, layer, cfg: BarkSubConfig, mask):
+    """One pre-LN GPT block; mask [B?, 1, Tq, Tk] additive."""
+    h = _ln(x, layer["ln1_w"], layer["ln1_b"])
+    q, k, v = _attn_qkv(h, layer, cfg)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.head_dim)
+    attn = jax.nn.softmax(scores + mask, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+    ctx = ctx.reshape(x.shape[0], x.shape[1], cfg.hidden_size)
+    o = ctx @ layer["wo"]
+    if layer["wo_b"] is not None:
+        o = o + layer["wo_b"]
+    x = x + o
+    h = _ln(x, layer["ln2_w"], layer["ln2_b"])
+    m = h @ layer["mlp_in"]
+    if layer["mlp_in_b"] is not None:
+        m = m + layer["mlp_in_b"]
+    m = jax.nn.gelu(m, approximate=False) @ layer["mlp_out"]
+    if layer["mlp_out_b"] is not None:
+        m = m + layer["mlp_out_b"]
+    return x + m
+
+
+def _scan_layers(x, params, cfg: BarkSubConfig, mask):
+    def body(x, layer):
+        return _block(x, layer, cfg, mask), None
+
+    layers = {k: params[k] for k in
+              ("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv_w", "qkv_b",
+               "wo", "wo_b", "mlp_in", "mlp_in_b", "mlp_out", "mlp_out_b")}
+    if layers["ln1_b"] is None:     # bias-less checkpoints: drop None leaves
+        layers = {k: v for k, v in layers.items() if v is not None}
+        def body(x, layer):  # noqa: F811
+            full = dict.fromkeys(
+                ("ln1_b", "ln2_b", "qkv_b", "wo_b", "mlp_in_b", "mlp_out_b"))
+            full.update(layer)
+            return _block(x, full, cfg, mask), None
+    x, _ = jax.lax.scan(body, x, layers)
+    return x
+
+
+def causal_logits(params, cfg: BarkSubConfig, embeds, valid=None):
+    """Full causal forward over embeds [B, T, D] -> logits [B, T, V].
+    ``valid`` [B, T] masks padded positions out of the attended keys."""
+    B, T, _ = embeds.shape
+    x = embeds + params["pos"][:T][None]
+    causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if valid is not None:
+        causal = causal & valid[:, None, None, :]
+    mask = jnp.where(causal, 0.0, -1e9)
+    x = _scan_layers(x, params, cfg, mask)
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    return x @ params["lm_head"]
+
+
+def fine_logits(params, cfg: BarkSubConfig, codes, codebook_idx: int):
+    """Non-causal fine forward: codes [B, T, n_codes_total] int32 ->
+    logits [B, T, V] for ``codebook_idx`` (embeds = sum of tables
+    0..codebook_idx, matching BarkFineModel.forward)."""
+    B, T, _ = codes.shape
+    emb = params["embed"]                       # [n_codes, V, D]
+    x = jnp.zeros((B, T, emb.shape[-1]), jnp.float32)
+    for i in range(codebook_idx + 1):
+        x = x + jnp.take(emb[i], codes[:, :, i], axis=0)
+    x = x + params["pos"][:T][None]
+    x = _scan_layers(x, params, cfg, jnp.zeros((1, 1, T, T), jnp.float32))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    return x @ params["lm_head"][codebook_idx - cfg.n_codes_given]
+
+
+# ------------------------------------------------------- cached generate
+
+def _prefill_cache(params, cfg: BarkSubConfig, embeds, prefix_len, total):
+    """Run the prefix through the blocks, returning per-layer K/V caches
+    padded to ``total`` positions plus the last hidden state's logits."""
+    B, P, D = embeds.shape
+    x = embeds + params["pos"][:P][None]
+    pos_idx = jnp.arange(P)
+    causal = (pos_idx[None, :] <= pos_idx[:, None])[None, None]
+    valid = (jnp.arange(P)[None] < prefix_len[:, None])
+    mask = jnp.where(causal & valid[:, None, None, :], 0.0, -1e9)
+
+    ks, vs = [], []
+    layers = _layer_list(params, cfg)
+    for layer in layers:
+        h = _ln(x, layer["ln1_w"], layer["ln1_b"])
+        q, k, v = _attn_qkv(h, layer, cfg)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(cfg.head_dim)
+        attn = jax.nn.softmax(scores + mask, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, P, D)
+        o = ctx @ layer["wo"]
+        if layer["wo_b"] is not None:
+            o = o + layer["wo_b"]
+        x = x + o
+        h = _ln(x, layer["ln2_w"], layer["ln2_b"])
+        m = h @ layer["mlp_in"]
+        if layer["mlp_in_b"] is not None:
+            m = m + layer["mlp_in_b"]
+        m = jax.nn.gelu(m, approximate=False) @ layer["mlp_out"]
+        if layer["mlp_out_b"] is not None:
+            m = m + layer["mlp_out_b"]
+        x = x + m
+        ks.append(jnp.pad(k, ((0, 0), (0, total - P), (0, 0), (0, 0))))
+        vs.append(jnp.pad(v, ((0, 0), (0, total - P), (0, 0), (0, 0))))
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    # last VALID position's hidden state per batch row
+    last = jnp.take_along_axis(
+        x, (prefix_len - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return last @ params["lm_head"], jnp.stack(ks), jnp.stack(vs)
+
+
+def _layer_list(params, cfg: BarkSubConfig):
+    keys = ("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv_w", "qkv_b",
+            "wo", "wo_b", "mlp_in", "mlp_in_b", "mlp_out", "mlp_out_b")
+    out = []
+    for i in range(cfg.num_layers):
+        out.append({k: (params[k][i] if params[k] is not None else None)
+                    for k in keys})
+    return out
+
+
+def _decode_step(params, cfg: BarkSubConfig, tok_embed, pos, ck, cv,
+                 prefix_len, step_valid):
+    """One cached decode step. tok_embed [B, D]; ck/cv [L, B, total, H, hd];
+    writes at position ``pos`` [B]; attends over [0, pos]."""
+    B, D = tok_embed.shape
+    x = (tok_embed + jnp.take(params["pos"], pos, axis=0))[:, None]
+    total = ck.shape[2]
+    kpos = jnp.arange(total)
+    layers = _layer_list(params, cfg)
+    new_ck, new_cv = [], []
+    for li, layer in enumerate(layers):
+        h = _ln(x, layer["ln1_w"], layer["ln1_b"])
+        q, k, v = _attn_qkv(h, layer, cfg)
+        lk = ck[li].at[jnp.arange(B), pos].set(k[:, 0])
+        lv = cv[li].at[jnp.arange(B), pos].set(v[:, 0])
+        # valid keys: prefix rows [0, prefix_len) and generated [P, pos]
+        att_ok = (kpos[None] < prefix_len[:, None]) | (
+            (kpos[None] <= pos[:, None]) & step_valid[:, None])
+        scores = jnp.einsum("bhd,bkhd->bhk", q[:, 0], lk) \
+            / np.sqrt(cfg.head_dim)
+        attn = jax.nn.softmax(
+            jnp.where(att_ok[:, None], scores, -1e9), axis=-1)
+        ctx = jnp.einsum("bhk,bkhd->bhd", attn, lv).reshape(B, 1, D)
+        o = ctx @ layer["wo"]
+        if layer["wo_b"] is not None:
+            o = o + layer["wo_b"]
+        x = x + o
+        h = _ln(x, layer["ln2_w"], layer["ln2_b"])
+        m = h @ layer["mlp_in"]
+        if layer["mlp_in_b"] is not None:
+            m = m + layer["mlp_in_b"]
+        m = jax.nn.gelu(m, approximate=False) @ layer["mlp_out"]
+        if layer["mlp_out_b"] is not None:
+            m = m + layer["mlp_out_b"]
+        x = x + m
+        new_ck.append(lk)
+        new_cv.append(lv)
+    x = _ln(x, params["lnf_w"], params["lnf_b"])
+    return (x[:, 0] @ params["lm_head"], jnp.stack(new_ck),
+            jnp.stack(new_cv))
+
+
+def _sample(logits, allowed_lo, allowed_hi, temperature, key):
+    """Greedy (temperature<=0) or softmax sample restricted to
+    [allowed_lo, allowed_hi)."""
+    V = logits.shape[-1]
+    ids = jnp.arange(V)
+    ok = (ids[None] >= allowed_lo[:, None]) & (ids[None] < allowed_hi[:, None])
+    masked = jnp.where(ok, logits, -jnp.inf)
+    if temperature and temperature > 0:
+        return jax.random.categorical(key, masked / temperature, axis=-1)
+    return jnp.argmax(masked, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sub", "g", "temperature", "max_new", "total", "P"))
+def _semantic_scan(sem_params, prefix, prefix_len, key, *, sub, g,
+                   temperature, max_new, total, P):
+    """Prefill + max_new cached decode steps in ONE device program."""
+    B = prefix.shape[0]
+    eos = jnp.int32(g.semantic_pad_token)
+    logits, ck, cv = _prefill_cache(sem_params, sub, prefix, prefix_len,
+                                    total)
+
+    def step(carry, key):
+        logits, ck, cv, done, n = carry
+        lo = jnp.zeros((B,), jnp.int32)
+        hi = jnp.full((B,), g.semantic_vocab_size + 1, jnp.int32)
+        tok = _sample(logits, lo, hi, temperature, key)
+        if g.min_eos_p:
+            p = jax.nn.softmax(logits, axis=-1)[:, g.semantic_pad_token]
+            tok = jnp.where(p >= g.min_eos_p, eos, tok)
+        tok = jnp.where(done, eos, tok)
+        done = done | (tok == eos)
+        pos = jnp.minimum(P + n, total - 1)
+        emb_t = jnp.take(sem_params["embed"], tok, axis=0)
+        logits, ck, cv = _decode_step(
+            sem_params, sub, emb_t, jnp.full((B,), pos, jnp.int32),
+            ck, cv, prefix_len, ~done)
+        return (logits, ck, cv, done, n + 1), tok
+
+    keys = jax.random.split(key, max_new)
+    _, toks = jax.lax.scan(
+        step, (logits, ck, cv, jnp.zeros((B,), bool), 0), keys)
+    return toks.T                                         # [B, max_new]
+
+
+def generate_semantic(params, cfg: BarkConfig, text_ids, text_len,
+                      history: Optional[np.ndarray] = None,
+                      temperature: float = 0.0, seed: int = 0,
+                      max_new: Optional[int] = None):
+    """Text ids [B, <=256] -> semantic tokens [B, max_new] + lengths [B].
+
+    Mirrors BarkSemanticModel.generate: ids get text_encoding_offset,
+    pads become text_pad_token, the prompt embedding is
+    emb(text)+emb(history) with an infer token appended, and generation
+    is restricted to [0, semantic_vocab_size] + eos."""
+    g = cfg.gen
+    sub = cfg.semantic
+    B = text_ids.shape[0]
+    ml = g.max_input_semantic_length
+    max_new = int(max_new or g.semantic_max_new)
+
+    ids = np.asarray(text_ids, np.int64) + g.text_encoding_offset
+    pad_mask = (np.arange(ids.shape[1])[None] >= np.asarray(text_len)[:, None])
+    ids[pad_mask] = g.text_pad_token
+    ids = np.pad(ids[:, :ml], ((0, 0), (0, max(0, ml - ids.shape[1]))),
+                 constant_values=g.text_pad_token)
+
+    if history is not None:
+        hist = np.asarray(history, np.int64)[-ml:]
+        hist = np.pad(hist, (0, ml - len(hist)),
+                      constant_values=g.semantic_pad_token)
+    else:
+        hist = np.full((ml,), g.semantic_pad_token, np.int64)
+    hist = np.broadcast_to(hist, (B, ml))
+
+    emb = params["semantic"]["embed"]
+    prefix = (jnp.take(emb, jnp.asarray(ids), axis=0)
+              + jnp.take(emb, jnp.asarray(hist), axis=0))
+    infer = jnp.broadcast_to(emb[g.semantic_infer_token][None, None],
+                             (B, 1, emb.shape[-1]))
+    prefix = jnp.concatenate([prefix, infer], axis=1)     # [B, ml+1, D]
+    P = ml + 1
+    prefix_len = jnp.full((B,), P, jnp.int32)
+
+    total = min(P + max_new, sub.block_size)
+
+    toks = np.asarray(_semantic_scan(
+        params["semantic"], prefix, prefix_len, jax.random.PRNGKey(seed),
+        sub=sub, g=g, temperature=float(temperature), max_new=max_new,
+        total=total, P=P))
+    lengths = []
+    for b in range(B):
+        nz = np.where(toks[b] == g.semantic_pad_token)[0]
+        lengths.append(int(nz[0]) if len(nz) else toks.shape[1])
+    return toks, np.asarray(lengths, np.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sub", "g", "temperature", "P"))
+def _coarse_window(co_params, prefix_ids, prefix_len, gen_parity, key,
+                   n_new_mask, *, sub, g, temperature, P):
+    """One sliding-window pass: prefill the (semantic-chunk + infer +
+    coarse-history) prefix, then sliding_window_len alternating-codebook
+    decode steps — one device program per window."""
+    B = prefix_ids.shape[0]
+    emb = co_params["embed"]
+    prefix = jnp.take(emb, prefix_ids, axis=0)
+    total = P + g.sliding_window_len
+    logits, ck, cv = _prefill_cache(co_params, sub, prefix, prefix_len,
+                                    total)
+
+    def step(carry, inp):
+        logits, ck, cv, n = carry
+        key, active = inp
+        parity = (gen_parity + n) % 2
+        lo = jnp.full((B,), g.semantic_vocab_size, jnp.int32) \
+            + parity * g.codebook_size
+        hi = lo + g.codebook_size
+        tok = _sample(logits, lo, hi, temperature, key)
+        pos = jnp.minimum(prefix_len + n, total - 1)
+        emb_t = jnp.take(emb, tok, axis=0)
+        logits, ck, cv = _decode_step(
+            co_params, sub, emb_t, pos, ck, cv, prefix_len,
+            jnp.broadcast_to(active, (B,)))
+        return (logits, ck, cv, n + 1), tok
+
+    keys = jax.random.split(key, g.sliding_window_len)
+    _, toks = jax.lax.scan(step, (logits, ck, cv, 0), (keys, n_new_mask))
+    return toks.T
+
+
+def generate_coarse(params, cfg: BarkConfig, semantic, semantic_len,
+                    temperature: float = 0.0, seed: int = 0):
+    """Semantic tokens -> interleaved coarse tokens [B, n_steps]
+    (codebook 0/1 alternating, ids offset by semantic_vocab_size),
+    mirroring BarkCoarseModel.generate's sliding-window loop."""
+    g = cfg.gen
+    sub = cfg.coarse
+    B = semantic.shape[0]
+    ratio = g.semantic_to_coarse_ratio
+    max_sem_hist = int(np.floor(g.max_coarse_history / ratio))
+
+    sem = np.asarray(semantic, np.int64).copy()
+    for b in range(B):
+        sem[b, semantic_len[b]:] = g.coarse_semantic_pad_token
+    sem[sem == g.semantic_pad_token] = g.coarse_semantic_pad_token
+
+    n_steps = int(np.max(np.round(np.floor(
+        np.asarray(semantic_len) * ratio / g.n_coarse_codebooks)
+        * g.n_coarse_codebooks)))
+    n_windows = int(np.ceil(n_steps / g.sliding_window_len))
+
+    x_coarse = np.zeros((B, 0), np.int64)
+    total_done = 0
+    key = jax.random.PRNGKey(seed)
+
+    # fixed shapes for the jitted window: prefix = 256 + 1 + 630
+    P = g.max_coarse_input_length + 1 + g.max_coarse_history
+
+    for _ in range(n_windows):
+        sem_idx = int(round(total_done / ratio))
+        chunk = sem[:, max(0, sem_idx - max_sem_hist):]
+        chunk = chunk[:, :g.max_coarse_input_length]
+        chunk = np.pad(chunk,
+                       ((0, 0),
+                        (0, g.max_coarse_input_length - chunk.shape[1])),
+                       constant_values=g.coarse_semantic_pad_token)
+        hist = x_coarse[:, -g.max_coarse_history:]
+        prefix_ids = np.concatenate([
+            chunk,
+            np.full((B, 1), g.coarse_infer_token, np.int64),
+            hist,
+            np.zeros((B, P - g.max_coarse_input_length - 1 - hist.shape[1]),
+                     np.int64),
+        ], axis=1)
+        prefix_len = np.full(
+            (B,), g.max_coarse_input_length + 1 + hist.shape[1], np.int32)
+        n_new = min(g.sliding_window_len, n_steps - total_done)
+        key, sub_key = jax.random.split(key)
+        mask = np.arange(g.sliding_window_len) < n_new
+        toks = np.asarray(_coarse_window(
+            params["coarse"], jnp.asarray(prefix_ids),
+            jnp.asarray(prefix_len), jnp.int32(total_done % 2), sub_key,
+            jnp.asarray(mask), sub=sub, g=g,
+            temperature=float(temperature), P=P))
+        x_coarse = np.concatenate([x_coarse, toks[:, :n_new]], axis=1)
+        total_done += n_new
+    return x_coarse
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sub", "codebook_idx", "cb", "temperature"))
+def _fine_refine(fi_params, buf, key, *, sub, codebook_idx, cb, temperature):
+    logits = fine_logits(fi_params, sub, buf, codebook_idx)
+    rel = logits[:, :, :cb]
+    if temperature and temperature > 0:
+        return jax.random.categorical(key, rel / temperature, axis=-1)
+    return jnp.argmax(rel, axis=-1).astype(jnp.int32)
+
+
+def generate_fine(params, cfg: BarkConfig, coarse, temperature: float = 0.0,
+                  seed: int = 0):
+    """Interleaved coarse tokens [B, steps] -> full codebook grid
+    [B, n_fine_codebooks, T], mirroring BarkFineModel.generate's
+    overlapping-window refinement."""
+    g = cfg.gen
+    sub = cfg.fine
+    B = coarse.shape[0]
+    cb = g.codebook_size
+    co = np.asarray(coarse, np.int64).reshape(B, -1, g.n_coarse_codebooks)
+    co = np.remainder(co - g.semantic_vocab_size, cb)
+    T = co.shape[1]
+
+    fine = np.pad(co, ((0, 0), (0, 0),
+                       (0, g.n_fine_codebooks - g.n_coarse_codebooks)),
+                  constant_values=cb)
+    n_remove = 0
+    if fine.shape[1] < g.max_fine_input_length:
+        n_remove = g.max_fine_input_length - fine.shape[1]
+        fine = np.pad(fine, ((0, 0), (0, n_remove), (0, 0)),
+                      constant_values=cb)
+
+    n_loops = max(0, int(np.ceil(
+        (T - g.max_fine_input_length) / g.max_fine_history_length))) + 1
+
+    key = jax.random.PRNGKey(seed)
+    for n_outer in range(n_loops):
+        start = min(n_outer * g.max_fine_history_length,
+                    fine.shape[1] - g.max_fine_input_length)
+        fill = min(n_outer * g.max_fine_history_length,
+                   fine.shape[1] - g.max_fine_history_length)
+        rel_fill = fill - start
+        buf = fine[:, start: start + g.max_fine_input_length]
+        for ci in range(g.n_coarse_codebooks, g.n_fine_codebooks):
+            key, sk = jax.random.split(key)
+            preds = np.asarray(_fine_refine(
+                params["fine"], jnp.asarray(buf), sk, sub=sub,
+                codebook_idx=ci, cb=cb, temperature=float(temperature)))
+            buf[:, rel_fill:, ci] = preds[:, rel_fill:]
+        fine[:, fill: fill + g.max_fine_input_length - rel_fill] = \
+            buf[:, rel_fill:]
+    fine = np.transpose(fine, (0, 2, 1))
+    if n_remove:
+        fine = fine[:, :, :-n_remove]
+    return fine
+
+
+def generate_speech(params, cfg: BarkConfig, codec_cfg, codec_params,
+                    text_ids, text_len, temperature: float = 0.0,
+                    seed: int = 0, max_semantic: Optional[int] = None,
+                    history: Optional[dict] = None):
+    """Full pipeline: text ids -> waveform [B, T_audio] float32."""
+    from localai_tpu.models import encodec as enc
+
+    sem_hist = history.get("semantic_prompt") if history else None
+    semantic, sem_len = generate_semantic(
+        params, cfg, text_ids, text_len, history=sem_hist,
+        temperature=temperature, seed=seed, max_new=max_semantic)
+    coarse = generate_coarse(params, cfg, semantic, sem_len,
+                             temperature=temperature, seed=seed + 1)
+    fine = generate_fine(params, cfg, coarse, temperature=temperature,
+                         seed=seed + 2)
+    codes = jnp.transpose(jnp.asarray(fine), (1, 0, 2))   # [K, B, T]
+    audio = enc.decode(codec_params, codec_cfg, codes)    # [B, ch, samples]
+    return np.asarray(audio)[:, 0]
