@@ -152,7 +152,8 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
     S = int(os.environ.get("LOCALAI_BENCH_SLOTS", hp["slots"]))
     kv = os.environ.get("LOCALAI_BENCH_KV", hp.get("kv", ""))
     models = tempfile.mkdtemp(prefix=f"bench-{preset}-")
-    burst = int(os.environ.get("LOCALAI_BENCH_BURST", hp.get("burst", 0)))
+    burst = int(os.environ.get("LOCALAI_BENCH_BURST")
+                or hp.get("burst", 0) or 0)
     _write_bench_model(models, preset, S, hp["ctx"], hp["quant"], kv, burst)
 
     os.environ["LOCALAI_ALLOW_RANDOM_WEIGHTS"] = "1"

@@ -64,7 +64,6 @@ class TTSServicer(BackendServicer):
         # backend/python/bark/backend.py)
         self.bark = None       # (cfg, params, codec_cfg, codec_params)
         self.bark_tokenizer = None
-        self.bark_history = None
 
     def LoadModel(self, request, context):
         try:
@@ -90,7 +89,6 @@ class TTSServicer(BackendServicer):
             self.musicgen_tokenizer = None
             self.bark = None
             self.bark_tokenizer = None
-            self.bark_history = None
             if cfg_dict.get("model_type") == "bark":
                 # suno/bark-class checkpoint: semantic -> coarse -> fine
                 # GPTs + EnCodec decode, torch forward parity
@@ -243,7 +241,10 @@ class TTSServicer(BackendServicer):
                 raise ValueError(f"voice preset not found: {voice}")
             npz = np.load(ref)
             history = {k: npz[k] for k in npz.files}
-        enc = self.bark_tokenizer(text)
+        # no [CLS]/[SEP]: BarkProcessor tokenizes with
+        # add_special_tokens=False — special ids would be offset into
+        # tokens the semantic GPT never saw
+        enc = self.bark_tokenizer(text, add_special_tokens=False)
         ids = np.asarray(enc["input_ids"], np.int64)[None]
         max_sem = int(os.environ.get("LOCALAI_BARK_MAX_SEMANTIC", "0")) or None
         wave = jbark.generate_speech(

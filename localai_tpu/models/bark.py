@@ -474,7 +474,12 @@ def _semantic_scan(sem_params, prefix, prefix_len, key, *, sub, g,
         hi = jnp.full((B,), g.semantic_vocab_size + 1, jnp.int32)
         tok = _sample(logits, lo, hi, temperature, key)
         if g.min_eos_p:
-            p = jax.nn.softmax(logits, axis=-1)[:, g.semantic_pad_token]
+            # the eos probability is taken AFTER vocab suppression (HF
+            # applies SuppressTokens before the eos prioritizer): the
+            # never-trained out-of-range logits must not absorb mass
+            ids = jnp.arange(logits.shape[-1])
+            masked = jnp.where(ids[None] <= eos, logits, -jnp.inf)
+            p = jax.nn.softmax(masked, axis=-1)[:, g.semantic_pad_token]
             tok = jnp.where(p >= g.min_eos_p, eos, tok)
         tok = jnp.where(done, eos, tok)
         done = done | (tok == eos)
@@ -578,10 +583,13 @@ def _coarse_window(co_params, prefix_ids, prefix_len, gen_parity, key,
 
 
 def generate_coarse(params, cfg: BarkConfig, semantic, semantic_len,
-                    temperature: float = 0.0, seed: int = 0):
+                    temperature: float = 0.0, seed: int = 0,
+                    history: Optional[dict] = None):
     """Semantic tokens -> interleaved coarse tokens [B, n_steps]
     (codebook 0/1 alternating, ids offset by semantic_vocab_size),
-    mirroring BarkCoarseModel.generate's sliding-window loop."""
+    mirroring BarkCoarseModel.generate's sliding-window loop. A voice
+    preset's semantic/coarse prompts condition the windows exactly as
+    BarkCoarseModel.preprocess_histories does."""
     g = cfg.gen
     sub = cfg.coarse
     B = semantic.shape[0]
@@ -598,7 +606,30 @@ def generate_coarse(params, cfg: BarkConfig, semantic, semantic_len,
         * g.n_coarse_codebooks)))
     n_windows = int(np.ceil(n_steps / g.sliding_window_len))
 
-    x_coarse = np.zeros((B, 0), np.int64)
+    # voice-preset histories (preprocess_histories semantics): the
+    # coarse prompt rows get per-codebook offsets, interleave-flatten,
+    # and both histories are trimmed to a consistent ratio-aligned tail
+    if history is not None and "semantic_prompt" in history \
+            and "coarse_prompt" in history:
+        sem_hist = np.asarray(history["semantic_prompt"], np.int64).ravel()
+        co = np.asarray(history["coarse_prompt"], np.int64).copy()
+        for n in range(1, co.shape[0]):
+            co[n] += g.codebook_size * n
+        co_flat = co.T.reshape(-1) + g.semantic_vocab_size
+        n_sem = min(max_sem_hist, len(sem_hist) - len(sem_hist) % 2,
+                    int(np.floor(len(co_flat) / ratio)))
+        n_co = int(round(n_sem * ratio))
+        sem_hist = sem_hist[len(sem_hist) - n_sem:]
+        co_hist = co_flat[len(co_flat) - n_co:][:-2] if n_co > 2 else \
+            co_flat[:0]
+        sem = np.concatenate(
+            [np.broadcast_to(sem_hist, (B, len(sem_hist))), sem], axis=1)
+        x_coarse = np.broadcast_to(co_hist, (B, len(co_hist))).copy()
+        base_sem_idx = len(sem_hist)
+    else:
+        x_coarse = np.zeros((B, 0), np.int64)
+        base_sem_idx = 0
+    len_coarse_hist = x_coarse.shape[1]
     total_done = 0
     key = jax.random.PRNGKey(seed)
 
@@ -606,7 +637,7 @@ def generate_coarse(params, cfg: BarkConfig, semantic, semantic_len,
     P = g.max_coarse_input_length + 1 + g.max_coarse_history
 
     for _ in range(n_windows):
-        sem_idx = int(round(total_done / ratio))
+        sem_idx = base_sem_idx + int(round(total_done / ratio))
         chunk = sem[:, max(0, sem_idx - max_sem_hist):]
         chunk = chunk[:, :g.max_coarse_input_length]
         chunk = np.pad(chunk,
@@ -633,7 +664,7 @@ def generate_coarse(params, cfg: BarkConfig, semantic, semantic_len,
             temperature=float(temperature), P=P))
         x_coarse = np.concatenate([x_coarse, toks[:, :n_new]], axis=1)
         total_done += n_new
-    return x_coarse
+    return x_coarse[:, len_coarse_hist:]
 
 
 @functools.partial(
@@ -647,10 +678,11 @@ def _fine_refine(fi_params, buf, key, *, sub, codebook_idx, cb, temperature):
 
 
 def generate_fine(params, cfg: BarkConfig, coarse, temperature: float = 0.0,
-                  seed: int = 0):
+                  seed: int = 0, history: Optional[dict] = None):
     """Interleaved coarse tokens [B, steps] -> full codebook grid
     [B, n_fine_codebooks, T], mirroring BarkFineModel.generate's
-    overlapping-window refinement."""
+    overlapping-window refinement (a voice preset's fine prompt is
+    prepended as already-filled context and trimmed from the output)."""
     g = cfg.gen
     sub = cfg.fine
     B = coarse.shape[0]
@@ -662,6 +694,13 @@ def generate_fine(params, cfg: BarkConfig, coarse, temperature: float = 0.0,
     fine = np.pad(co, ((0, 0), (0, 0),
                        (0, g.n_fine_codebooks - g.n_coarse_codebooks)),
                   constant_values=cb)
+    n_history = 0
+    if history is not None and "fine_prompt" in history:
+        fh = np.asarray(history["fine_prompt"], np.int64).T  # [T, n_fine]
+        fh = fh[-g.max_fine_history_length:]
+        n_history = fh.shape[0]
+        fine = np.concatenate(
+            [np.broadcast_to(fh, (B,) + fh.shape), fine], axis=1)
     n_remove = 0
     if fine.shape[1] < g.max_fine_input_length:
         n_remove = g.max_fine_input_length - fine.shape[1]
@@ -669,13 +708,14 @@ def generate_fine(params, cfg: BarkConfig, coarse, temperature: float = 0.0,
                       constant_values=cb)
 
     n_loops = max(0, int(np.ceil(
-        (T - g.max_fine_input_length) / g.max_fine_history_length))) + 1
+        (T - (g.max_fine_input_length - n_history))
+        / g.max_fine_history_length))) + 1
 
     key = jax.random.PRNGKey(seed)
     for n_outer in range(n_loops):
         start = min(n_outer * g.max_fine_history_length,
                     fine.shape[1] - g.max_fine_input_length)
-        fill = min(n_outer * g.max_fine_history_length,
+        fill = min(n_history + n_outer * g.max_fine_history_length,
                    fine.shape[1] - g.max_fine_history_length)
         rel_fill = fill - start
         buf = fine[:, start: start + g.max_fine_input_length]
@@ -687,7 +727,7 @@ def generate_fine(params, cfg: BarkConfig, coarse, temperature: float = 0.0,
             buf[:, rel_fill:, ci] = preds[:, rel_fill:]
         fine[:, fill: fill + g.max_fine_input_length - rel_fill] = \
             buf[:, rel_fill:]
-    fine = np.transpose(fine, (0, 2, 1))
+    fine = np.transpose(fine, (0, 2, 1))[:, :, n_history:]
     if n_remove:
         fine = fine[:, :, :-n_remove]
     return fine
@@ -705,9 +745,10 @@ def generate_speech(params, cfg: BarkConfig, codec_cfg, codec_params,
         params, cfg, text_ids, text_len, history=sem_hist,
         temperature=temperature, seed=seed, max_new=max_semantic)
     coarse = generate_coarse(params, cfg, semantic, sem_len,
-                             temperature=temperature, seed=seed + 1)
+                             temperature=temperature, seed=seed + 1,
+                             history=history)
     fine = generate_fine(params, cfg, coarse, temperature=temperature,
-                         seed=seed + 2)
+                         seed=seed + 2, history=history)
     codes = jnp.transpose(jnp.asarray(fine), (1, 0, 2))   # [K, B, T]
     audio = enc.decode(codec_params, codec_cfg, codes)    # [B, ch, samples]
     return np.asarray(audio)[:, 0]
