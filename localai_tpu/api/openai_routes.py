@@ -441,10 +441,20 @@ async def images_generations(request):
         with os.fdopen(fd, "wb") as f:
             f.write(raw)
     out = []
+    mode = str(body.get("mode", "") or "")
+    # video modes write a video container at dst (reference: diffusers
+    # backend export_to_video); "format" picks gif/webp over mp4
+    ext = ".png"
+    if mode in ("txt2vid", "img2vid"):
+        fmt = str(body.get("format", "") or "mp4").lstrip(".").lower()
+        if fmt not in ("mp4", "gif", "webp", "avi"):
+            return api_error(f"unsupported video format {fmt!r}", 400,
+                             "invalid_request_error")
+        ext = "." + fmt
     try:
         for i in range(n):
             dst = os.path.join(tempfile.gettempdir(),
-                               f"localai-img-{secrets.token_hex(8)}.png")
+                               f"localai-img-{secrets.token_hex(8)}{ext}")
             # n > 1 must produce n DIFFERENT samples: offset the seed
             # per image (a fixed seed otherwise reseeds the sampler
             # identically n times). Offsets wrap inside int32 (the proto
@@ -456,7 +466,7 @@ async def images_generations(request):
             await state.run_blocking(
                 state.caps.generate_image, mc, positive.strip(),
                 negative.strip(), width, height, step,
-                seed_i, dst, src, str(body.get("mode", "") or ""),
+                seed_i, dst, src, mode,
                 strength, scheduler)
             if body.get("response_format") == "b64_json":
                 with open(dst, "rb") as f:

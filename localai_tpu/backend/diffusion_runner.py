@@ -54,6 +54,9 @@ class DiffusionServicer(BackendServicer):
                     from localai_tpu.backend.service import parse_options
 
                     extra = parse_options(request.options)
+                    # video knobs (num_frames/fps/motion) ride the same
+                    # options wire; GenerateImage reads them per model
+                    self.extra = extra
                     loras = []
                     if request.lora_adapter:
                         lp = request.lora_adapter
@@ -96,6 +99,49 @@ class DiffusionServicer(BackendServicer):
                     scheduler = (request.scheduler
                                  or getattr(self, "scheduler", "")
                                  or "ddim")
+                    if request.mode in ("txt2vid", "img2vid"):
+                        # video generation (reference: diffusers
+                        # backend.py:199-223,440-453 — img2vid from a src
+                        # image, txt2vid from the prompt, video file at
+                        # dst). Frame count rides the options wire
+                        # (num_frames=), fps likewise.
+                        from localai_tpu.models import sd as sdlib
+
+                        extra = getattr(self, "extra", {}) or {}
+                        frames_n = int(extra.get("num_frames", 14) or 14)
+                        fps = int(extra.get("fps", 7) or 7)
+                        motion = float(extra.get("motion", 1.0) or 1.0)
+                        common = dict(
+                            negative_prompt=request.negative_prompt,
+                            num_frames=frames_n,
+                            steps=request.step or 20,
+                            cfg_scale=float(request.cfg_scale or 7),
+                            seed=request.seed, scheduler=scheduler,
+                            motion=motion)
+                        if request.mode == "img2vid":
+                            if not request.src:
+                                return pb.Result(
+                                    success=False,
+                                    message="img2vid needs a source image "
+                                            "(src)")
+                            from PIL import Image
+
+                            init = np.asarray(Image.open(request.src)
+                                              .convert("RGB"))
+                            strength = (float(request.strength)
+                                        if request.HasField("strength")
+                                        else 0.5)
+                            frames = self.sd_pipe.img2vid(
+                                init, prompt=request.positive_prompt,
+                                strength=strength, **common)
+                        else:
+                            frames = self.sd_pipe.txt2vid(
+                                request.positive_prompt, height=h, width=w,
+                                **common)
+                        os.makedirs(os.path.dirname(request.dst) or ".",
+                                    exist_ok=True)
+                        sdlib.write_video(request.dst, frames, fps=fps)
+                        return pb.Result(success=True, message="ok")
                     if request.src and request.mode == "controlnet":
                         # src is the CONTROL image (canny/pose map), not
                         # an init image: structure-conditioned txt2img

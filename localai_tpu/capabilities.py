@@ -47,7 +47,8 @@ def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
              if mc.group_attn_n > 1 else [])
             + ([f"controlnet={mc.controlnet}"] if mc.controlnet else [])
             + ([f"decode_burst={mc.decode_burst}"]
-               if mc.decode_burst > 0 else [])),
+               if mc.decode_burst > 0 else [])
+            + [str(o) for o in (mc.options or [])]),
     )
 
 

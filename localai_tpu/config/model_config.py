@@ -126,6 +126,10 @@ class ModelConfig:
     # for layer matmuls, embed/lm_head int8 — llama-family only)
     quantization: str = ""
     num_slots: int = 8                # reference: LLAMACPP_PARALLEL slots
+    # free-form "k=v" strings forwarded on the backend options wire
+    # (reference: BackendConfig.Options, backend_config.go) — e.g. the
+    # video knobs num_frames=14,fps=7,motion=1.0
+    options: list = dataclasses.field(default_factory=list)
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
     # decode tokens per burst dispatch (0 = engine default). Trades
