@@ -244,3 +244,34 @@ def test_gptq_checkpoint_loads_and_serves(tmp_path):
         ck, cv, jnp.asarray([0, 1], jnp.int32),
         jnp.asarray([0, 0], jnp.int32))
     assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_gptq_checkpoint_int4_target(tmp_path):
+    """quantization=int4 on a GPTQ checkpoint: the 4-bit dequant is
+    re-quantized to grouped jnp.int4 (the checkpoint's 4-bit memory
+    intent is preserved EXACTLY in storage width), and the model still
+    runs."""
+    import jax.numpy as jnp
+
+    from localai_tpu.engine import weights
+    from localai_tpu.models import llama
+    from localai_tpu.ops import quant as quantlib
+
+    ckpt = str(tmp_path / "gptq-tiny4")
+    cfg, expected = _write_gptq_checkpoint(ckpt)
+    params = weights.load_llama_params(ckpt, cfg, quantize="int4")
+
+    # layer matmuls are int4 (w_down in-axis 128 -> grouped); embeds int8
+    assert params["layers"]["w_down"]["q"].dtype == jnp.int4
+    assert quantlib.is_grouped(params["layers"]["w_down"])
+    assert params["embed"]["q"].dtype == jnp.int8
+    got = quantlib.mat(params["layers"]["w_down"], jnp.float32)
+    # int4-of-4bit round trip stays close to the GPTQ dequant
+    assert np.max(np.abs(np.asarray(got) - expected["w_down"])) < 0.05
+
+    ck, cv = llama.init_cache(cfg, 1, 32)
+    logits, ck, cv = llama.prefill(
+        params, cfg, jnp.full((1, 8), 5, jnp.int32),
+        jnp.asarray([8], jnp.int32), ck, cv, jnp.asarray([0], jnp.int32),
+        jnp.zeros((1,), jnp.int32))
+    assert np.all(np.isfinite(np.asarray(logits)))
