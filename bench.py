@@ -267,7 +267,11 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
             return results, time.monotonic() - t0
 
         timeout = httpx.Timeout(connect=60, read=3600, write=60, pool=3600)
-        limits = httpx.Limits(max_connections=S + 4)
+        # pool sized to the STREAM count, not the slot count: with
+        # LOCALAI_BENCH_STREAMS oversubscription (> S) a cap of S+4 made
+        # the extra streams block on the client pool, so the measurement
+        # reflected pool starvation rather than engine behavior
+        limits = httpx.Limits(max_connections=max(S, n_streams) + 4)
         async with httpx.AsyncClient(timeout=timeout, limits=limits) as client:
             # warmup: trigger model load + jit warm, one full round
             warm = [one_stream(client, max_new) for _ in range(S)]
@@ -343,11 +347,14 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         cfg, quantize=os.environ.get("LOCALAI_BENCH_QUANT", ""))
     cache_dtype = (jnp.int8 if os.environ.get("LOCALAI_BENCH_KV", "") == "int8"
                    else jnp.bfloat16)
+    layout = os.environ.get("LOCALAI_BENCH_KV_LAYOUT", "")
     ecfg = eng.EngineConfig(num_slots=S, max_context=C,
                             prefill_buckets=(prompt_len, 512),
                             prefill_chunk=512, cache_dtype=cache_dtype,
                             # burst<=0 = keep the EngineConfig default
-                            **({"decode_burst": burst} if burst > 0 else {}))
+                            **({"decode_burst": burst} if burst > 0 else {}),
+                            # paged vs contiguous KV comparison knob
+                            **({"kv_layout": layout} if layout else {}))
     engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                         eos_token_ids={cfg.vocab_size - 1})
     engine.start(precompile=True)
@@ -466,10 +473,12 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         engine.cancel(r.request_id)
         while first is not None:
             first = out.get()
+    kv_layout = engine.metrics().get("kv_layout", "")
     engine.shutdown()
     if errors:
         raise RuntimeError(errors[0])
     out = {
+        "kv_layout": kv_layout,
         "tok_s": completed / wall,
         "p50_ttft_ms": float(np.percentile(ttfts, 50) * 1e3),
         "p95_ttft_ms": float(np.percentile(ttfts, 95) * 1e3),
@@ -543,11 +552,120 @@ def bench_kernel(cfg, S, C, steps, inner):
     return {"tok_s": S * n_bursts * inner / dt}
 
 
+def _arm_budget_watchdog(partial_line: dict) -> float:
+    """LOCALAI_BENCH_BUDGET_S wall-clock budget (default 600 s; 0
+    disables): a daemon thread prints whatever has been measured so far
+    as ONE JSON line and exits rc=0 at the deadline — the bench NEVER
+    dies rc=124 under a harness timeout with nothing reported (BENCH_r05
+    failure mode). Returns the deadline (monotonic) or +inf."""
+    import threading
+
+    budget = float(os.environ.get("LOCALAI_BENCH_BUDGET_S", "600"))
+    if budget <= 0:
+        return float("inf")
+    deadline = time.monotonic() + budget
+
+    def watchdog():
+        time.sleep(budget)
+        partial_line.setdefault("metric", "bench_budget_exceeded")
+        partial_line["budget_exceeded_s"] = budget
+        print(json.dumps(partial_line), flush=True)
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True,
+                     name="bench-budget").start()
+    return deadline
+
+
+def _subprocess_jax_platform(deadline: float) -> str:
+    """JAX_PLATFORMS value for spawned bench subprocesses: the parent's
+    explicit setting if any, else "" (= let jax pick the chip) when a
+    fresh interpreter can initialize a backend quickly, else "cpu".
+    On chipless containers unpinned TPU discovery HANGS rather than
+    failing, which used to eat the whole compare budget as subprocess
+    timeouts — so the probe itself is time-boxed."""
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS"):
+        return os.environ["JAX_PLATFORMS"]
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["LOCALAI_JAX_PLATFORM"] = ""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            env=env, capture_output=True, text=True,
+            timeout=max(10, min(45, deadline - time.monotonic() - 60)))
+        if res.returncode == 0 and res.stdout.strip():
+            return ""
+    except Exception:
+        pass
+    return "cpu"
+
+
+def _engine_direct_layout_compare(deadline: float, partial: dict) -> dict:
+    """Decode tok/s for the PAGED vs CONTIGUOUS KV layouts: two
+    engine-direct subprocesses on a small preset
+    (LOCALAI_BENCH_COMPARE_PRESET, default the CPU-safe smoke shape; set
+    1b/8b on a real chip) with identical everything but kv_layout."""
+    import subprocess
+
+    cmp_preset = os.environ.get("LOCALAI_BENCH_COMPARE_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(cmp_preset, HTTP_PRESETS["smoke"])
+    platform = _subprocess_jax_platform(deadline)
+    out = {}
+    for layout in ("paged", "contiguous"):
+        remaining = deadline - time.monotonic()
+        if remaining < 30:
+            out[f"{layout}_error"] = "budget exhausted"
+            break
+        env = dict(os.environ)
+        env.update({
+            "LOCALAI_BENCH_PRESET": cmp_preset,
+            "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+            "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+            "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+            "LOCALAI_BENCH_KV": hp.get("kv", ""),
+            "LOCALAI_BENCH_KV_LAYOUT": layout,
+            "LOCALAI_BENCH_PROMPT": os.environ.get(
+                "LOCALAI_BENCH_COMPARE_PROMPT", "48"),
+            "LOCALAI_BENCH_NEW": os.environ.get(
+                "LOCALAI_BENCH_COMPARE_NEW", "32"),
+            "LOCALAI_BENCH_TOKENS": os.environ.get(
+                "LOCALAI_BENCH_COMPARE_TOKENS", "256"),
+            "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+            "LOCALAI_JAX_PLATFORM": "",
+        })
+        if platform:
+            env["JAX_PLATFORMS"] = platform
+        else:
+            env.pop("JAX_PLATFORMS", None)
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--engine"],
+                env=env, capture_output=True, text=True,
+                timeout=max(30, min(remaining - 10, 1800)))
+            for ln in res.stdout.splitlines():
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    out[f"{layout}_tok_s"] = json.loads(ln).get("value")
+            if f"{layout}_tok_s" not in out:
+                out[f"{layout}_error"] = (f"rc={res.returncode} "
+                                          f"stderr={res.stderr[-200:]}")
+        except Exception as e:
+            out[f"{layout}_error"] = f"{type(e).__name__}: {e}"[:200]
+        partial.update({f"kv_layout_compare_{k}": v for k, v in out.items()})
+    return out
+
+
 def main():
     prompt_len = int(os.environ.get("LOCALAI_BENCH_PROMPT", "128"))
     max_new = int(os.environ.get("LOCALAI_BENCH_NEW", "128"))
     # default sized so the 8B HTTP measurement finishes in ~8 min
     target = int(os.environ.get("LOCALAI_BENCH_TOKENS", "4096"))
+
+    partial = {}
+    deadline = _arm_budget_watchdog(partial)
 
     if "--engine" in sys.argv or "--kernel" in sys.argv:
         # engine-direct / kernel modes own the chip in-process
@@ -577,10 +695,11 @@ def main():
         burst = int(os.environ.get("LOCALAI_BENCH_BURST") or 0)
         r = bench_serving(cfg, S, C, prompt_len, max_new, target, burst)
         gtag = "_grammar" if os.environ.get("LOCALAI_BENCH_GRAMMAR", "") == "1" else ""
+        ltag = (f"_{r['kv_layout']}" if r.get("kv_layout") else "")
         print(json.dumps({
             "metric": (f"engine_tok_s_per_chip_llama_{preset}_"
                        f"{'int8' if os.environ.get('LOCALAI_BENCH_QUANT', '') == 'int8' else 'bf16'}"
-                       f"_slots{S}{gtag}"),
+                       f"_slots{S}{gtag}{ltag}"),
             "value": round(r["tok_s"], 1), "unit": "tok/s",
             "vs_baseline": round(r["tok_s"] / 2000.0, 3),
             "p50_ttft_ms": round(r["p50_ttft_ms"], 1),
@@ -599,17 +718,30 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # CHEAPEST phase first, so the budget watchdog can never starve it:
+    # decode tok/s for the paged vs contiguous KV layouts, engine-direct
+    # on a small preset (identical config either side)
+    layout_cmp = _engine_direct_layout_compare(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
     errors = {}
     for p in presets:
+        if deadline - time.monotonic() < 60:
+            errors[p] = "skipped: bench budget exhausted"
+            continue
         try:
             results[p] = bench_http(p, prompt_len, max_new, target)
+            partial[f"{p}_tok_s"] = round(results[p]["tok_s"], 1)
         except Exception as e:  # report what ran; a preset OOM shouldn't
             errors[p] = f"{type(e).__name__}: {e}"  # zero the whole bench
     if not results:
-        raise RuntimeError(f"no preset completed: {errors}")
+        line = {"metric": "http_chat_tok_s_per_chip", "value": None,
+                "unit": "tok/s",
+                "kv_layout_compare": layout_cmp,
+                "errors": {p: e[:200] for p, e in errors.items()}}
+        print(json.dumps(line))
+        return
     primary = "8b" if "8b" in results else sorted(results)[0]
     r = results[primary]
     # effective config = preset value unless env-overridden (bench_http

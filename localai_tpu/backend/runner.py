@@ -171,12 +171,21 @@ class EngineServicer(BackendServicer):
                 f"unknown kv_cache_dtype {kv_dt_name!r} "
                 f"(one of {sorted(kv_dt_map)})")
         cache_dtype = kv_dt_map[kv_dt_name]
-        if family is not None and cache_dtype != jnp.bfloat16:
+        if family is not None and cache_dtype == jnp.int8:
             # mamba/rwkv cache lanes hold recurrent STATE, not KV rows;
             # quantizing recurrent state accumulates error every step
             raise ValueError(
-                "kv_cache_dtype is llama-family only (mamba/rwkv cache "
-                "lanes carry recurrent state)")
+                f"kv_cache_dtype {kv_dt_name!r} is llama-family only "
+                f"(mamba/rwkv cache lanes carry recurrent state, kept "
+                f"fp32); float dtypes are accepted as no-ops for these "
+                f"families")
+        if family is not None:
+            # float kv_cache_dtype values are NO-OPS for recurrent-state
+            # families (their init_cache pins fp32 — SSM/wkv recurrences
+            # are precision-sensitive) and the YAML validator accepts
+            # them for any family: accept rather than fail a valid
+            # config at load time (ADVICE r5, runner.py:201)
+            cache_dtype = jnp.bfloat16
 
         n_dev = len(jax.devices())
         tp = request.mesh_tp or n_dev
@@ -242,6 +251,17 @@ class EngineServicer(BackendServicer):
             # 0 (or absent) = engine default, matching the YAML contract
             **({"decode_burst": db} if (db := int(
                 extra.get("decode_burst", 0) or 0)) > 0 else {}),
+            # paged-KV knobs via the options escape hatch: the engine's
+            # "auto" default picks the paged layout for llama-family
+            # serving; kv_layout=contiguous opts out, kv_page_size /
+            # kv_pool_pages tune the pool (EngineConfig docs)
+            **({"kv_layout": kl} if (kl := str(
+                extra.get("kv_layout", "") or "")) in
+               ("paged", "contiguous") else {}),
+            **({"kv_page_size": kp} if (kp := int(
+                extra.get("kv_page_size", 0) or 0)) > 0 else {}),
+            **({"kv_pool_pages": kpp} if (kpp := int(
+                extra.get("kv_pool_pages", 0) or 0)) > 0 else {}),
         )
         draft = None
         if request.draft_model:

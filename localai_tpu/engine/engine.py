@@ -57,6 +57,21 @@ class EngineConfig:
     prefill_chunk: int = 512   # max prompt tokens processed between decode steps
     context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
+    # KV layout (llama family): "auto" -> the PAGED page-pool layout
+    # (ops/kvcache.py; ragged paged decode kernel on TPU) except in
+    # multi-host lockstep mode, where the page table is leader-local
+    # host state the followers can't replay -> contiguous. "paged" /
+    # "contiguous" force it. Paged admission allocates pages lazily per
+    # prefill chunk, shares prompt-prefix pages copy-on-write between
+    # slots (ref-counted; the first divergent page is cloned) and
+    # returns pages to a free list on finish.
+    kv_layout: str = "auto"
+    kv_page_size: int = 64
+    # physical pages in the pool; 0 = num_slots * max_context/page_size
+    # (exactly the contiguous reservation — never more HBM). Shrink to
+    # oversubscribe against actual usage; retained prefixes of free
+    # slots are reclaimed under pressure.
+    kv_pool_pages: int = 0
     # speculative decoding: draft proposals per round (0 disables even
     # when a draft model is loaded); greedy slots only
     n_draft: int = 4
@@ -289,13 +304,38 @@ class Engine:
         # speculative decoding (greedy-lossless; see engine/speculative.py)
         self.draft_cfg, self.draft_params = draft if draft else (None, None)
         self._state_shardings = self._make_state_shardings()
+        # paged KV layout resolution (EngineConfig.kv_layout doc):
+        # llama-family only; lockstep followers can't replay the leader's
+        # host-side page-table mutations, so "auto" degrades there
+        if self.ecfg.kv_layout == "paged" and bus is not None:
+            raise ValueError("kv_layout=paged is unsupported in multi-host "
+                             "lockstep mode (host-local page tables)")
+        if self.ecfg.kv_layout == "paged" and self.ecfg.ga_n > 1:
+            raise ValueError("kv_layout=paged is incompatible with "
+                             "self-extend (ga_n > 1): grouped-attention "
+                             "compression re-rotates cached keys in place, "
+                             "which page sharing cannot isolate")
+        self._paged = self._fam_llama and self.ecfg.ga_n <= 1 and (
+            self.ecfg.kv_layout == "paged"
+            or (self.ecfg.kv_layout == "auto" and bus is None))
+        self._pool = None
+        pg = 0
+        if self._paged:
+            from localai_tpu.engine.paging import PagePool
+
+            pg = max(1, min(self.ecfg.kv_page_size, C))
+            while C % pg:     # page size must divide the context
+                pg -= 1
+            self._pool = PagePool(S, C, pg, self.ecfg.kv_pool_pages)
         # device-resident state: big (KV cache), rarely-mutated (bias), or
         # not host-mirrorable (PRNG keys). Everything per-slot and small
         # lives as HOST numpy — admissions/releases are then free in-place
         # writes instead of ~3ms `.at[].set` dispatches, and the arrays ride
         # to the device as ordinary jit args each step.
-        self.ck, self.cv = self.family.init_cache(model_cfg, S, C,
-                                                  self.ecfg.cache_dtype)
+        self.ck, self.cv = self.family.init_cache(
+            model_cfg, S, C, self.ecfg.cache_dtype,
+            **({"page_size": pg, "num_pages": self.ecfg.kv_pool_pages}
+               if self._paged else {}))
         # draft cache is allocated LAZILY at the first spec-eligible
         # admission (r2 allocated it up front, doubling per-slot KV HBM
         # even when no request could ever speculate)
@@ -489,6 +529,135 @@ class Engine:
         self.cv = kvcache.device_put(self.cv, self.mesh, sh["cache_spec"])
         self.bias = jax.device_put(self.bias, sh["slot_mat"])
         self.rng_keys = jax.device_put(self.rng_keys, sh["slot_mat"])
+
+    # ---------- paged KV plumbing ----------
+
+    def _commit_ptab(self):
+        """Commit the host page-table mirror into the cache pytrees (the
+        table rides INSIDE ck/cv so every jitted body stays
+        layout-agnostic). Called before any dispatch that touches the
+        cache; a no-op unless the allocator dirtied the table."""
+        if not self._paged or not self._pool.dirty:
+            return
+        # two independent uploads: ck and cv are donated separately, and a
+        # shared leaf would be the same buffer donated twice
+        tabs = [jnp.asarray(self._pool.ptab) for _ in range(2)]
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self.mesh, P(None, None))
+            tabs = [jax.device_put(t, sh) for t in tabs]
+        self.ck = kvcache.with_page_table(self.ck, tabs[0])
+        self.cv = kvcache.with_page_table(self.cv, tabs[1])
+        self._pool.dirty = False
+
+    def _ensure_pages(self, slot: int, rows: int):
+        """Lazy page allocation with reclaim: on pool pressure, retained
+        prefix pages of FREE slots are released (their _cache_tokens
+        cleared so _pick_slot stops advertising the prefix) and the
+        allocation retried."""
+        if not self._paged:
+            return
+        from localai_tpu.engine.paging import PoolExhausted
+
+        try:
+            self._pool.ensure(slot, rows)
+            return
+        except PoolExhausted:
+            pass
+        for i, s in enumerate(self.slots):
+            if s is None and i != slot and self._pool.owned[i]:
+                self._pool.release(i, 0)
+                self._cache_tokens[i] = []
+                if self._pool.free_pages >= self._pool.pages_for(rows):
+                    break
+        self._pool.ensure(slot, rows)   # raises PoolExhausted if truly full
+
+    def _get_page_clone_fn(self):
+        fn = self._fork_fns.get("page_clone")
+        if fn is None:
+            fn = jax.jit(
+                lambda ck, cv, src, dst: (kvcache.clone_page(ck, src, dst),
+                                          kvcache.clone_page(cv, src, dst)),
+                donate_argnums=(0, 1))
+            self._fork_fns["page_clone"] = fn
+        return fn
+
+    def _cow_guard(self, slot: int, row: int):
+        """Copy-on-write: if the page containing ``row`` (the slot's first
+        write position) is shared, clone it into a fresh page before any
+        scatter can touch it. Pages before it stay shared — zero copies
+        for the common prefix; this one page is the 'first divergent
+        page' clone."""
+        if not self._paged:
+            return
+        pi = self._pool.cow_page(slot, row)
+        if pi < 0:
+            return
+        old = int(self._pool.ptab[slot, pi])
+        new = self._pool.alloc_detached()
+        self._commit_ptab()
+        self.ck, self.cv = self._get_page_clone_fn()(
+            self.ck, self.cv, np.int32(old), np.int32(new))
+        self._pool.replace(slot, pi, new)
+
+    def _share_prefix(self, src: int, dst: int, rows: int) -> int:
+        """Zero-copy prefix transfer: full pages covering rows[0:rows] are
+        ref-count-shared into dst's table; when the prefix ends mid-page,
+        that FIRST DIVERGENT page is cloned (one page copy, never a row
+        loop) so dst reuses exactly ``rows`` rows."""
+        shared = self._pool.share(src, dst, rows)
+        if shared < rows:
+            pi = shared // self._pool.page_size
+            src_page = int(self._pool.ptab[src, pi])
+            new = self._pool.alloc_detached()
+            self._commit_ptab()
+            self.ck, self.cv = self._get_page_clone_fn()(
+                self.ck, self.cv, np.int32(src_page), np.int32(new))
+            self._pool.adopt(dst, new)
+            shared = rows
+        return shared
+
+    def _paged_admission(self, slot: int, ids: list, common: int) -> int:
+        """Paged prefix reuse at admission. Returns the reusable row
+        count. Three tiers, best wins:
+          1. the slot's OWN retained rows (common — free, pages already
+             owned);
+          2. another slot's prefix, shared COPY-ON-WRITE (_share_prefix):
+             zero KV row copies for the full pages, at most one page
+             clone at the divergence boundary; only rows that are
+             read-only for the source (committed prompt rows of an
+             active slot / retained rows of a free one) are eligible;
+          3. neither — pages released for reuse by the pool.
+        Either way the first page this request will write is COW-guarded."""
+        pool = self._pool
+        best_src, best_rows = -1, 0
+        if self.ecfg.ga_n <= 1:
+            # cross-slot scan (self-extend rewrites cached keys in place,
+            # so sharing is gated off under ga — rotation would corrupt
+            # the other referents' view)
+            cap = len(ids) - 1          # always leave >= 1 token to prefill
+            for j, sj in enumerate(self.slots):
+                if j == slot:
+                    continue
+                toks = self._cache_tokens[j]
+                limit = len(toks) if sj is None else min(sj.committed,
+                                                         sj.prompt_len)
+                limit = min(limit, cap, pool.slot_rows_capacity(j))
+                n = 0
+                for a, b in zip(toks[:limit], ids):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_rows:
+                    best_src, best_rows = j, n
+        if best_rows > common and best_rows >= 16:
+            pool.release(slot, 0)
+            return self._share_prefix(best_src, slot, best_rows)
+        pool.release(slot, common)
+        if common:
+            self._cow_guard(slot, common)
+        return common
 
     # ---------- jitted step bodies ----------
 
@@ -909,8 +1078,17 @@ class Engine:
             self._bus.send("reset")
         S = self.ecfg.num_slots
         V = self.cfg.vocab_size
+        if self._paged:
+            from localai_tpu.engine.paging import PagePool
+
+            self._pool = PagePool(S, self.ecfg.max_context,
+                                  self._pool.page_size,
+                                  self.ecfg.kv_pool_pages)
         self.ck, self.cv = self.family.init_cache(
-            self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype)
+            self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype,
+            **({"page_size": self._pool.page_size,
+                "num_pages": self.ecfg.kv_pool_pages}
+               if self._paged else {}))
         self.dck = self.dcv = None   # re-ensured at the next spec admission
         self.ring, self.ring_pos = sampling.make_ring(S)
         self.bias = jnp.zeros((S, V), jnp.float32)
@@ -980,6 +1158,14 @@ class Engine:
             "prompt_tokens_reused": self._reused_total,
             "uptime_s": time.monotonic() - self._load_time,
         }
+        if self._paged:
+            out["kv_layout"] = "paged"
+            out["kv_page_size"] = self._pool.page_size
+            out["kv_pages_total"] = self._pool.num_pages
+            out["kv_pages_in_use"] = self._pool.pages_in_use
+            out["kv_pages_shared"] = int((self._pool.refs > 1).sum())
+        else:
+            out["kv_layout"] = "contiguous"
         with self._decomp_lock:
             d = list(self._ttft_decomp)
         if d:
@@ -1310,7 +1496,16 @@ class Engine:
             # non-llama families have no positional KV rows to share —
             # prefix reuse and prompt-cache restore are llama-only
             common = 0
-        elif mm_pos is None:
+        if self._paged:
+            if self.ecfg.ga_n > 1 or mm_pos is not None:
+                # no reuse or sharing for these: recycle the slot's
+                # retained pages into the pool
+                self._pool.release(slot, 0)
+            else:
+                # paged reuse: own retained pages, or copy-on-write page
+                # sharing from ANY slot's prefix (zero KV row copies)
+                common = self._paged_admission(slot, ids, common)
+        if self._fam_llama and self.ecfg.ga_n <= 1 and mm_pos is None:
             common = self._restore_prompt_cache(slot, req, ids, common)
 
         # install sampling state for the slot
@@ -1426,6 +1621,12 @@ class Engine:
         s = _Slot(req, IncrementalDetokenizer(self.tokenizer), len(ids))
         s.phase = "fork_wait"
         s.pending = []
+        if self._paged:
+            # drop the previous tenant's retained pages now: the fork
+            # resolution either shares the leader's pages into an empty
+            # table or downgrades to a fresh full prefill — and the old
+            # pages may be shared with other slots (never overwrite)
+            self._pool.release(slot, 0)
         self._cache_tokens[slot] = []
         self.slots[slot] = s
         self._fork_waiters.setdefault(leader_slot, []).append(
@@ -1461,7 +1662,25 @@ class Engine:
             leader_ok = (self.slots[leader_slot] is lsnap
                          and lsnap.phase == "decode"
                          and self._cache_tokens[leader_slot][:len(ids)] == ids)
-            if leader_ok and len(ids) > 1:
+            if leader_ok and len(ids) > 1 and self._paged:
+                # PAGED fork-dedup: the sibling's table points at the
+                # leader's full prompt pages (ref-counted, zero row
+                # copies; one boundary-page clone when the prompt ends
+                # mid-page). The leader only ever appends past its
+                # prompt, so shared pages stay read-only for it.
+                n = len(ids) - 1
+                self._pool.release(sib, 0)
+                shared = self._share_prefix(leader_slot, sib, n)
+                s.pending = ids[shared:]
+                s.written = shared
+                s.committed = shared
+                s.reused = shared
+                self._reused_total += shared
+                self._cache_tokens[sib] = list(ids)
+                # the draft cache stays contiguous and unshared — paged
+                # siblings never join spec rounds
+                s.spec_ok = False
+            elif leader_ok and len(ids) > 1:
                 n = len(ids) - 1
                 self.ck, self.cv = self._get_fork_fn("main")(
                     self.ck, self.cv, leader_slot, sib, n)
@@ -1566,6 +1785,15 @@ class Engine:
         kfull, vfull, ctoks2 = self._load_prompt_cache_rows(path, m)
         if kfull is None or ctoks2[:m] != ids[:m]:
             return common
+        if self._paged:
+            # the restore scatter writes rows [0, m) through the slot's
+            # table — never into pages other slots still reference: drop
+            # any shared pages first (restore beats sharing: m > common)
+            npg = min(self._pool.pages_for(m), int(self._pool.owned[slot]))
+            if any(self._pool.page_refs(slot, i) > 1 for i in range(npg)):
+                self._pool.release(slot, 0)
+            self._ensure_pages(slot, m)
+            self._commit_ptab()
         if self._bus is not None:
             # followers replay the same restore body from the same file
             # (shared filesystem); the token prefix rides along so a
@@ -1640,6 +1868,7 @@ class Engine:
             # the descriptor goes out first and every process issues it
             if self._bus is not None:
                 self._bus.send("cache_save", slot=slot, n2=n2)
+            self._commit_ptab()   # export gathers through the page table
             k_dev, v_dev = self._get_cache_export_fn(n2)(
                 self.ck, self.cv, np.int32(slot))
             path = req.prompt_cache_path
@@ -1699,6 +1928,8 @@ class Engine:
                                                  s.ga_blocks)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :take] = s.pending[:take]
+        self._ensure_pages(slot, s.written + take)
+        self._commit_ptab()
         t0 = time.monotonic()
         if not final:
             self.ck, self.cv = self._get_ga_chunk_fn(bucket)(
@@ -1755,6 +1986,7 @@ class Engine:
             new = c * (w // n) + (i - c * w) // n
             deltas[c * w:(c + 1) * w] = (new - old).astype(np.int32)
             deltas[(c + 1) * w:s.committed] = -bd
+            self._commit_ptab()   # rotation reads/writes via the table
             self.ck = self._get_ga_rotate_fn()(self.ck, np.int32(slot), deltas)
             self.pos_offset[slot] += bd
             s.ga_blocks = c + 1
@@ -1822,6 +2054,8 @@ class Engine:
 
         t0 = time.monotonic()
         if not final:
+            self._ensure_pages(slot, s.written + take)
+            self._commit_ptab()
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :take] = s.pending[:take]
             args = (self.params, tokens, np.array([take], np.int32), self.ck,
@@ -1885,6 +2119,9 @@ class Engine:
             while B < len(group):
                 B *= 2
 
+        for gslot, gtake in group:
+            self._ensure_pages(gslot, self.slots[gslot].written + gtake)
+        self._commit_ptab()
         tokens = np.zeros((B, bucket), np.int32)
         seq_len = np.ones((B,), np.int32)
         slots_v = np.zeros((B,), np.int32)
@@ -1996,6 +2233,16 @@ class Engine:
                 active[i] = False
                 continue
             included.append((i, s))
+        C = self.ecfg.max_context
+        for gslot, gs in group_snaps:
+            # pages for the prompt rows AND the K fused burst steps
+            self._ensure_pages(gslot, min(C, gs.written + K + 2))
+        for i, s in included:
+            if any(g == i for g, _ in group_snaps):
+                continue
+            self._ensure_pages(i, min(C, int(self.lengths[i])
+                                      + self._inflight_steps(i) + K + 2))
+        self._commit_ptab()
         ov_mask = np.zeros((S,), np.bool_)
         if self._chain is None:
             chain = (self.cur_tokens.copy(), self.lengths.copy(),
@@ -2233,6 +2480,12 @@ class Engine:
         burst_slots = [(i, s) for i, s in enumerate(self.slots)
                        if s is not None and s.phase == "decode"
                        and eligible[i]]
+        if self._paged:
+            C = self.ecfg.max_context
+            for i, _s in burst_slots:
+                self._ensure_pages(i, min(C, int(self.lengths[i])
+                                          + self.ecfg.n_draft + 2))
+            self._commit_ptab()
         out, out_lp, n_out, self.ck, self.cv, self.dck, self.dcv, _ = fn(
             self.params, self.draft_params, self.cur_tokens.copy(),
             self.lengths.copy(), self.ck, self.cv, self.dck, self.dcv,
@@ -2310,6 +2563,13 @@ class Engine:
         if not included:
             return False
         n_steps = self._pick_burst()
+        if self._paged:
+            C = self.ecfg.max_context
+            for i in included:
+                self._ensure_pages(i, min(C, int(self.lengths[i])
+                                          + self._inflight_steps(i)
+                                          + n_steps + 2))
+            self._commit_ptab()
         f = sampling.feature_flags(self.slot_params, self.active_dev)
         flags = (f["use_penalties"], f["use_typical"], f["use_mirostat"])
         if any(flags) and flags != (True, True, True):
@@ -2566,6 +2826,11 @@ class Engine:
         history = self._cache_tokens[slot] + [token_id]
         keep = max(self.ecfg.max_context // 2, 1)
         new_ids = history[-keep:]
+        if self._paged:
+            # the shift re-prefills from row 0: give the pages back first
+            # (referents of shared pages keep them alive) and re-allocate
+            # lazily per chunk — never rewrite a page another slot reads
+            self._pool.release(slot, 0)
         s.phase = "prefill"
         s.pending = list(new_ids)
         s.written = 0
@@ -2618,6 +2883,10 @@ class Engine:
         s = self.slots[slot]
         if s is not None:
             self._cache_tokens[slot] = self._cache_tokens[slot][:s.committed]
+        if self._paged:
+            # keep the retained prefix's pages (same reuse story as
+            # _cache_tokens); everything past it returns to the free list
+            self._pool.release(slot, len(self._cache_tokens[slot]))
         self.slots[slot] = None
         self.active_dev[slot] = False
         self.lengths[slot] = 0

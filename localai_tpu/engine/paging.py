@@ -1,0 +1,166 @@
+"""Host-side page allocator for the paged KV cache (ops/kvcache.py).
+
+The device holds the page POOL ([L, n_pages, page_size, KV, hd]) and a
+snapshot of the page TABLE; this module owns the table's numpy mirror
+plus everything the device cannot do: the free list, per-page REFERENCE
+COUNTS, lazy allocation, and copy-on-write sharing decisions. The engine
+commits the mirror to the device (kvcache.with_page_table) before each
+dispatch that touches the cache — a ~KB upload, only when dirty.
+
+Sharing model (the zero-copy prefix path):
+  * share(src, dst, rows) points dst's leading table entries at src's
+    FULL pages covering rows[0:rows] and bumps their refcounts — no KV
+    rows move. Only full pages are ever shared, and only rows that are
+    strictly read-only for the source (its committed prompt prefix), so
+    the source never writes into a shared page.
+  * The first page the NEW request writes (the one containing its first
+    divergent row) is CLONED by the engine when its refcount is > 1
+    (kvcache.clone_page) — classic copy-on-write; pages past it are
+    allocated fresh.
+  * release(slot, keep_rows) drops refcounts; a page returns to the
+    free list when its last referent lets go. Freed slots RETAIN their
+    prefix pages (keep_rows = committed tokens) so a later request with
+    the same prefix reuses them in place — the paged analogue of the
+    contiguous layout's cache_tokens retention.
+
+Pool sizing: num_pages defaults to num_slots * max_context / page_size —
+exactly the contiguous reservation, so the default config never uses
+more HBM than before; sharing + lazy allocation make it use less.
+Shrinking num_pages oversubscribes HBM against actual (not worst-case)
+usage; the engine reclaims retained pages of free slots on pressure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PoolExhausted(RuntimeError):
+    """No free page; the engine reclaims retained prefixes and retries."""
+
+
+class PagePool:
+    def __init__(self, num_slots: int, max_context: int, page_size: int,
+                 num_pages: int = 0):
+        if max_context % page_size:
+            raise ValueError(
+                f"max_context {max_context} not a multiple of page_size "
+                f"{page_size}")
+        self.page_size = page_size
+        self.max_pages = max_context // page_size
+        self.num_pages = num_pages or num_slots * self.max_pages
+        self.num_slots = num_slots
+        # sentinel num_pages = unallocated (drops scatters, zero-fills
+        # gathers on device)
+        self.ptab = np.full((num_slots, self.max_pages), self.num_pages,
+                            np.int32)
+        self.refs = np.zeros((self.num_pages,), np.int32)
+        self.owned = np.zeros((num_slots,), np.int32)  # table entries in use
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self.dirty = True      # device table snapshot is stale
+
+    # ---------- accounting ----------
+
+    def pages_for(self, rows: int) -> int:
+        return -(-int(rows) // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def slot_rows_capacity(self, slot: int) -> int:
+        return int(self.owned[slot]) * self.page_size
+
+    def page_refs(self, slot: int, page_idx: int) -> int:
+        p = int(self.ptab[slot, page_idx])
+        return int(self.refs[p]) if p < self.num_pages else 0
+
+    # ---------- allocation ----------
+
+    def _alloc(self) -> int:
+        if not self._free:
+            raise PoolExhausted(
+                f"page pool exhausted ({self.num_pages} pages of "
+                f"{self.page_size} rows)")
+        p = self._free.pop()
+        self.refs[p] = 1
+        return p
+
+    def alloc_detached(self) -> int:
+        """One page owned by nobody yet (copy-on-write clone target);
+        hand it to replace() or free it via unref_detached()."""
+        return self._alloc()
+
+    def unref_detached(self, page: int):
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self._free.append(page)
+
+    def ensure(self, slot: int, rows: int) -> bool:
+        """Allocate pages so the slot can hold ``rows`` logical rows
+        (lazy, page granularity). Returns True if the table changed."""
+        need = min(self.pages_for(rows), self.max_pages)
+        changed = False
+        while self.owned[slot] < need:
+            self.ptab[slot, self.owned[slot]] = self._alloc()
+            self.owned[slot] += 1
+            changed = True
+        if changed:
+            self.dirty = True
+        return changed
+
+    def release(self, slot: int, keep_rows: int = 0):
+        """Drop the slot's pages beyond those covering keep_rows."""
+        keep = min(self.pages_for(keep_rows), self.max_pages)
+        while self.owned[slot] > keep:
+            self.owned[slot] -= 1
+            i = int(self.owned[slot])
+            self.unref_detached(int(self.ptab[slot, i]))
+            self.ptab[slot, i] = self.num_pages
+            self.dirty = True
+
+    # ---------- sharing / copy-on-write ----------
+
+    def share(self, src: int, dst: int, rows: int) -> int:
+        """Point dst's leading entries at src's full pages covering
+        rows[0:rows]; refcounts bump, nothing is copied. dst must own no
+        pages. Returns the rows actually shared (a page multiple)."""
+        n = min(int(rows) // self.page_size, int(self.owned[src]))
+        assert self.owned[dst] == 0, "share() into a non-empty slot"
+        for i in range(n):
+            p = int(self.ptab[src, i])
+            self.ptab[dst, i] = p
+            self.refs[p] += 1
+        self.owned[dst] = n
+        if n:
+            self.dirty = True
+        return n * self.page_size
+
+    def adopt(self, slot: int, page: int):
+        """Append a detached (freshly cloned) page to the slot's table —
+        the commit half of a boundary-page clone."""
+        i = int(self.owned[slot])
+        assert i < self.max_pages
+        self.ptab[slot, i] = page
+        self.owned[slot] = i + 1
+        self.dirty = True
+
+    def cow_page(self, slot: int, row: int) -> int:
+        """Table index of the page containing ``row`` IF the slot owns it
+        and it is shared (refcount > 1) — i.e. writing row requires a
+        clone first. -1 otherwise."""
+        i = int(row) // self.page_size
+        if i < self.owned[slot] and self.page_refs(slot, i) > 1:
+            return i
+        return -1
+
+    def replace(self, slot: int, page_idx: int, new_page: int):
+        """Swap a (cloned) page into the slot's table (COW commit)."""
+        old = int(self.ptab[slot, page_idx])
+        self.ptab[slot, page_idx] = new_page
+        self.unref_detached(old)
+        self.dirty = True

@@ -535,6 +535,11 @@ def generate_semantic(params, cfg: BarkConfig, text_ids, text_len,
     P = ml + 1
     prefix_len = jnp.full((B,), P, jnp.int32)
 
+    # HF cropping semantics: generation stops at the model's block_size.
+    # Without the clamp, write positions saturate at block_size-1
+    # (jnp.minimum in _semantic_scan) and late steps silently overwrite
+    # the last KV row, degrading the audio tail (ADVICE r5, bark.py:833).
+    max_new = min(max_new, sub.block_size - P)
     total = min(P + max_new, sub.block_size)
 
     toks = np.asarray(_semantic_scan(

@@ -360,6 +360,27 @@ def _decode_attend_write(q1, k1, v1, lck, lcv, lengths, cfg: LlamaConfig):
     S = q1.shape[0]
     slot_idx = jnp.arange(S, dtype=jnp.int32)
     mode = _decode_attn_mode()
+    if kvcache.is_paged(lck):
+        # PAGED layout: the ragged paged kernel on real TPU backends
+        # (pages consumed in place, page table scalar-prefetched into the
+        # block pipeline); pure-jnp page gather + append-attention
+        # everywhere else (JAX_PLATFORMS=cpu tests, int8 paged caches —
+        # the gathered {"q","s"} rows fold scales exactly like the
+        # contiguous path)
+        if _pallas_decode() and not kvcache.is_quant(lck):
+            from localai_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_append)
+
+            attn = paged_decode_attention_append(
+                q1, k1, v1, lck["pages"], lcv["pages"], lck["ptab"],
+                lengths, cfg.q_per_kv)
+        else:
+            attn = decode_attention_append(
+                q1, k1, v1, kvcache.gather_all_rows(lck),
+                kvcache.gather_all_rows(lcv), lengths, cfg.q_per_kv)
+        lk = kvcache.scatter_decode(lck, slot_idx, lengths, k1)
+        lv = kvcache.scatter_decode(lcv, slot_idx, lengths, v1)
+        return attn, lk, lv
     if mode == "pallas" and _pallas_decode() and not kvcache.is_quant(lck):
         from localai_tpu.ops.pallas.decode_attention import (
             decode_attention_append_pallas)
@@ -568,12 +589,26 @@ def shift_cache_positions(cache_k: jax.Array, cfg: LlamaConfig,
         return kvcache.tree_slot_update(cache_k, slot,
                                         kvcache.rows_from_float(out, cache_k))
     out = rotate_by_delta(rows, sin[None, :, None, :], cos[None, :, None, :])
+    if kvcache.is_paged(cache_k):
+        # scatter the rotated rows back through the page table (the slot
+        # owns its pages exclusively here: cross-slot page sharing is
+        # disabled under self-extend — see engine admission gates)
+        return kvcache.tree_slot_update(cache_k, slot, out)
     return cache_k.at[:, slot].set(out)
 
 
-def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int, dtype=None):
+def init_cache(cfg: LlamaConfig, num_slots: int, max_len: int, dtype=None,
+               page_size: int = 0, num_pages: int = 0):
     """KV cache: ([L, S, C, KV, hd], [L, S, C, KV, hd]); ``dtype=int8``
-    selects the quantized {"q","s"} pytree (ops/kvcache.py)."""
+    selects the quantized {"q","s"} pytree (ops/kvcache.py).
+
+    ``page_size > 0`` selects the PAGED layout instead: a shared page
+    pool (num_pages physical pages, default the full S * C/page_size —
+    i.e. never more HBM than the contiguous reservation) plus a per-slot
+    page table, same logical shape."""
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, num_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
+    if page_size:
+        return (kvcache.init_paged(shape, dtype, page_size, num_pages),
+                kvcache.init_paged(shape, dtype, page_size, num_pages))
     return kvcache.init(shape, dtype), kvcache.init(shape, dtype)

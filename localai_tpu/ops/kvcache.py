@@ -1,4 +1,33 @@
-"""Quantized KV-cache representation (int8, per-row-per-head scales).
+"""KV-cache representations: quantized (int8) and PAGED layouts.
+
+Two orthogonal axes of representation, both expressed as pytrees so the
+engine's jitted bodies stay shape-stable and donation-friendly:
+
+1. QUANTIZED (int8, per-row-per-head scales) — see below.
+2. PAGED (Ragged Paged Attention, PAPERS.md arxiv 2604.15464): instead
+   of one contiguous [L, S, C, KV, hd] reservation, KV rows live in a
+   shared PAGE POOL
+
+       {"pages": [L, n_pages, page_size, KV, hd],
+        "ptab":  int32 [S, max_pages]}            (+ "scales" when int8)
+
+   with a per-slot page table mapping logical row c of slot s to
+   physical row ``ptab[s, c // page_size] * page_size + c % page_size``.
+   Unallocated table entries hold the sentinel ``n_pages`` so gathers
+   fill zeros and scatters drop (mode="drop") — the same OOB discipline
+   the contiguous layout uses for inactive slots. The page table rides
+   INSIDE the cache pytree: every jitted engine body (bursts, prefill,
+   fused admission, restore) is layout-agnostic — the host allocator
+   (engine/paging.py) mutates its numpy mirror and commits it as a new
+   ``ptab`` leaf before dispatch. Logical shape() stays
+   [L, S, max_pages*page_size, KV, hd], so capacity math is unchanged.
+
+   Why: HBM is reserved for actual rows (lazily, page granularity)
+   instead of worst-case per slot, and a shared prompt prefix is
+   REF-COUNTED page sharing instead of a row copy (copy-on-write: the
+   first divergent page is cloned, see clone_page / engine admission).
+
+Quantized representation (int8, per-row-per-head scales).
 
 `kv_cache_dtype: int8` in the model YAML (reference analogue: llama.cpp's
 `cache-type-k q8_0`, plumbed via backend.proto ModelOptions and vLLM's
@@ -40,8 +69,15 @@ def wants_quant(dtype) -> bool:
     return dtype == jnp.int8
 
 
+def is_paged(cache: Any) -> bool:
+    """True for the page-pool layout (full cache or single-layer view)."""
+    return isinstance(cache, dict) and "ptab" in cache
+
+
 def is_quant(cache: Any) -> bool:
-    return isinstance(cache, dict)
+    """True when rows are stored int8 with folded scales — for BOTH the
+    contiguous {"q","s"} pytree and the paged {"pages","scales","ptab"}."""
+    return isinstance(cache, dict) and ("q" in cache or "scales" in cache)
 
 
 def init(shape: Tuple[int, ...], dtype) -> Cache:
@@ -52,7 +88,51 @@ def init(shape: Tuple[int, ...], dtype) -> Cache:
     return jnp.zeros(shape, dtype)
 
 
+def init_paged(shape: Tuple[int, ...], dtype, page_size: int,
+               num_pages: int = 0) -> Cache:
+    """Page-pool cache for logical shape [L, S, C, KV, hd].
+
+    C must be a page_size multiple; max_pages = C // page_size. num_pages
+    defaults to S * max_pages — exactly the old contiguous reservation,
+    never more (callers shrink it to realize HBM savings). The page table
+    starts all-sentinel (nothing allocated)."""
+    L, S, C, KV, hd = shape
+    if C % page_size:
+        raise ValueError(f"max_context {C} not a multiple of page_size "
+                         f"{page_size}")
+    mp = C // page_size
+    np_ = num_pages or S * mp
+    ptab = jnp.full((S, mp), np_, jnp.int32)
+    if wants_quant(dtype):
+        return {"pages": jnp.zeros((L, np_, page_size, KV, hd), jnp.int8),
+                "scales": jnp.zeros((L, np_, page_size, KV), jnp.float32),
+                "ptab": ptab}
+    return {"pages": jnp.zeros((L, np_, page_size, KV, hd), dtype),
+            "ptab": ptab}
+
+
+def page_size(cache: Cache) -> int:
+    return cache["pages"].shape[-3]
+
+
+def num_phys_pages(cache: Cache) -> int:
+    return cache["pages"].shape[-4]
+
+
+def with_page_table(cache: Cache, ptab) -> Cache:
+    """New cache dict with the (host-updated) page table committed."""
+    out = dict(cache)
+    out["ptab"] = ptab
+    return out
+
+
 def shape(cache: Cache) -> Tuple[int, ...]:
+    """LOGICAL shape [L, S, C, KV, hd] — paged caches report
+    C = max_pages * page_size so capacity math is layout-agnostic."""
+    if is_paged(cache):
+        pg = cache["pages"]
+        s, mp = cache["ptab"].shape
+        return (pg.shape[0], s, mp * pg.shape[-3]) + pg.shape[-2:]
     if is_quant(cache):
         return cache["q"].shape
     return cache.shape
@@ -61,9 +141,32 @@ def shape(cache: Cache) -> Tuple[int, ...]:
 def store_dtype(cache: Cache):
     """The dtype new rows must be cast to before a raw scatter (plain
     caches only; quantized caches go through quantize())."""
+    if is_paged(cache):
+        return cache["pages"].dtype
     if is_quant(cache):
         return jnp.int8
     return cache.dtype
+
+
+def _row_index(ptab_rows: jax.Array, pg: int) -> jax.Array:
+    """Expand page-table rows [..., MP] to physical row ids [..., MP*pg].
+    Sentinel entries expand past the pool — gathers must use mode="fill"."""
+    base = ptab_rows[..., :, None] * pg + jnp.arange(pg, dtype=jnp.int32)
+    return base.reshape(*ptab_rows.shape[:-1], ptab_rows.shape[-1] * pg)
+
+
+def _page_of(ptab_rows: jax.Array, cols: jax.Array, pg: int,
+             n_pages: int) -> Tuple[jax.Array, jax.Array]:
+    """(physical page, in-page offset) for logical columns, vectorized.
+
+    ptab_rows [..., MP] are the owning slots' table rows aligned with
+    cols [...]. Out-of-range columns (>= MP*pg, e.g. the drop sentinel
+    used for inactive slots) map to page n_pages so scatters drop."""
+    mp = ptab_rows.shape[-1]
+    pidx = cols // pg
+    page = jnp.take_along_axis(
+        ptab_rows, jnp.minimum(pidx, mp - 1)[..., None], axis=-1)[..., 0]
+    return jnp.where(pidx < mp, page, n_pages), cols % pg
 
 
 def quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -92,12 +195,23 @@ def gather_slots(cache: Cache, slot_ids: jax.Array) -> Cache:
 
 def layer(cache: Cache, li) -> Cache:
     """Select one layer (inside the lax.scan over layers)."""
+    if is_paged(cache):
+        out = {"pages": cache["pages"][li], "ptab": cache["ptab"]}
+        if "scales" in cache:
+            out["scales"] = cache["scales"][li]
+        return out
     if is_quant(cache):
         return {"q": cache["q"][li], "s": cache["s"][li]}
     return cache[li]
 
 
 def set_layer(cache: Cache, li, lcache: Cache) -> Cache:
+    if is_paged(cache):
+        out = {"pages": cache["pages"].at[li].set(lcache["pages"]),
+               "ptab": cache["ptab"]}
+        if "scales" in cache:
+            out["scales"] = cache["scales"].at[li].set(lcache["scales"])
+        return out
     if is_quant(cache):
         return {"q": cache["q"].at[li].set(lcache["q"]),
                 "s": cache["s"].at[li].set(lcache["s"])}
@@ -105,10 +219,35 @@ def set_layer(cache: Cache, li, lcache: Cache) -> Cache:
 
 
 def gather_layer_rows(lcache: Cache, slot_ids: jax.Array) -> Cache:
-    """lcache[slot_ids] for a single-layer cache [S, C, KV, hd]."""
+    """lcache[slot_ids] for a single-layer cache [S, C, KV, hd].
+
+    Paged caches materialize the selected slots' logical rows densely
+    (page gather with zero fill for unallocated pages) — prefill-path
+    only; the decode hot path uses the paged kernel / gather_all_rows."""
+    if is_paged(lcache):
+        pg = lcache["pages"].shape[-3]
+        idx = _row_index(lcache["ptab"][slot_ids], pg)          # [B, C]
+        flat = lcache["pages"].reshape((-1,) + lcache["pages"].shape[-2:])
+        rows = jnp.take(flat, idx, axis=0, mode="fill", fill_value=0)
+        if "scales" in lcache:
+            sflat = lcache["scales"].reshape(-1, lcache["scales"].shape[-1])
+            return {"q": rows,
+                    "s": jnp.take(sflat, idx, axis=0, mode="fill",
+                                  fill_value=0)}
+        return rows
     if is_quant(lcache):
         return {"q": lcache["q"][slot_ids], "s": lcache["s"][slot_ids]}
     return lcache[slot_ids]
+
+
+def gather_all_rows(lcache: Cache) -> Cache:
+    """Single-layer paged cache -> dense [S, C, KV, hd] rows for every
+    slot (the pure-jnp decode fallback used where the Pallas ragged
+    kernel is unavailable, e.g. JAX_PLATFORMS=cpu)."""
+    if not is_paged(lcache):
+        return lcache
+    s = lcache["ptab"].shape[0]
+    return gather_layer_rows(lcache, jnp.arange(s, dtype=jnp.int32))
 
 
 def scatter_decode(lcache: Cache, slot_idx: jax.Array, lengths: jax.Array,
@@ -117,6 +256,19 @@ def scatter_decode(lcache: Cache, slot_idx: jax.Array, lengths: jax.Array,
 
     lcache: single-layer [S, C, KV, hd]; new_kv: [S, KV, hd] float.
     """
+    if is_paged(lcache):
+        n_pages = lcache["pages"].shape[0]
+        pg = lcache["pages"].shape[-3]
+        page, off = _page_of(lcache["ptab"][slot_idx], lengths, pg, n_pages)
+        out = dict(lcache)
+        if "scales" in lcache:
+            q, s = quantize(new_kv)
+            out["pages"] = lcache["pages"].at[page, off].set(q, mode="drop")
+            out["scales"] = lcache["scales"].at[page, off].set(s, mode="drop")
+        else:
+            out["pages"] = lcache["pages"].at[page, off].set(
+                new_kv.astype(lcache["pages"].dtype), mode="drop")
+        return out
     if is_quant(lcache):
         q, s = quantize(new_kv)
         return {"q": lcache["q"].at[slot_idx, lengths].set(q, mode="drop"),
@@ -131,6 +283,21 @@ def scatter_prefill(cache: Cache, li, rows: jax.Array, cols: jax.Array,
 
     cache: full [L, S, C, KV, hd]; rows/cols: [B, T]; new_kv: [B, T, KV, hd].
     """
+    if is_paged(cache):
+        n_pages = cache["pages"].shape[1]
+        pg = cache["pages"].shape[-3]
+        page, off = _page_of(cache["ptab"][rows], cols, pg, n_pages)
+        out = dict(cache)
+        if "scales" in cache:
+            q, s = quantize(new_kv)
+            out["pages"] = cache["pages"].at[li, page, off].set(
+                q, mode="drop")
+            out["scales"] = cache["scales"].at[li, page, off].set(
+                s, mode="drop")
+        else:
+            out["pages"] = cache["pages"].at[li, page, off].set(
+                new_kv.astype(cache["pages"].dtype), mode="drop")
+        return out
     if is_quant(cache):
         q, s = quantize(new_kv)
         return {"q": cache["q"].at[li, rows, cols].set(q, mode="drop"),
@@ -140,15 +307,62 @@ def scatter_prefill(cache: Cache, li, rows: jax.Array, cols: jax.Array,
 
 
 def tree_slot_update(cache: Cache, dst, new_rows: Cache) -> Cache:
-    """cache[:, dst] = new_rows per leaf (fork / restore bodies)."""
+    """cache[:, dst] = new_rows per leaf (fork / restore bodies).
+
+    Paged caches scatter the dense row set into dst's OWN pages via the
+    table; rows over unallocated pages are dropped. (Page SHARING is a
+    host-side table edit, not a device op — see engine/paging.py.)"""
+    if is_paged(cache):
+        pg = cache["pages"].shape[-3]
+        c = cache["ptab"].shape[1] * pg
+        cols = jnp.arange(c, dtype=jnp.int32)
+        # cols always < C = MP*pg, so the table lookup is in range; the
+        # sentinel entries of unallocated pages drop the writes themselves
+        page = jnp.take(cache["ptab"][dst], cols // pg)
+        off = cols % pg
+        out = dict(cache)
+        if "scales" in cache:
+            out["pages"] = cache["pages"].at[:, page, off].set(
+                new_rows["q"], mode="drop")
+            out["scales"] = cache["scales"].at[:, page, off].set(
+                new_rows["s"], mode="drop")
+        else:
+            out["pages"] = cache["pages"].at[:, page, off].set(
+                new_rows.astype(cache["pages"].dtype), mode="drop")
+        return out
     if is_quant(cache):
         return {"q": cache["q"].at[:, dst].set(new_rows["q"]),
                 "s": cache["s"].at[:, dst].set(new_rows["s"])}
     return cache.at[:, dst].set(new_rows)
 
 
+def clone_page(cache: Cache, src_page, dst_page) -> Cache:
+    """Copy one physical page (all layers) — the copy-on-write primitive:
+    admission clones the FIRST DIVERGENT page of a shared prefix before
+    the new request's prefill writes into it."""
+    out = dict(cache)
+    out["pages"] = cache["pages"].at[:, dst_page].set(cache["pages"][:, src_page])
+    if "scales" in cache:
+        out["scales"] = cache["scales"].at[:, dst_page].set(
+            cache["scales"][:, src_page])
+    return out
+
+
 def slot_rows(cache: Cache, slot) -> Cache:
     """cache[:, slot] per leaf -> [L, C, KV, hd] (+ scales)."""
+    if is_paged(cache):
+        pg = cache["pages"].shape[-3]
+        idx = _row_index(cache["ptab"][slot], pg)               # [C]
+        flat = cache["pages"].reshape(
+            (cache["pages"].shape[0], -1) + cache["pages"].shape[-2:])
+        rows = jnp.take(flat, idx, axis=1, mode="fill", fill_value=0)
+        if "scales" in cache:
+            sflat = cache["scales"].reshape(
+                cache["scales"].shape[0], -1, cache["scales"].shape[-1])
+            return {"q": rows,
+                    "s": jnp.take(sflat, idx, axis=1, mode="fill",
+                                  fill_value=0)}
+        return rows
     if is_quant(cache):
         return {"q": cache["q"][:, slot], "s": cache["s"][:, slot]}
     return cache[:, slot]
@@ -173,11 +387,12 @@ def rows_to_float(rows: Cache, dtype) -> jax.Array:
 
 
 def rows_from_float(rows: jax.Array, like: Cache) -> Cache:
-    """Dense float [L, C, KV, hd] -> the cache's representation."""
+    """Dense float [L, C, KV, hd] -> the cache's ROW representation
+    (what tree_slot_update accepts as new_rows)."""
     if is_quant(like):
         q, s = quantize(rows)
         return {"q": q, "s": s}
-    return rows.astype(like.dtype)
+    return rows.astype(store_dtype(like))
 
 
 def cache_sharding(mesh, spec5):
@@ -190,11 +405,33 @@ def cache_sharding(mesh, spec5):
     return full, scales
 
 
+def paged_sharding(mesh, spec5):
+    """Paged layout under the same LOGICAL 5-dim spec: pages
+    [L, n_pages, page_size, KV, hd] keep the layer and kv-head entries
+    (kv heads on tp); the slot/context entries have no physical analogue
+    — any slot's rows may live in any page, so the page axis is
+    replicated. The page table is replicated (parallel/sharding.py
+    page_table_spec): it is tiny and every shard needs all of it."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pspec = (spec5[0], None, None, spec5[3], spec5[4])
+    return (NamedSharding(mesh, P(*pspec)),
+            NamedSharding(mesh, P(*pspec[:-1])),
+            NamedSharding(mesh, P(None, None)))
+
+
 def device_put(cache: Cache, mesh, spec5) -> Cache:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if is_paged(cache):
+        pages_sh, scales_sh, ptab_sh = paged_sharding(mesh, spec5)
+        out = {"pages": jax.device_put(cache["pages"], pages_sh),
+               "ptab": jax.device_put(cache["ptab"], ptab_sh)}
+        if "scales" in cache:
+            out["scales"] = jax.device_put(cache["scales"], scales_sh)
+        return out
     if is_quant(cache):
         full, scales = cache_sharding(mesh, spec5)
         return {"q": jax.device_put(cache["q"], full),
                 "s": jax.device_put(cache["s"], scales)}
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     return jax.device_put(cache, NamedSharding(mesh, P(*spec5)))

@@ -1,0 +1,156 @@
+"""Pallas TPU kernel: RAGGED PAGED decode (append-)attention.
+
+The paged KV layout (ops/kvcache.py) stores rows in a shared page pool
+[n_pages, page_size, KV, hd] with a per-slot page table [S, max_pages];
+a mixed-length batch is "ragged" — each slot touches only the pages its
+table names (Ragged Paged Attention, PAPERS.md arxiv 2604.15464). A
+naive XLA gather materializes a dense [S, C, KV, hd] copy of the pool
+every layer of every step; this kernel reads pages IN PLACE:
+
+  * Grid (S, max_pages): one program per (slot, page-table entry).
+  * The page table and lengths are SCALAR-PREFETCH arguments, consumed
+    by the K/V BlockSpec index maps — the grid pipeline therefore knows
+    page p+1's physical address while page p computes, and its automatic
+    double-buffering overlaps the next page's HBM read with the current
+    page's FLOPs (the prefetch-ahead-of-decode idea of PRESERVE,
+    arxiv 2501.08192, expressed through the Pallas pipeline).
+  * Table entries past a slot's last valid page are remapped to the last
+    valid page in the index map: consecutive grid steps then name the
+    SAME block, and the pipeline skips the redundant DMA entirely —
+    short slots cost ~their own length in HBM reads, not max_pages.
+  * Softmax is accumulated online across pages (m/l/acc VMEM scratch);
+    the current token's own k/v is appended from registers at the final
+    page, matching ops/attention.py::decode_attention_append — the jnp
+    fallback used on CPU (kvcache.gather_all_rows) and the parity
+    reference in tests.
+
+Plain float caches only (like decode_attention.py): the int8 paged
+cache folds scales through the jnp fallback path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(ptab_ref, len_ref, q_ref, nk_ref, nv_ref, kp_ref, vp_ref,
+            out_ref, m_ref, l_ref, acc_ref):
+    """One (slot, page) program: q [1, KV, G, hd]; k/v page [1, Pg, KV, hd];
+    online-softmax state in VMEM scratch, persistent across the page walk
+    (the output block index is invariant in the page dimension)."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    mp = pl.num_programs(1)
+    length = len_ref[s]
+    pg = kp_ref.shape[1]
+    kv_heads = kp_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    for h in range(kv_heads):
+        q = q_ref[0, h]                               # [G, hd]
+        k = kp_ref[0, :, h, :]                        # [Pg, hd]
+        v = vp_ref[0, :, h, :]
+        scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+        qf = q.astype(jnp.float32) * scale
+        scores = jax.lax.dot_general(                 # [G, Pg] NT matmul
+            qf, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + p * pg
+        scores = jnp.where(col < length, scores, _NEG_INF)
+
+        m_prev = m_ref[h]                             # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)               # [G, Pg]
+        l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+            probs, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[h] = m_new
+
+    @pl.when(p == mp - 1)
+    def _finish():
+        for h in range(kv_heads):
+            q = q_ref[0, h]
+            nk = nk_ref[0, h]                         # [1, hd]
+            nv = nv_ref[0, h]
+            scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+            qf = q.astype(jnp.float32) * scale
+            # current token's own key/value (register append; visible)
+            s_self = jnp.sum(qf * nk.astype(jnp.float32), axis=-1,
+                             keepdims=True)           # [G, 1]
+            m_fin = jnp.maximum(m_ref[h], s_self)
+            alpha = jnp.exp(m_ref[h] - m_fin)
+            p_self = jnp.exp(s_self - m_fin)
+            denom = l_ref[h] * alpha + p_self
+            out = (acc_ref[h] * alpha + p_self * nv.astype(jnp.float32))
+            out_ref[0, h] = (out / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_per_kv", "interpret"))
+def paged_decode_attention_append(q, new_k, new_v, pages_k, pages_v, ptab,
+                                  lengths, q_per_kv: int,
+                                  interpret: bool = False):
+    """q: [S, H, hd]; new_k/new_v: [S, KV, hd]; pages_k/v:
+    [n_pages, page_size, KV, hd] (single-layer page pool); ptab:
+    [S, max_pages] int32 (sentinel n_pages = unallocated); lengths: [S].
+    Returns [S, H, hd] (q.dtype). Semantics match
+    ops/attention.py::decode_attention_append over the slot's logical
+    rows [0, lengths[s]) plus the register-appended current token."""
+    S, H, hd = q.shape
+    n_pages, pg, kv_heads, _ = pages_k.shape
+    mp = ptab.shape[1]
+    G = q_per_kv
+    qg = q.reshape(S, kv_heads, G, hd)
+    nk = new_k.reshape(S, kv_heads, 1, hd)
+    nv = new_v.reshape(S, kv_heads, 1, hd)
+
+    def page_map(s, p, ptab_ref, len_ref):
+        # pages past the slot's last valid one revisit the last valid
+        # block (no DMA); fully-empty slots clamp to physical page 0 —
+        # their scores are all masked (col < 0 never holds)
+        n_valid = (len_ref[s] + pg - 1) // pg
+        last = jnp.maximum(n_valid - 1, 0)
+        pid = ptab_ref[s, jnp.minimum(p, last)]
+        return (jnp.clip(pid, 0, n_pages - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # ptab, lengths
+        grid=(S, mp),
+        in_specs=[
+            pl.BlockSpec((1, kv_heads, G, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv_heads, 1, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv_heads, 1, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, pg, kv_heads, hd), page_map),
+            pl.BlockSpec((1, pg, kv_heads, hd), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, kv_heads, G, hd),
+                               lambda s, p, pt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kv_heads, G, 1), jnp.float32),    # running max
+            pltpu.VMEM((kv_heads, G, 1), jnp.float32),    # running denom
+            pltpu.VMEM((kv_heads, G, hd), jnp.float32),   # running out
+        ],
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, kv_heads, G, hd), q.dtype),
+        interpret=interpret,
+    )(ptab, lengths, qg, nk, nv, pages_k, pages_v)
+    return out.reshape(S, H, hd)

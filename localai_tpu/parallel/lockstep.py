@@ -253,14 +253,47 @@ def follow(engine, bus: "FollowerBus") -> None:
             e._get_cache_export_fn(m["n2"])(e.ck, e.cv, np.int32(m["slot"]))
         elif op == "cache_restore":
             # every process reads the SAME cache file (shared filesystem)
-            # and replays the same restore body with identical inputs
-            kfull, vfull, ctoks = e._load_prompt_cache_rows(
-                m["path"], m["m"])
-            if ctoks is None or ctoks[:m["m"]] != m["tokens"]:
+            # and replays the same restore body with identical inputs.
+            # The leader has ALREADY issued its restore program, so the
+            # follower MUST issue the same program no matter what — a
+            # raise here kills follow() and deadlocks the mesh on the
+            # next collective over what is only an optimization.
+            import time as _time
+
+            kfull = vfull = ctoks = None
+            for attempt in range(3):
+                kfull, vfull, ctoks = e._load_prompt_cache_rows(
+                    m["path"], m["m"])
+                if kfull is not None:
+                    break
+                _time.sleep(0.05 * (attempt + 1))  # transient FS read
+            if ctoks is not None and ctoks[:m["m"]] != m["tokens"]:
+                # a DIFFERENT file version than the leader validated:
+                # not transient — a mis-deployed (non-shared) prompt
+                # cache dir. Still mesh-fatal by design, but loudly.
                 raise RuntimeError(
                     f"lockstep cache_restore: follower's view of "
                     f"{m['path']} diverges from the leader's — shared "
                     f"filesystem required for prompt-cache in multi-host")
+            if kfull is None:
+                # degrade to no-reuse for THIS request: replay the same
+                # restore program with zero rows. This process's shard
+                # of the reused prefix is zeros (degraded output for one
+                # request), but the program sequence stays identical and
+                # the mesh lives.
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "lockstep cache_restore: unreadable %s after retries; "
+                    "replaying with zero rows (degraded prefix reuse for "
+                    "one request)", m["path"])
+                import numpy as _np
+
+                from localai_tpu.ops import kvcache as _kv
+
+                L, _, C, KV, hd = _kv.shape(e.ck)
+                kfull = _np.zeros((L, C, KV, hd), _np.float16)
+                vfull = _np.zeros((L, C, KV, hd), _np.float16)
             e.ck, e.cv = e._get_restore_fn()(
                 e.ck, e.cv, kfull, vfull, m["slot"], m["m"])
         elif op == "reset":

@@ -74,3 +74,17 @@ def plan_for_devices(n: int, want_tp: Optional[int] = None) -> MeshPlan:
 
 def local_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
+
+
+def paged_cache_shardings(mesh: Mesh) -> tuple:
+    """(pages, scales, page_table) NamedShardings for the paged KV layout
+    (ops/kvcache.py): pages split kv heads on tp, page axis replicated;
+    the page table is replicated host-managed metadata. Convenience for
+    callers outside the engine (the engine derives the same via
+    kvcache.paged_sharding from its 5-dim contiguous spec)."""
+    from localai_tpu.parallel.sharding import (page_table_spec,
+                                               paged_cache_spec)
+
+    pages = NamedSharding(mesh, paged_cache_spec())
+    scales = NamedSharding(mesh, P(*paged_cache_spec()[:-1]))
+    return pages, scales, NamedSharding(mesh, page_table_spec())

@@ -82,6 +82,21 @@ def cache_spec() -> P:
     return P(None, "dp", None, "tp", None)
 
 
+def paged_cache_spec() -> P:
+    # paged pool [L, n_pages, page_size, KV, hd]: kv heads on tp — the
+    # page axis is REPLICATED (any slot's rows may land in any page, so
+    # there is no slot/dp analogue); HBM still shrinks tp-fold per chip
+    # through the head split, and the pool is sized to actual usage
+    # rather than worst-case-per-slot (ops/kvcache.py paged layout)
+    return P(None, None, None, "tp", None)
+
+
+def page_table_spec() -> P:
+    # [S, max_pages] int32: replicated — every shard resolves the same
+    # logical-row -> physical-page mapping, and the table is tiny
+    return P(None, None)
+
+
 def batch_spec() -> P:
     return P("dp")
 

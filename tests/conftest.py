@@ -10,11 +10,21 @@ must go through jax.config before any backend initialization.
 import os
 
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Older jax releases (< 0.4.x with jax_num_cpu_devices) spell the virtual
+# device count as an XLA flag; it is read at backend init, which has not
+# happened yet here.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-jax_num_cpu_devices release: XLA_FLAGS above covers it
 
 import pytest  # noqa: E402
 

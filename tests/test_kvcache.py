@@ -149,7 +149,9 @@ def test_kv_cache_dtype_wired_through_loadmodel(tmp_path):
     try:
         assert svc.engine.ecfg.cache_dtype == jnp.int8
         assert kvcache.is_quant(svc.engine.ck)
-        assert svc.engine.ck["q"].dtype == jnp.int8
+        rows = (svc.engine.ck["pages"] if kvcache.is_paged(svc.engine.ck)
+                else svc.engine.ck["q"])
+        assert rows.dtype == jnp.int8
         chunks = list(svc.PredictStream(pb.PredictOptions(
             prompt="hello world", max_tokens=5, temperature=0.0,
             ignore_eos=True), _Ctx()))

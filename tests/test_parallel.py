@@ -170,9 +170,10 @@ def test_engine_serving_on_mesh_matches_single_device(shard_cfg, mesh8,
 
     e_mesh = _greedy_engine(shard_cfg, sharded, mesh=mesh8)
     try:
-        # engine state must actually be committed to the mesh
-        assert e_mesh.ck.sharding.spec == shardlib.cache_spec()
-        assert set(e_mesh.ck.sharding.mesh.devices.flat) == set(
+        # engine state must actually be committed to the mesh (paged
+        # layout: the page pool carries the tp head split)
+        assert e_mesh.ck["pages"].sharding.spec == shardlib.paged_cache_spec()
+        assert set(e_mesh.ck["pages"].sharding.mesh.devices.flat) == set(
             mesh8.devices.flat)
         text_mesh, ev_mesh = e_mesh.generate_text(
             eng.GenRequest(prompt_ids=list(prompt), **req))
@@ -191,7 +192,10 @@ def test_engine_mesh_state_survives_reset(shard_cfg, mesh8, shard_params_pair):
     e = _greedy_engine(shard_cfg, sharded, mesh=mesh8)
     try:
         e._reset_device_state()
-        assert e.ck.sharding.spec == shardlib.cache_spec()
+        # default cache layout is PAGED: pages carry the tp head split,
+        # the page table is replicated (parallel/sharding.py)
+        assert e.ck["pages"].sharding.spec == shardlib.paged_cache_spec()
+        assert e.ck["ptab"].sharding.spec == shardlib.page_table_spec()
         assert e.bias.sharding.spec == P("dp", None)
         text, events = e.generate_text(eng.GenRequest(
             prompt_ids=ByteTokenizer().encode("after reset"),
@@ -214,8 +218,8 @@ def test_odd_sizes_fall_back_to_replication(mesh8):
         eng.EngineConfig(num_slots=4, max_context=32, prefill_buckets=(16,),
                          prefill_chunk=16, cache_dtype=jnp.float32),
         mesh=mesh8)
-    # slots still shard on dp (4 % 2 == 0); kv axis replicated (3 % 4 != 0)
-    assert e.ck.sharding.spec == P(None, "dp", None, None, None)
+    # kv axis replicated (3 % 4 != 0); paged pool has no slot/dp axis
+    assert e.ck["pages"].sharding.spec == P(None, None, None, None, None)
 
 
 def test_ring_attention_matches_single_device(mesh8):

@@ -195,7 +195,11 @@ def test_rollback_cache_matches_fresh_prefill_oracle():
     assert e._rollbacks > 0, "scenario no longer triggers a rollback"
     slot = next(i for i, t in enumerate(e._cache_tokens) if t)
     toks = list(e._cache_tokens[slot])
-    got = np.asarray(e.ck[:, slot, :len(toks)])
+    # layout-agnostic read: the default engine cache is PAGED now, so go
+    # through the representation instead of raw row indexing
+    from localai_tpu.ops import kvcache
+    got = np.asarray(kvcache.rows_to_float(
+        kvcache.slot_rows(e.ck, slot), jnp.float32))[:, :len(toks)]
     e.shutdown()
     want = _oracle_cache(cfg, params, toks, ecfg.max_context)
     np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
