@@ -84,9 +84,61 @@ async def version(request):
     return web.json_response({"version": __version__})
 
 
+_POOL_GAUGES = ("kv_pages_total", "kv_pages_free", "kv_pages_retained",
+                "kv_pages_active")
+_PCACHE_COUNTERS = ("hits", "misses", "evicted_pages", "inserted_pages",
+                    "hit_rows")
+
+
+def _refresh_engine_metrics(state):
+    """Pull each loaded LLM backend's engine stats (the JSON side-channel
+    on GetMetrics — see backend/runner.py) into the Prometheus registry:
+    kv pool occupancy gauges + prefix-cache counters, labeled by model.
+    Runs synchronously right before every /metrics render, Prometheus
+    pull style; backends without GetMetrics (tts, diffusion, ...) are
+    skipped."""
+    import json as _json
+
+    for g in ("kv_pool_pages", "kv_pool_oversubscription",
+              "prefix_cache_entries",
+              *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS)):
+        METRICS.clear_instrument(g)
+    for name in state.caps.loader.list_loaded():
+        lm = state.caps.loader.get(name)
+        if lm is None:
+            continue
+        try:
+            m = lm.client.get_metrics(timeout=2.0)
+            stats = _json.loads(m.prompt_json_for_slot or "{}")
+        except Exception:
+            continue
+        if stats.get("kv_layout") != "paged":
+            continue
+        for key in _POOL_GAUGES:
+            if key in stats:
+                state_name = key[len("kv_pages_"):]
+                METRICS.set_gauge(
+                    "kv_pool_pages",
+                    stats[key],
+                    f'model="{name}",state="{state_name}"')
+        if "kv_pool_oversubscription" in stats:
+            METRICS.set_gauge("kv_pool_oversubscription",
+                              stats["kv_pool_oversubscription"],
+                              f'model="{name}"')
+        pc = stats.get("prefix_cache")
+        if pc:
+            METRICS.set_gauge("prefix_cache_entries", pc.get("entries", 0),
+                              f'model="{name}"')
+            for key in _PCACHE_COUNTERS:
+                METRICS.set_counter(f"prefix_cache_{key}_total",
+                                    pc.get(key, 0), f'model="{name}"')
+
+
 async def metrics(request):
-    if get_state(request).config.disable_metrics_endpoint:
+    state = get_state(request)
+    if state.config.disable_metrics_endpoint:
         return api_error("metrics disabled", 404)
+    await state.run_blocking(_refresh_engine_metrics, state)
     return web.Response(text=METRICS.render(), content_type="text/plain")
 
 
@@ -291,6 +343,12 @@ async def token_metrics(request):
     if lm is None:
         return api_error(f"model {model} is not loaded", 404)
     m = await state.run_blocking(lm.client.get_metrics)
+    try:
+        import json as _json
+
+        engine_stats = _json.loads(m.prompt_json_for_slot or "{}")
+    except Exception:
+        engine_stats = {}
     return web.json_response({
         "model": model,
         "tokens_per_second": m.tokens_per_second,
@@ -299,6 +357,9 @@ async def token_metrics(request):
         "slots_total": m.slots_total,
         "queued": m.queued,
         "uptime_s": m.uptime_s,
+        # full engine stats dict (kv pool occupancy, prefix-cache
+        # hit/miss/evict, TTFT decomposition) — see Engine.metrics()
+        "engine": engine_stats,
     })
 
 

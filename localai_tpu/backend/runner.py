@@ -262,6 +262,15 @@ class EngineServicer(BackendServicer):
                 extra.get("kv_page_size", 0) or 0)) > 0 else {}),
             **({"kv_pool_pages": kpp} if (kpp := int(
                 extra.get("kv_pool_pages", 0) or 0)) > 0 else {}),
+            # cross-release prefix cache (PR 2): kv_prefix_cache=0 opts
+            # out (restores PR-1 lifecycle exactly);
+            # kv_prefix_cache_min_rows guards short accidental matches
+            **({"kv_prefix_cache": False} if str(
+                extra.get("kv_prefix_cache", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"kv_prefix_cache_min_rows": mr} if (mr := int(
+                extra.get("kv_prefix_cache_min_rows", 0) or 0)) > 0
+               else {}),
         )
         draft = None
         if request.draft_model:
@@ -477,6 +486,16 @@ class EngineServicer(BackendServicer):
         if not self.engine:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
         m = self.engine.metrics()
+        # the engine's FULL stats dict (kv pool occupancy, prefix-cache
+        # counters, TTFT decomposition, ...) rides the proto's free
+        # string field as JSON: the stubs are hand-rolled (no protoc in
+        # the image), so the wire cannot grow typed fields per release —
+        # the core's /metrics exporter and tokenMetrics endpoint parse
+        # this instead (api/localai_routes.py)
+        try:
+            stats_json = json.dumps(m)
+        except (TypeError, ValueError):
+            stats_json = ""
         return pb.MetricsResponse(
             tokens_per_second=m["tokens_per_second_active"],
             tokens_generated=m["total_tokens_generated"],
@@ -484,6 +503,7 @@ class EngineServicer(BackendServicer):
             slots_total=m["slots_total"],
             queued=m["queued"],
             uptime_s=m["uptime_s"],
+            prompt_json_for_slot=stats_json,
         )
 
     def _require_ready(self, context):

@@ -128,7 +128,12 @@ class ModelConfig:
     num_slots: int = 8                # reference: LLAMACPP_PARALLEL slots
     # free-form "k=v" strings forwarded on the backend options wire
     # (reference: BackendConfig.Options, backend_config.go) — e.g. the
-    # video knobs num_frames=14,fps=7,motion=1.0
+    # video knobs num_frames=14,fps=7,motion=1.0, or the paged-KV knobs
+    # kv_layout=paged|contiguous, kv_page_size=N, kv_pool_pages=N,
+    # kv_prefix_cache=0|1 (cross-release prefix cache, default on),
+    # kv_prefix_cache_min_rows=N (reuse threshold, default 16). The
+    # known kv_* knobs are value-validated in validate() so a typo
+    # fails at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
@@ -203,6 +208,23 @@ class ModelConfig:
                 problems.append(
                     f"group_attn_w ({self.group_attn_w}) must be divisible "
                     f"by group_attn_n ({self.group_attn_n})")
+        bool_vals = ("0", "1", "true", "false", "on", "off", "yes", "no")
+        for o in self.options or []:
+            s = str(o)
+            if "=" not in s:
+                continue
+            k, v = (p.strip() for p in s.split("=", 1))
+            if k == "kv_layout" and v not in ("auto", "paged", "contiguous"):
+                problems.append(
+                    f"kv_layout must be auto|paged|contiguous, got {v!r}")
+            elif k in ("kv_page_size", "kv_pool_pages",
+                       "kv_prefix_cache_min_rows") and not v.isdigit():
+                problems.append(
+                    f"{k} must be a non-negative integer "
+                    f"(0 = engine default), got {v!r}")
+            elif k == "kv_prefix_cache" and v.lower() not in bool_vals:
+                problems.append(
+                    f"kv_prefix_cache must be one of {bool_vals}, got {v!r}")
         return problems
 
     def usecases(self) -> Usecase:

@@ -72,6 +72,19 @@ class EngineConfig:
     # oversubscribe against actual usage; retained prefixes of free
     # slots are reclaimed under pressure.
     kv_pool_pages: int = 0
+    # cross-release prefix cache (engine/prefix_cache.py): on slot
+    # release/context-shift, committed full pages are RETAINED in a
+    # token-hash-keyed store instead of freed, and admission splices
+    # matching chains into the new slot's table (zero KV row copies,
+    # works after the source slot is long gone). Retained pages are
+    # evicted LRU-first under pool pressure, so the knob costs no
+    # correctness — only free-list headroom. Paged layout only; off
+    # restores PR-1 behavior exactly.
+    kv_prefix_cache: bool = True
+    # minimum reusable rows for a prefix-cache hit (and the live-slot
+    # share scan) to beat a clean prefill — a 1-page BOS match must
+    # never force the slow continued-prefill path
+    kv_prefix_cache_min_rows: int = 16
     # speculative decoding: draft proposals per round (0 disables even
     # when a draft model is loaded); greedy slots only
     n_draft: int = 4
@@ -319,6 +332,7 @@ class Engine:
             self.ecfg.kv_layout == "paged"
             or (self.ecfg.kv_layout == "auto" and bus is None))
         self._pool = None
+        self._pcache = None
         pg = 0
         if self._paged:
             from localai_tpu.engine.paging import PagePool
@@ -327,6 +341,15 @@ class Engine:
             while C % pg:     # page size must divide the context
                 pg -= 1
             self._pool = PagePool(S, C, pg, self.ecfg.kv_pool_pages)
+            if self.ecfg.kv_prefix_cache:
+                # cross-release page retention; NEVER built for the
+                # contiguous fallbacks (lockstep / self-extend / mamba /
+                # rwkv) — those layouts have no pages to retain
+                from localai_tpu.engine import prefix_cache
+
+                self._pcache = prefix_cache.PrefixPageCache(
+                    prefix_cache.build_scope(self._fam_name, model_cfg, pg,
+                                             self.ecfg.cache_dtype), pg)
         # device-resident state: big (KV cache), rarely-mutated (bias), or
         # not host-mirrorable (PRNG keys). Everything per-slot and small
         # lives as HOST numpy — admissions/releases are then free in-place
@@ -551,11 +574,28 @@ class Engine:
         self.cv = kvcache.with_page_table(self.cv, tabs[1])
         self._pool.dirty = False
 
+    def _reclaim_pages(self, slot: int, need_free: int):
+        """Two-tier reclaim under pool pressure, cheapest truth first:
+          1. free slots' retained TABLES are released (their
+             _cache_tokens cleared so _pick_slot stops advertising the
+             prefix) — with the prefix cache on, pages it holds survive
+             this with refs dropping to the cache's hold alone;
+          2. prefix-cache entries are EVICTED LRU-first until enough
+             pages are free (engine/prefix_cache.py).
+        Purely host-side and non-blocking — admission either gets its
+        pages or sees PoolExhausted from the retried alloc, never a
+        deadlock against work the scheduler still has to run."""
+        for i, s in enumerate(self.slots):
+            if self._pool.free_pages >= need_free:
+                return
+            if s is None and i != slot and self._pool.owned[i]:
+                self._pool.release(i, 0)
+                self._cache_tokens[i] = []
+        if self._pcache is not None:
+            self._pcache.evict(self._pool, need_free)
+
     def _ensure_pages(self, slot: int, rows: int):
-        """Lazy page allocation with reclaim: on pool pressure, retained
-        prefix pages of FREE slots are released (their _cache_tokens
-        cleared so _pick_slot stops advertising the prefix) and the
-        allocation retried."""
+        """Lazy page allocation with reclaim-and-retry on pool pressure."""
         if not self._paged:
             return
         from localai_tpu.engine.paging import PoolExhausted
@@ -565,13 +605,20 @@ class Engine:
             return
         except PoolExhausted:
             pass
-        for i, s in enumerate(self.slots):
-            if s is None and i != slot and self._pool.owned[i]:
-                self._pool.release(i, 0)
-                self._cache_tokens[i] = []
-                if self._pool.free_pages >= self._pool.pages_for(rows):
-                    break
+        self._reclaim_pages(slot, self._pool.pages_for(rows))
         self._pool.ensure(slot, rows)   # raises PoolExhausted if truly full
+
+    def _alloc_detached(self) -> int:
+        """alloc_detached with the same reclaim-and-retry discipline as
+        _ensure_pages: a COW boundary clone must not fail while retained
+        pages are still evictable."""
+        from localai_tpu.engine.paging import PoolExhausted
+
+        try:
+            return self._pool.alloc_detached()
+        except PoolExhausted:
+            self._reclaim_pages(-1, 1)
+            return self._pool.alloc_detached()
 
     def _get_page_clone_fn(self):
         fn = self._fork_fns.get("page_clone")
@@ -595,7 +642,7 @@ class Engine:
         if pi < 0:
             return
         old = int(self._pool.ptab[slot, pi])
-        new = self._pool.alloc_detached()
+        new = self._alloc_detached()
         self._commit_ptab()
         self.ck, self.cv = self._get_page_clone_fn()(
             self.ck, self.cv, np.int32(old), np.int32(new))
@@ -610,7 +657,7 @@ class Engine:
         if shared < rows:
             pi = shared // self._pool.page_size
             src_page = int(self._pool.ptab[src, pi])
-            new = self._pool.alloc_detached()
+            new = self._alloc_detached()
             self._commit_ptab()
             self.ck, self.cv = self._get_page_clone_fn()(
                 self.ck, self.cv, np.int32(src_page), np.int32(new))
@@ -620,7 +667,7 @@ class Engine:
 
     def _paged_admission(self, slot: int, ids: list, common: int) -> int:
         """Paged prefix reuse at admission. Returns the reusable row
-        count. Three tiers, best wins:
+        count. Four tiers, best (longest usable prefix) wins:
           1. the slot's OWN retained rows (common — free, pages already
              owned);
           2. another slot's prefix, shared COPY-ON-WRITE (_share_prefix):
@@ -628,15 +675,23 @@ class Engine:
              clone at the divergence boundary; only rows that are
              read-only for the source (committed prompt rows of an
              active slot / retained rows of a free one) are eligible;
-          3. neither — pages released for reuse by the pool.
-        Either way the first page this request will write is COW-guarded."""
+          3. the CROSS-RELEASE prefix cache (engine/prefix_cache.py):
+             the prompt's chained page hashes are matched against
+             retained pages and the chain is spliced into the slot's
+             table — zero copies, works after the source slot is gone;
+          4. none — pages released for reuse by the pool.
+        Tiers 2 and 3 share the min-rows guard (kv_prefix_cache_min_rows)
+        so a 1-page BOS match never forces the slow continued-prefill
+        path, and either way the first page this request will write is
+        COW-guarded."""
         pool = self._pool
+        min_rows = max(1, self.ecfg.kv_prefix_cache_min_rows)
+        cap = len(ids) - 1              # always leave >= 1 token to prefill
         best_src, best_rows = -1, 0
         if self.ecfg.ga_n <= 1:
             # cross-slot scan (self-extend rewrites cached keys in place,
             # so sharing is gated off under ga — rotation would corrupt
             # the other referents' view)
-            cap = len(ids) - 1          # always leave >= 1 token to prefill
             for j, sj in enumerate(self.slots):
                 if j == slot:
                     continue
@@ -651,7 +706,22 @@ class Engine:
                     n += 1
                 if n > best_rows:
                     best_src, best_rows = j, n
-        if best_rows > common and best_rows >= 16:
+        if self._pcache is not None and self.ecfg.ga_n <= 1:
+            cached_pages = self._pcache.match(ids, pool.max_pages)
+            cached_rows = min(len(cached_pages) * pool.page_size, cap)
+            if cached_rows >= min_rows and cached_rows > max(common,
+                                                            best_rows):
+                pool.release(slot, 0)
+                pool.splice(slot, cached_pages)
+                # a retained page re-entering a table carries refs >= 2
+                # (table + cache hold), so the existing COW guard clones
+                # the boundary page before the first prefill write —
+                # cached rows are immutable by construction
+                self._cow_guard(slot, cached_rows)
+                self._pcache.note_hit(cached_rows)
+                return cached_rows
+            self._pcache.note_miss()
+        if best_rows > common and best_rows >= min_rows:
             pool.release(slot, 0)
             return self._share_prefix(best_src, slot, best_rows)
         pool.release(slot, common)
@@ -1084,6 +1154,10 @@ class Engine:
             self._pool = PagePool(S, self.ecfg.max_context,
                                   self._pool.page_size,
                                   self.ecfg.kv_pool_pages)
+            if self._pcache is not None:
+                # the pool (and its holds) died with the device state;
+                # forget the index, keep the telemetry counters
+                self._pcache.clear()
         self.ck, self.cv = self.family.init_cache(
             self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype,
             **({"page_size": self._pool.page_size,
@@ -1164,6 +1238,16 @@ class Engine:
             out["kv_pages_total"] = self._pool.num_pages
             out["kv_pages_in_use"] = self._pool.pages_in_use
             out["kv_pages_shared"] = int((self._pool.refs > 1).sum())
+            # pool occupancy gauges (ROADMAP: "shrink default
+            # kv_pool_pages once oversubscription telemetry exists"):
+            # free + retained + active == total; retained is reclaimable
+            out["kv_pages_free"] = self._pool.free_pages
+            out["kv_pages_retained"] = self._pool.retained_pages
+            out["kv_pages_active"] = self._pool.active_pages
+            out["kv_pool_oversubscription"] = round(
+                self._pool.oversubscription, 4)
+            if self._pcache is not None:
+                out["prefix_cache"] = self._pcache.stats()
         else:
             out["kv_layout"] = "contiguous"
         with self._decomp_lock:
@@ -2827,9 +2911,14 @@ class Engine:
         keep = max(self.ecfg.max_context // 2, 1)
         new_ids = history[-keep:]
         if self._paged:
-            # the shift re-prefills from row 0: give the pages back first
-            # (referents of shared pages keep them alive) and re-allocate
-            # lazily per chunk — never rewrite a page another slot reads
+            # the shift re-prefills from row 0: retain the committed
+            # full pages in the prefix cache (a parallel conversation
+            # sharing this history can still splice them), then give the
+            # table back and re-allocate lazily per chunk — never
+            # rewrite a page another slot or the cache reads
+            if self._pcache is not None:
+                self._pcache.insert(self._pool, slot,
+                                    self._cache_tokens[slot][:s.committed])
             self._pool.release(slot, 0)
         s.phase = "prefill"
         s.pending = list(new_ids)
@@ -2884,8 +2973,15 @@ class Engine:
         if s is not None:
             self._cache_tokens[slot] = self._cache_tokens[slot][:s.committed]
         if self._paged:
-            # keep the retained prefix's pages (same reuse story as
-            # _cache_tokens); everything past it returns to the free list
+            # cross-release retention FIRST (while the slot's references
+            # still pin the pages): committed full pages enter the
+            # token-hash store and survive this slot's next tenant
+            if self._pcache is not None:
+                self._pcache.insert(self._pool, slot,
+                                    self._cache_tokens[slot])
+            # keep the retained prefix's pages in the table too (same
+            # reuse story as _cache_tokens — the slot's own next request
+            # reuses them for free); everything past returns to the pool
             self._pool.release(slot, len(self._cache_tokens[slot]))
         self.slots[slot] = None
         self.active_dev[slot] = False

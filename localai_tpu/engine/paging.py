@@ -28,9 +28,23 @@ exactly the contiguous reservation, so the default config never uses
 more HBM than before; sharing + lazy allocation make it use less.
 Shrinking num_pages oversubscribes HBM against actual (not worst-case)
 usage; the engine reclaims retained pages of free slots on pressure.
+
+Page lifecycle (PR 2, cross-release prefix cache): every page moves
+through  free -> active -> retained -> (reused | evicted | free).
+"Active" means some slot's table references it; "retained" means its
+ONLY references are holds placed by the engine's PrefixPageCache
+(engine/prefix_cache.py) — the page's KV rows outlive the slot that
+wrote them and can be spliced into a later request's table with zero
+copies. hold()/drop() are the retention refcount half; the cache owns
+the hash index and the LRU order, the pool owns the truth about which
+pages are reclaimable. The free list is FIFO (oldest-freed page is
+reallocated first), so a just-evicted page's rows survive as long as
+the pool allows — cheap insurance for racing re-admissions.
 """
 
 from __future__ import annotations
+
+from collections import deque
 
 import numpy as np
 
@@ -55,8 +69,11 @@ class PagePool:
         self.ptab = np.full((num_slots, self.max_pages), self.num_pages,
                             np.int32)
         self.refs = np.zeros((self.num_pages,), np.int32)
+        # references held by the prefix cache (subset of refs): a page
+        # with refs == held > 0 is RETAINED — alive only for reuse
+        self.held = np.zeros((self.num_pages,), np.int32)
         self.owned = np.zeros((num_slots,), np.int32)  # table entries in use
-        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._free = deque(range(self.num_pages))
         self.dirty = True      # device table snapshot is stale
 
     # ---------- accounting ----------
@@ -72,6 +89,25 @@ class PagePool:
     def pages_in_use(self) -> int:
         return self.num_pages - len(self._free)
 
+    @property
+    def retained_pages(self) -> int:
+        """Pages alive ONLY through prefix-cache holds (reclaimable by
+        LRU eviction without touching any slot)."""
+        return int(((self.refs > 0) & (self.refs == self.held)).sum())
+
+    @property
+    def active_pages(self) -> int:
+        """Pages some slot table (or an in-flight detached clone) still
+        references — NOT reclaimable."""
+        return int(((self.refs > 0) & (self.refs > self.held)).sum())
+
+    @property
+    def oversubscription(self) -> float:
+        """Worst-case logical demand over physical pages: > 1.0 means
+        kv_pool_pages was shrunk below num_slots * max_context rows and
+        admission relies on reclaim/eviction under full load."""
+        return self.num_slots * self.max_pages / float(self.num_pages)
+
     def slot_rows_capacity(self, slot: int) -> int:
         return int(self.owned[slot]) * self.page_size
 
@@ -86,7 +122,7 @@ class PagePool:
             raise PoolExhausted(
                 f"page pool exhausted ({self.num_pages} pages of "
                 f"{self.page_size} rows)")
-        p = self._free.pop()
+        p = self._free.popleft()
         self.refs[p] = 1
         return p
 
@@ -133,6 +169,38 @@ class PagePool:
         assert self.owned[dst] == 0, "share() into a non-empty slot"
         for i in range(n):
             p = int(self.ptab[src, i])
+            self.ptab[dst, i] = p
+            self.refs[p] += 1
+        self.owned[dst] = n
+        if n:
+            self.dirty = True
+        return n * self.page_size
+
+    def hold(self, page: int):
+        """Prefix-cache retention reference: keeps the page (and its KV
+        rows) alive after every slot table lets go. Must only be placed
+        on a page that is currently referenced (refs > 0) — a free page
+        has no content worth retaining."""
+        assert self.refs[page] > 0, "hold() on an unreferenced page"
+        self.refs[page] += 1
+        self.held[page] += 1
+
+    def drop(self, page: int):
+        """Release a hold() reference (cache eviction / entry dedup)."""
+        assert self.held[page] > 0, "drop() without a matching hold()"
+        self.held[page] -= 1
+        self.unref_detached(page)
+
+    def splice(self, dst: int, pages) -> int:
+        """Point dst's leading table entries at an explicit page list
+        (the prefix cache's chain match) and bump refcounts — share()'s
+        sibling for pages whose owning slot no longer exists. dst must
+        own no pages. Returns the rows spliced (a page multiple)."""
+        assert self.owned[dst] == 0, "splice() into a non-empty slot"
+        n = min(len(pages), self.max_pages)
+        for i in range(n):
+            p = int(pages[i])
+            assert self.refs[p] > 0, "splice() of a freed page"
             self.ptab[dst, i] = p
             self.refs[p] += 1
         self.owned[dst] = n

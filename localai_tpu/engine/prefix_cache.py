@@ -1,0 +1,217 @@
+"""Cross-release prefix cache: a token-hash-keyed store of retained pages.
+
+PR 1's paged pool only reuses a prompt prefix while it is resident in
+some live slot's page table — once a slot is reclaimed for an unrelated
+prompt its pages go back to the free list and the next turn of the same
+conversation pays a full prefill. This module is the fix the ROADMAP
+names as the top paged-KV follow-up: page-level prefix reuse ACROSS
+releases, the paged analogue of KV retention/prefetch schemes like
+PRESERVE (arXiv:2501.08192) and DejaVu (arXiv:2403.01876), applied at
+page granularity on the existing copy-on-write pool.
+
+Design:
+  * Identity is a CHAINED BLOCK HASH over token ids, never page content:
+    key_i = hash(scope, key_{i-1}, tokens[i*pg:(i+1)*pg])  (kvcache.
+    page_chain_hash). The scope folds model geometry + page size into
+    every link, so different tokenizations or layouts can never alias;
+    the parent chain makes "same page tokens, different history" two
+    distinct keys — a hash-chain divergence at page j hides every page
+    past j, which is exactly the false-reuse guard the paged layout
+    needs (a page's rows encode its absolute position via RoPE).
+  * On slot release / context shift the engine calls insert(): each
+    committed FULL page gets a pool.hold() reference and a store entry.
+    The page is then RETAINED — alive after every slot table lets go.
+  * At admission the engine calls match(): the chain is walked from the
+    root; contiguous present links yield the physical pages to splice
+    into the new slot's table (PagePool.splice — ref-counted, zero KV
+    row copies). The boundary write is protected by the engine's
+    existing COW guard: a retained page always has refs >= 2 once it is
+    in a table again, so the first divergent write clones it.
+  * Under pool pressure the engine calls evict(): entries die LRU-first
+    (ties: deepest chain link first — children are never more recent
+    than their parents, since every touch walks root-down), each drop()
+    returning its page to the free list once nothing else references
+    it. Eviction never blocks and never touches live slots, so the
+    reclaim path stays deadlock-free under oversubscription.
+
+Entries are one page each, so the store is bounded by the pool size;
+there is no separate capacity knob — pool pressure IS the bound.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from localai_tpu.ops import kvcache
+
+
+class _Entry:
+    __slots__ = ("key", "parent", "page", "depth", "tick")
+
+    def __init__(self, key: bytes, parent: bytes, page: int, depth: int,
+                 tick: int):
+        self.key = key
+        self.parent = parent
+        self.page = page
+        self.depth = depth      # chain position (0 = first page)
+        self.tick = tick        # LRU clock at last touch
+
+
+class PrefixPageCache:
+    """Host-side index of retained pages; the PagePool owns the pages."""
+
+    def __init__(self, scope: bytes, page_size: int):
+        self.scope = scope
+        self.page_size = page_size
+        self._entries: dict[bytes, _Entry] = {}
+        self._children: dict[bytes, set] = {}
+        self._tick = 0
+        # telemetry (absolute, monotonic — exported as counters)
+        self.hits = 0            # admissions served from the store
+        self.misses = 0          # store consulted, no usable chain
+        self.hit_rows = 0        # prompt rows reused via the store
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    # ---------- introspection ----------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def pages_held(self) -> int:
+        return len(self._entries)   # one page per entry, deduped by key
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rows": self.hit_rows,
+            "inserted_pages": self.inserted_pages,
+            "evicted_pages": self.evicted_pages,
+        }
+
+    # ---------- the hash chain ----------
+
+    def chain_keys(self, ids) -> Iterable[bytes]:
+        """Chain keys for every FULL page of ids, root-down."""
+        pg = self.page_size
+        parent = kvcache.PAGE_HASH_ROOT
+        for i in range(len(ids) // pg):
+            parent = kvcache.page_chain_hash(
+                parent, ids[i * pg:(i + 1) * pg], self.scope)
+            yield parent
+
+    # ---------- store operations ----------
+
+    def insert(self, pool, slot: int, toks) -> int:
+        """Index the slot's committed full pages under their chain keys
+        (called BEFORE the pool release drops the slot's references).
+        Existing keys are touched, not replaced — two slots that
+        independently prefilled the same prefix dedup to one retained
+        copy; the newcomer's pages simply free with its table. Returns
+        the number of newly retained pages."""
+        self._tick += 1
+        added = 0
+        n_full = min(len(toks) // self.page_size, int(pool.owned[slot]))
+        parent = kvcache.PAGE_HASH_ROOT
+        for i, key in enumerate(self.chain_keys(toks)):
+            if i >= n_full:
+                break
+            e = self._entries.get(key)
+            if e is not None:
+                e.tick = self._tick
+                parent = key
+                continue
+            page = int(pool.ptab[slot, i])
+            if page >= pool.num_pages or pool.refs[page] <= 0:
+                break   # unallocated tail; nothing past it is committed
+            pool.hold(page)
+            self._entries[key] = _Entry(key, parent, page, i, self._tick)
+            self._children.setdefault(parent, set()).add(key)
+            added += 1
+            parent = key
+        self.inserted_pages += added
+        return added
+
+    def match(self, ids, max_pages: int) -> list:
+        """Longest contiguous chain match over ids' full pages. Returns
+        the physical pages root-down (possibly empty); every matched
+        entry (and thus its whole ancestor path) is LRU-touched."""
+        self._tick += 1
+        pages: list = []
+        for key in self.chain_keys(ids):
+            if len(pages) >= max_pages:
+                break
+            e = self._entries.get(key)
+            if e is None:
+                break
+            e.tick = self._tick
+            pages.append(e.page)
+        return pages
+
+    def evict(self, pool, need_free: int) -> int:
+        """Drop entries LRU-first until the pool has need_free free
+        pages or the store is empty. Ties evict the deepest chain link
+        first, and removal cascades to descendants (an orphaned child is
+        unreachable — match() walks root-down). Returns pages dropped."""
+        if not self._entries or pool.free_pages >= need_free:
+            return 0
+        victims = sorted(self._entries.values(),
+                         key=lambda e: (e.tick, -e.depth))
+        dropped = 0
+        for e in victims:
+            if pool.free_pages >= need_free:
+                break
+            if e.key not in self._entries:
+                continue    # already cascaded away
+            dropped += self._remove_tree(pool, e.key)
+        self.evicted_pages += dropped
+        return dropped
+
+    def _remove_tree(self, pool, key: bytes) -> int:
+        n = 0
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            e = self._entries.pop(k, None)
+            if e is None:
+                continue
+            stack.extend(self._children.pop(k, ()))
+            kids = self._children.get(e.parent)
+            if kids is not None:
+                kids.discard(k)
+                if not kids:
+                    del self._children[e.parent]
+            pool.drop(e.page)
+            n += 1
+        return n
+
+    def clear(self):
+        """Forget everything WITHOUT touching a pool — for device-state
+        resets, where the pool object itself is rebuilt and the old
+        holds die with it. Counters survive (telemetry continuity)."""
+        self._entries.clear()
+        self._children.clear()
+
+    # ---------- engine-side accounting helpers ----------
+
+    def note_hit(self, rows: int):
+        self.hits += 1
+        self.hit_rows += int(rows)
+
+    def note_miss(self):
+        self.misses += 1
+
+
+def build_scope(family: str, cfg, page_size: int, cache_dtype) -> bytes:
+    """The engine's scope recipe: family + attention geometry + context
+    + cache dtype + page size. Everything that changes what a page's KV
+    rows MEAN must be in here."""
+    return kvcache.page_scope(
+        page_size, family,
+        getattr(cfg, "num_layers", 0), getattr(cfg, "num_kv_heads", 0),
+        getattr(cfg, "head_dim_", getattr(cfg, "head_dim", 0)),
+        getattr(cfg, "vocab_size", 0),
+        getattr(cfg, "rope_theta", 0), str(cache_dtype))

@@ -54,14 +54,51 @@ byte/element. See ops/attention.py for the score-side folding.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Cache = Union[jax.Array, dict]
 
 _EPS = 1e-8
+
+# ---------- page identity hashing (cross-release prefix cache) ----------
+#
+# The engine's PrefixPageCache (engine/prefix_cache.py) indexes committed
+# FULL pages by a chained block hash so a released slot's prompt-prefix
+# pages stay findable after the slot is gone. The hash lives here, next
+# to the layout it names, because it IS part of the page representation
+# contract: a page's identity is (scope, parent chain, its token ids) —
+# never its float content, which is not bit-stable across dtypes/meshes.
+
+PAGE_HASH_BYTES = 16
+PAGE_HASH_ROOT = b"\x00" * PAGE_HASH_BYTES
+
+
+def page_scope(page_size: int, *parts) -> bytes:
+    """Scope token for a page-hash chain: page size + any model-identity
+    parts (family, layer/head geometry, cache dtype, tokenizer id...).
+    Two engines whose scopes differ can NEVER alias each other's chains —
+    the scope is folded into every link, so a different tokenization or
+    page layout diverges at the first hash."""
+    text = "|".join([f"pg={int(page_size)}"] + [str(p) for p in parts])
+    return hashlib.blake2b(text.encode("utf-8"),
+                           digest_size=PAGE_HASH_BYTES).digest()
+
+
+def page_chain_hash(parent: bytes, token_ids, scope: bytes) -> bytes:
+    """hash(scope, parent, page_token_ids) — one link of the chained
+    block hash. parent is PAGE_HASH_ROOT for the first page. Token ids
+    are hashed as int64 so the digest is independent of the caller's
+    container (list / np array) and of numpy's default int width."""
+    h = hashlib.blake2b(digest_size=PAGE_HASH_BYTES)
+    h.update(scope)
+    h.update(parent)
+    h.update(np.asarray(token_ids, np.int64).tobytes())
+    return h.digest()
 
 
 def wants_quant(dtype) -> bool:

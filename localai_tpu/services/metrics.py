@@ -1,8 +1,15 @@
-"""Metrics: api_call duration histogram, Prometheus text exposition.
+"""Metrics: api_call duration histogram, pull-updated engine gauges
+(kv pool occupancy, prefix-cache counters), Prometheus text exposition.
 
 Parity with the reference (reference: core/services/metrics.go:18-45 — an
 OTel meter exporting one `api_call` histogram tagged method/path, served at
 GET /metrics). Hand-rolled exposition keeps the dependency surface zero.
+
+Engine-side series (localai_kv_pool_pages_{total,free,retained,active},
+localai_kv_pool_oversubscription, localai_prefix_cache_*_total) live in
+the backend subprocess; the /metrics handler (api/localai_routes.py)
+refreshes them via each loaded model's GetMetrics RPC right before
+rendering, labeled model="<name>".
 """
 
 from __future__ import annotations
@@ -20,6 +27,14 @@ class Metrics:
         # (method, path) -> [bucket counts..., +inf], sum, count
         self._hist = defaultdict(lambda: [[0] * (len(_BUCKETS) + 1), 0.0, 0])
         self._counters = defaultdict(int)
+        # pull-updated instruments (engine pool telemetry): the /metrics
+        # handler refreshes these from each loaded backend's GetMetrics
+        # before rendering. Gauges are point-in-time; "absolute counters"
+        # are monotonic totals owned by the backend (the engine counts,
+        # this process just re-exposes — so a backend restart resets
+        # them, which Prometheus rate() handles as a counter reset).
+        self._gauges: dict = {}
+        self._abs_counters: dict = {}
 
     def observe_api_call(self, method: str, path: str, seconds: float):
         with self._lock:
@@ -36,6 +51,23 @@ class Metrics:
     def inc(self, name: str, labels: str = ""):
         with self._lock:
             self._counters[(name, labels)] += 1
+
+    def set_gauge(self, name: str, value, labels: str = ""):
+        with self._lock:
+            self._gauges[(name, labels)] = float(value)
+
+    def set_counter(self, name: str, value, labels: str = ""):
+        """Expose a backend-owned monotonic total at its current value."""
+        with self._lock:
+            self._abs_counters[(name, labels)] = int(value)
+
+    def clear_instrument(self, name: str):
+        """Drop every series of a pull-updated instrument (a model was
+        unloaded; stale per-model series must not linger)."""
+        with self._lock:
+            for d in (self._gauges, self._abs_counters):
+                for k in [k for k in d if k[0] == name]:
+                    del d[k]
 
     def render(self) -> str:
         lines = [
@@ -55,6 +87,19 @@ class Metrics:
                 lines.append(f'localai_api_call_sum{{{labels}}} {total:.6f}')
                 lines.append(f'localai_api_call_count{{{labels}}} {count}')
             for (name, labels), v in sorted(self._counters.items()):
+                label_part = f"{{{labels}}}" if labels else ""
+                lines.append(f"localai_{name}{label_part} {v}")
+            seen = set()
+            for (name, labels), v in sorted(self._gauges.items()):
+                if name not in seen:
+                    seen.add(name)
+                    lines.append(f"# TYPE localai_{name} gauge")
+                label_part = f"{{{labels}}}" if labels else ""
+                lines.append(f"localai_{name}{label_part} {v:g}")
+            for (name, labels), v in sorted(self._abs_counters.items()):
+                if name not in seen:
+                    seen.add(name)
+                    lines.append(f"# TYPE localai_{name} counter")
                 label_part = f"{{{labels}}}" if labels else ""
                 lines.append(f"localai_{name}{label_part} {v}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Canonical tier-1 verify runner — the ONE invocation builders and CI
+# share, verbatim from ROADMAP.md ("Tier-1 verify"). Prints the pytest
+# stream, then a DOTS_PASSED=<n> line (passing-test count parsed from
+# the progress dots), and exits with pytest's own return code (124 when
+# the 870 s budget killed the run — partial DOTS_PASSED still printed).
+#
+# Usage: scripts/run_tier1.sh   (from the repo root or anywhere)
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG=${TIER1_LOG:-/tmp/_t1.log}
+BUDGET=${TIER1_BUDGET_S:-870}
+
+rm -f "$LOG"
+timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit $rc

@@ -32,6 +32,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "e2e: full-stack tests spawning real backend subprocesses")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+                   "verify budget (ROADMAP runs -m 'not slow')")
 
 
 @pytest.fixture(scope="session")

@@ -291,14 +291,17 @@ def test_shared_prefix_zero_copy_refcounts(tiny_cfg_params):
             evs_b.append(ev)
         # B reused A's prefix via page sharing
         assert evs_b[-1].timings["reused_prompt_tokens"] >= 2 * pgs
-        # zero row copies: both shared pages show refcount 2, and neither
-        # the fork body nor the COW clone ever compiled/ran
+        # zero row copies: both shared pages are ref-count shared (A's
+        # table + B's, plus — since PR 2 — a prefix-cache retention hold
+        # once B released), and neither the fork body nor the COW clone
+        # ever compiled/ran
         pool = e._pool
         slot_b = next(i for i, t in enumerate(e._cache_tokens)
                       if t[:len(sys_prefix)] == sys_prefix
                       and t[len(sys_prefix):len(sys_prefix) + 2] == [123, 124])
-        assert pool.page_refs(slot_b, 0) == 2
-        assert pool.page_refs(slot_b, 1) == 2
+        assert pool.page_refs(slot_b, 0) >= 2
+        assert pool.page_refs(slot_b, 1) >= 2
+        assert e._pcache is not None and e._pcache.pages_held >= 2
         assert "page_clone" not in e._fork_fns
         assert "main" not in e._fork_fns
         m = e.metrics()
