@@ -520,15 +520,28 @@ def _apply_platform_env():
     plat = os.environ.get("LOCALAI_JAX_PLATFORM")
     ndev = os.environ.get("LOCALAI_JAX_CPU_DEVICES")
     if plat or ndev:
+        if ndev and not ndev.isdigit():
+            raise SystemExit(
+                f"LOCALAI_JAX_CPU_DEVICES must be an integer, got {ndev!r}")
+        if ndev:
+            # pre-jax_num_cpu_devices releases read the count from
+            # XLA_FLAGS at backend init — set it before jax imports
+            import re
+
+            os.environ["XLA_FLAGS"] = (re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+                + f" --xla_force_host_platform_device_count={ndev}").strip()
+
         import jax
 
         if plat:
             jax.config.update("jax_platforms", plat)
         if ndev:
-            if not ndev.isdigit():
-                raise SystemExit(
-                    f"LOCALAI_JAX_CPU_DEVICES must be an integer, got {ndev!r}")
-            jax.config.update("jax_num_cpu_devices", int(ndev))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(ndev))
+            except AttributeError:
+                pass  # covered by XLA_FLAGS above
 
 
 def main(argv=None):

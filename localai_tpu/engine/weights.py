@@ -96,7 +96,10 @@ def _make_put(cfg, mesh, dtype, quantize, adapter=None):
                 # weight (e.g. llama-2's 11008 FFN: 86 groups vs tp=8)
                 divisor = 1
                 if mesh is not None:
-                    axis = leaf_spec(spec_path)[-2]
+                    from localai_tpu.parallel.sharding import fit_spec
+
+                    axis = fit_spec(mesh, arr.shape,
+                                    leaf_spec(spec_path))[-2]
                     if axis is not None:
                         divisor = mesh.shape[axis]
                 leaf = quantize_weight_int4(arr, shard_divisor=divisor)
@@ -107,8 +110,11 @@ def _make_put(cfg, mesh, dtype, quantize, adapter=None):
         if mesh is not None:
             from jax.sharding import NamedSharding
             from localai_tpu.ops.quant import scale_spec
+            from localai_tpu.parallel.sharding import fit_spec
 
-            node = leaf_spec(spec_path)
+            node = fit_spec(
+                mesh, (leaf["q"] if isinstance(leaf, dict) else leaf).shape,
+                leaf_spec(spec_path))
             if isinstance(leaf, dict):
                 q = jax.device_put(leaf["q"], NamedSharding(mesh, node))
                 s = jax.device_put(leaf["s"], NamedSharding(

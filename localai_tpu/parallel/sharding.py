@@ -101,6 +101,25 @@ def batch_spec() -> P:
     return P("dp")
 
 
+def fit_spec(mesh: Mesh, shape, spec: P) -> P:
+    """Drop (replicate) any spec axis whose dimension the mesh degree
+    does not divide — e.g. a 258-row test vocab on tp=8. Every case the
+    fallback fires would otherwise be a device_put error, so this only
+    ever turns a crash into replication, never changes a working
+    placement."""
+    fitted = []
+    for i, ax in enumerate(spec):
+        if ax is not None and i < len(shape):
+            names = ax if isinstance(ax, tuple) else (ax,)
+            deg = 1
+            for n in names:
+                deg *= mesh.shape[n]
+            if shape[i] % deg:
+                ax = None
+        fitted.append(ax)
+    return P(*fitted)
+
+
 def to_named(mesh: Mesh, tree):
     return jax.tree.map(
         lambda s: NamedSharding(mesh, s), tree,

@@ -11,14 +11,37 @@ import sys
 
 import pytest
 
+# XLA's CPU backend grew cross-process collectives only after the jaxlib
+# releases this repo supports as a floor; on those, the handshake succeeds
+# but the first multi-host computation dies with this exact message. That
+# is a missing platform capability, not a product bug — skip, don't fail.
+_NO_MP_CPU = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+def _skip_if_no_multiprocess_cpu(outs):
+    if any(_NO_MP_CPU in o for o in outs):
+        pytest.skip(f"jaxlib: {_NO_MP_CPU}")
+
+
 _WORKER = r"""
-import os, sys
+import os, re, sys
 import numpy as np
+
+# 2 local x 2 procs = 4 global. Pre-jax_num_cpu_devices releases spell the
+# count as an XLA flag read at backend init, so scrub the 8-device flag the
+# parent conftest exported and set ours BEFORE jax initializes.
+os.environ["XLA_FLAGS"] = (re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""))
+    + " --xla_force_host_platform_device_count=2").strip()
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)   # 2 local x 2 procs = 4 global
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # covered by XLA_FLAGS above
 
 coordinator, pid = sys.argv[1], int(sys.argv[2])
 jax.distributed.initialize(coordinator_address=coordinator,
@@ -48,15 +71,24 @@ print(f"OK pid={pid} total={got}", flush=True)
 
 
 _COMMON = r"""
-import os, sys
+import os, re, sys
 import numpy as np
 
 coordinator, bus_addr, ckpt, http_port, pid = (
     sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4], int(sys.argv[5]))
 
+# 1 local device per process; see _WORKER for why XLA_FLAGS is scrubbed.
+os.environ["XLA_FLAGS"] = (re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""))
+    + " --xla_force_host_platform_device_count=1").strip()
+
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass  # covered by XLA_FLAGS above
 jax.distributed.initialize(coordinator_address=coordinator,
                            num_processes=2, process_id=pid)
 assert len(jax.devices()) == 2
@@ -280,6 +312,7 @@ def test_lockstep_engine_http_two_process(tmp_path):
                 outs.append("<no output>")
         raise AssertionError("lockstep test timed out:\n"
                              + "\n====\n".join(o[-3000:] for o in outs))
+    _skip_if_no_multiprocess_cpu(outs)
     for name, p, out in zip(("leader", "follower"), procs, outs):
         assert p.returncode == 0, f"{name} failed:\n{out[-3000:]}"
         assert f"OK {name}" in out, out[-3000:]
@@ -313,6 +346,7 @@ def test_two_process_distributed_mesh(tmp_path):
                 q.kill()
             raise
         outs.append(out)
+    _skip_if_no_multiprocess_cpu(outs)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"pid {pid} failed:\n{out[-2000:]}"
         assert f"OK pid={pid}" in out, out[-2000:]
