@@ -496,7 +496,8 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     return out
 
 
-def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new):
+def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
+                    pressure=False):
     """Multi-turn shared-prefix scenario (PR 2 acceptance): N greedy
     conversations of K turns each, submitted round-robin through S << N
     slots so every conversation's slot is overwritten between its own
@@ -505,7 +506,20 @@ def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new):
     thing standing between turn 2 and a full re-prefill. Runs the same
     token schedule with the cache on and off and reports per-phase TTFT,
     the store hit-rate, and whether greedy outputs stayed byte-identical
-    (they must: reused pages hold the same rows a cold prefill writes)."""
+    (they must: reused pages hold the same rows a cold prefill writes).
+
+    ``pressure=True`` is the PR 3 acceptance variant: the DEVICE pool is
+    sized to ~half the conversations' working set so retained chains get
+    evicted between turns, and the on/off axis becomes kv_offload (the
+    host-RAM tier) instead of the prefix cache — off, every warm turn
+    behind an eviction re-prefills; on, it restores from host RAM. The
+    pressure comparison runs the cache in float32: the byte-identical
+    check compares restore-then-continue against full re-prefill, whose
+    forwards run at different bucket shapes — under bf16 the shape-
+    dependent rounding (~2^-8 relative) is the same magnitude as a
+    512-vocab random model's top-logit gaps, so greedy flips on numeric
+    noise unrelated to the mechanism under test; f32 puts the noise
+    floor ~2^-23 where the comparison is deterministic."""
     import jax.numpy as jnp
 
     from localai_tpu.engine import engine as eng
@@ -515,18 +529,29 @@ def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new):
     params = random_params(
         cfg, quantize=os.environ.get("LOCALAI_BENCH_QUANT", ""))
     pgs = 16
-    out = {}
+    final_rows = sys_len + n_turns * (user_len + max_new)
+    working_pages = n_conv * (-(-final_rows // pgs))
+    # pressured pool: ~half the working set, floored at live demand (S
+    # slots of the final history + COW/boundary headroom) so admission
+    # always succeeds and the squeeze lands on RETAINED chains only
+    pressured = max(S * (-(-final_rows // pgs)) + 2, working_pages // 2)
+    out = {"pressure": bool(pressure),
+           **({"kv_pool_pages": pressured,
+               "working_set_pages": working_pages} if pressure else {})}
     gen_by_mode = {}
     for mode in ("on", "off"):
         ecfg = eng.EngineConfig(
             num_slots=S, max_context=C, prefill_buckets=(32, 128, 512),
-            prefill_chunk=min(512, C), cache_dtype=jnp.bfloat16,
+            prefill_chunk=min(512, C),
+            cache_dtype=jnp.float32 if pressure else jnp.bfloat16,
             kv_layout="paged", kv_page_size=pgs,
-            # headroom ABOVE the contiguous reservation so retention is
-            # bounded by the scenario, not by eviction: the win being
-            # measured is reuse, not replacement policy
-            kv_pool_pages=(n_conv + S) * (C // pgs),
-            kv_prefix_cache=(mode == "on"))
+            # default scenario: headroom ABOVE the contiguous reservation
+            # so retention is bounded by the scenario, not by eviction —
+            # the win measured is reuse, not replacement policy
+            kv_pool_pages=(pressured if pressure
+                           else (n_conv + S) * (C // pgs)),
+            kv_prefix_cache=(True if pressure else mode == "on"),
+            kv_offload=(mode == "on") if pressure else False)
         engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                             eos_token_ids={cfg.vocab_size - 1})
         engine.start(precompile=False)
@@ -580,10 +605,16 @@ def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new):
             r["hit_rate"] = round(pc["hits"] / consulted, 3) if consulted else 0.0
             r["reused_rows"] = pc["hit_rows"]
             r["evicted_pages"] = pc["evicted_pages"]
-        out[f"cache_{mode}"] = r
+        off = m.get("kv_offload")
+        if off:
+            r["offloaded_pages"] = off["offloaded_pages"]
+            r["restored_pages"] = off["restored_pages"]
+            r["restores"] = off["restores"]
+        out[("offload_" if pressure else "cache_") + mode] = r
+    tag = "offload_" if pressure else "cache_"
     out["greedy_match"] = gen_by_mode["on"] == gen_by_mode["off"]
-    warm_on = out["cache_on"]["p50_ttft_warm_ms"]
-    warm_off = out["cache_off"]["p50_ttft_warm_ms"]
+    warm_on = out[tag + "on"]["p50_ttft_warm_ms"]
+    warm_off = out[tag + "off"]["p50_ttft_warm_ms"]
     out["warm_ttft_speedup"] = round(warm_off / warm_on, 3) if warm_on else 0.0
     return out
 
@@ -645,20 +676,24 @@ def bench_kernel(cfg, S, C, steps, inner):
 
 
 def _arm_budget_watchdog(partial_line: dict) -> float:
-    """LOCALAI_BENCH_BUDGET_S wall-clock budget (default 600 s; 0
-    disables): a daemon thread prints whatever has been measured so far
-    as ONE JSON line and exits rc=0 at the deadline — the bench NEVER
-    dies rc=124 under a harness timeout with nothing reported (BENCH_r05
-    failure mode). Returns the deadline (monotonic) or +inf."""
+    """LOCALAI_BENCH_BUDGET_S wall-clock budget (default 480 s — the
+    harness kills at ~600, and r05 showed a watchdog AT the harness
+    limit loses the race and dies rc=124 with empty output; 0 disables):
+    a daemon thread prints whatever the finished phases measured so far
+    as ONE JSON line and exits rc=0 at the deadline, so ``parsed`` is
+    never null. Returns the deadline (monotonic) or +inf."""
     import threading
 
-    budget = float(os.environ.get("LOCALAI_BENCH_BUDGET_S", "600"))
+    budget = float(os.environ.get("LOCALAI_BENCH_BUDGET_S", "480"))
     if budget <= 0:
         return float("inf")
     deadline = time.monotonic() + budget
 
     def watchdog():
-        time.sleep(budget)
+        # small sleep slices: one long sleep can overshoot under load,
+        # and the whole point is beating the harness's hard kill
+        while time.monotonic() < deadline:
+            time.sleep(min(2.0, max(0.1, deadline - time.monotonic())))
         partial_line.setdefault("metric", "bench_budget_exceeded")
         partial_line["budget_exceeded_s"] = budget
         print(json.dumps(partial_line), flush=True)
@@ -667,6 +702,17 @@ def _arm_budget_watchdog(partial_line: dict) -> float:
     threading.Thread(target=watchdog, daemon=True,
                      name="bench-budget").start()
     return deadline
+
+
+def _emit_phase(name: str, payload) -> None:
+    """Incremental per-phase progress on STDERR (stdout stays reserved
+    for the single final JSON summary line the harness parses)."""
+    try:
+        print(json.dumps({"phase": name, "result": payload}),
+              file=sys.stderr, flush=True)
+    except (TypeError, ValueError):
+        print(json.dumps({"phase": name, "result": str(payload)[:500]}),
+              file=sys.stderr, flush=True)
 
 
 def _subprocess_jax_platform(deadline: float) -> str:
@@ -747,6 +793,7 @@ def _engine_direct_layout_compare(deadline: float, partial: dict) -> dict:
         except Exception as e:
             out[f"{layout}_error"] = f"{type(e).__name__}: {e}"[:200]
         partial.update({f"kv_layout_compare_{k}": v for k, v in out.items()})
+    _emit_phase("kv_layout_compare", out)
     return out
 
 
@@ -798,6 +845,60 @@ def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     except Exception as e:
         out = {"error": f"{type(e).__name__}: {e}"[:200]}
     partial.update({f"multiturn_{k}": v for k, v in out.items()})
+    _emit_phase("multiturn_prefix_cache", out)
+    return out
+
+
+def _engine_direct_offload(deadline: float, partial: dict) -> dict:
+    """The PR-3 acceptance scenario as a default-bench phase: multi-turn
+    under FORCED POOL PRESSURE (device pool ~half the working set),
+    kv_offload on vs off, engine-direct in a subprocess — warm turns
+    behind an eviction restore from host RAM instead of re-prefilling."""
+    import subprocess
+
+    mt_preset = os.environ.get("LOCALAI_BENCH_MT_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(mt_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": mt_preset,
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--multiturn",
+             "--pressure"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"warm_ttft_speedup": r.get("warm_ttft_speedup"),
+                       "greedy_match": r.get("greedy_match"),
+                       "restores": r.get("offload_on", {}).get("restores"),
+                       "warm_ms_on": round(r.get("offload_on", {}).get(
+                           "p50_ttft_warm_ms", 0.0), 1),
+                       "warm_ms_off": round(r.get("offload_off", {}).get(
+                           "p50_ttft_warm_ms", 0.0), 1)}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"kv_offload_pressure_{k}": v for k, v in out.items()})
+    _emit_phase("kv_offload_pressure", out)
     return out
 
 
@@ -827,17 +928,36 @@ def main():
             # multi-turn shared-prefix scenario with forced slot churn:
             # few slots, more conversations. Defaults scale with the
             # context so the K-turn histories always fit without a shift.
+            # --pressure additionally squeezes the device pool to ~half
+            # the working set and flips the on/off axis to kv_offload
+            # (PR 3 acceptance: restore-from-host vs re-prefill); its
+            # longer system prompt makes the re-prefill cost visible.
+            pressure = "--pressure" in sys.argv
+            if pressure:
+                import jax.numpy as jnp
+
+                # context >= 256 so the re-prefill being avoided is big
+                # enough to dominate fixed per-request overhead, and
+                # float32 weights to match the f32 cache (see
+                # bench_multiturn's parity note)
+                C = max(C, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                        or 256, 256)
+                cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                        dtype=jnp.float32, **PRESETS[preset])
             mt = {k: int(os.environ["LOCALAI_BENCH_MT_" + k.upper()])
                   if "LOCALAI_BENCH_MT_" + k.upper() in os.environ else v
                   for k, v in dict(
-                      slots=2, convs=6, turns=3, sys=max(32, C // 4),
+                      slots=2, convs=6, turns=3,
+                      sys=max(32, C // 2 if pressure else C // 4),
                       user=max(8, C // 24), new=max(8, C // 24)).items()}
             # keep the final history inside the context window
             assert mt["sys"] + mt["turns"] * (mt["user"] + mt["new"]) < C - 1
             r = bench_multiturn(cfg, mt["slots"], C, mt["convs"],
-                                mt["turns"], mt["sys"], mt["user"], mt["new"])
+                                mt["turns"], mt["sys"], mt["user"],
+                                mt["new"], pressure=pressure)
             print(json.dumps({
-                "metric": f"multiturn_prefix_cache_{preset}",
+                "metric": (f"multiturn_kv_offload_{preset}" if pressure
+                           else f"multiturn_prefix_cache_{preset}"),
                 "value": r["warm_ttft_speedup"], "unit": "x warm-turn TTFT",
                 **r,
             }))
@@ -874,6 +994,29 @@ def main():
         }))
         return
 
+    if "--smoke" in sys.argv:
+        # CI harness check (scripts/ci.sh): the cheap engine-direct
+        # phases only — layout compare, prefix-cache multiturn, offload-
+        # under-pressure multiturn — no HTTP stack, no big presets.
+        # rc=0 iff every phase produced a result and greedy stayed
+        # byte-identical; always ends in one JSON line.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        layout_cmp = _engine_direct_layout_compare(deadline, partial)
+        multiturn = _engine_direct_multiturn(deadline, partial)
+        offload = _engine_direct_offload(deadline, partial)
+        ok = ("paged_tok_s" in layout_cmp
+              and multiturn.get("greedy_match") is True
+              and offload.get("greedy_match") is True)
+        print(json.dumps({
+            "metric": "bench_smoke", "value": 1 if ok else 0, "unit": "ok",
+            "kv_layout_compare": layout_cmp,
+            "multiturn_prefix_cache": multiturn,
+            "kv_offload_pressure": offload,
+        }))
+        sys.exit(0 if ok else 1)
+
     # DEFAULT: the BASELINE.json metric — /v1/chat/completions over real
     # HTTP with SSE, on the 8B (north-star model) preset. The parent
     # process pins itself to the CPU platform (config, not env — the
@@ -883,11 +1026,14 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     # CHEAPEST phases first, so the budget watchdog can never starve
-    # them: decode tok/s for the paged vs contiguous KV layouts, then
-    # the multi-turn prefix-cache scenario, engine-direct on small
+    # them (each phase reports incrementally on stderr and folds into
+    # the watchdog's partial line): decode tok/s for the paged vs
+    # contiguous KV layouts, the multi-turn prefix-cache scenario, and
+    # the offload-under-pressure scenario, engine-direct on small
     # presets (identical config either side)
     layout_cmp = _engine_direct_layout_compare(deadline, partial)
     multiturn = _engine_direct_multiturn(deadline, partial)
+    offload_cmp = _engine_direct_offload(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -899,13 +1045,18 @@ def main():
         try:
             results[p] = bench_http(p, prompt_len, max_new, target)
             partial[f"{p}_tok_s"] = round(results[p]["tok_s"], 1)
+            _emit_phase(f"http_{p}",
+                        {"tok_s": round(results[p]["tok_s"], 1),
+                         "p50_ttft_ms": round(results[p]["p50_ttft_ms"], 1)})
         except Exception as e:  # report what ran; a preset OOM shouldn't
             errors[p] = f"{type(e).__name__}: {e}"  # zero the whole bench
+            _emit_phase(f"http_{p}", {"error": errors[p][:200]})
     if not results:
         line = {"metric": "http_chat_tok_s_per_chip", "value": None,
                 "unit": "tok/s",
                 "kv_layout_compare": layout_cmp,
                 "multiturn_prefix_cache": multiturn,
+                "kv_offload_pressure": offload_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -997,6 +1148,7 @@ def main():
                          "(no-egress rig); compute path identical to a "
                          "real checkpoint"),
         "multiturn_prefix_cache": multiturn,
+        "kv_offload_pressure": offload_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

@@ -85,9 +85,18 @@ async def version(request):
 
 
 _POOL_GAUGES = ("kv_pages_total", "kv_pages_free", "kv_pages_retained",
-                "kv_pages_active")
+                "kv_pages_active", "kv_pages_offloaded")
 _PCACHE_COUNTERS = ("hits", "misses", "evicted_pages", "inserted_pages",
                     "hit_rows")
+# host-tier transfer totals (engine/kv_offload.py stats key -> metric):
+# localai_kv_offload_{pages,bytes,restores,hits,misses}_total
+_OFFLOAD_COUNTERS = (("offloaded_pages", "pages"),
+                     ("offloaded_bytes", "bytes"),
+                     ("restores", "restores"),
+                     ("hits", "hits"),
+                     ("misses", "misses"),
+                     ("evicted_pages", "evicted_pages"),
+                     ("restored_pages", "restored_pages"))
 
 
 def _refresh_engine_metrics(state):
@@ -100,8 +109,9 @@ def _refresh_engine_metrics(state):
     import json as _json
 
     for g in ("kv_pool_pages", "kv_pool_oversubscription",
-              "prefix_cache_entries",
-              *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS)):
+              "prefix_cache_entries", "kv_offload_host_bytes",
+              *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
+              *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS)):
         METRICS.clear_instrument(g)
     for name in state.caps.loader.list_loaded():
         lm = state.caps.loader.get(name)
@@ -132,6 +142,13 @@ def _refresh_engine_metrics(state):
             for key in _PCACHE_COUNTERS:
                 METRICS.set_counter(f"prefix_cache_{key}_total",
                                     pc.get(key, 0), f'model="{name}"')
+        off = stats.get("kv_offload")
+        if off:
+            METRICS.set_gauge("kv_offload_host_bytes", off.get("bytes", 0),
+                              f'model="{name}"')
+            for skey, mkey in _OFFLOAD_COUNTERS:
+                METRICS.set_counter(f"kv_offload_{mkey}_total",
+                                    off.get(skey, 0), f'model="{name}"')
 
 
 async def metrics(request):

@@ -69,6 +69,20 @@ class EngineServicer(BackendServicer):
         self._embed = False
 
     @staticmethod
+    def _host_store_path(extra: dict, request) -> str:
+        """kv_host_store=path option -> absolute persistence path for the
+        offloaded-page store (engine/kv_offload.py); relative paths land
+        next to the prompt caches under model_path."""
+        p = str(extra.get("kv_host_store", "") or "")
+        if not p:
+            return ""
+        if not os.path.isabs(p) and request.model_path:
+            base = os.path.join(request.model_path, "prompt_cache")
+            os.makedirs(base, exist_ok=True)
+            p = os.path.join(base, p)
+        return p
+
+    @staticmethod
     def _sane_ga_w(extra: dict) -> int:
         n = max(1, int(extra.get("ga_n", 1) or 1))
         w = int(extra.get("ga_w", 512) or 512)
@@ -271,6 +285,17 @@ class EngineServicer(BackendServicer):
             **({"kv_prefix_cache_min_rows": mr} if (mr := int(
                 extra.get("kv_prefix_cache_min_rows", 0) or 0)) > 0
                else {}),
+            # two-tier host offload (PR 3): kv_offload=0 opts out
+            # (restores the PR-2 lifecycle exactly); kv_host_pool_mb
+            # bounds the host tier; kv_host_store=path persists it
+            # across restarts (relative paths resolve under model_path)
+            **({"kv_offload": False} if str(
+                extra.get("kv_offload", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"kv_host_pool_mb": hmb} if (hmb := int(
+                extra.get("kv_host_pool_mb", 0) or 0)) > 0 else {}),
+            **({"kv_host_store_path": hsp} if (hsp := self._host_store_path(
+                extra, request)) else {}),
         )
         draft = None
         if request.draft_model:

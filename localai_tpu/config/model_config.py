@@ -131,9 +131,12 @@ class ModelConfig:
     # video knobs num_frames=14,fps=7,motion=1.0, or the paged-KV knobs
     # kv_layout=paged|contiguous, kv_page_size=N, kv_pool_pages=N,
     # kv_prefix_cache=0|1 (cross-release prefix cache, default on),
-    # kv_prefix_cache_min_rows=N (reuse threshold, default 16). The
-    # known kv_* knobs are value-validated in validate() so a typo
-    # fails at config scan instead of silently running the default.
+    # kv_prefix_cache_min_rows=N (reuse threshold, default 16),
+    # kv_offload=0|1 (host-RAM page offload tier, default on),
+    # kv_host_pool_mb=N (host tier byte budget), kv_host_store=path
+    # (persist offloaded chains across restarts). The known kv_* knobs
+    # are value-validated in validate() so a typo fails at config scan
+    # instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
@@ -218,13 +221,15 @@ class ModelConfig:
                 problems.append(
                     f"kv_layout must be auto|paged|contiguous, got {v!r}")
             elif k in ("kv_page_size", "kv_pool_pages",
-                       "kv_prefix_cache_min_rows") and not v.isdigit():
+                       "kv_prefix_cache_min_rows",
+                       "kv_host_pool_mb") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
-            elif k == "kv_prefix_cache" and v.lower() not in bool_vals:
+            elif k in ("kv_prefix_cache",
+                       "kv_offload") and v.lower() not in bool_vals:
                 problems.append(
-                    f"kv_prefix_cache must be one of {bool_vals}, got {v!r}")
+                    f"{k} must be one of {bool_vals}, got {v!r}")
         return problems
 
     def usecases(self) -> Usecase:

@@ -85,6 +85,21 @@ class EngineConfig:
     # share scan) to beat a clean prefill — a 1-page BOS match must
     # never force the slow continued-prefill path
     kv_prefix_cache_min_rows: int = 16
+    # two-tier KV page store (engine/kv_offload.py): when _reclaim_pages
+    # would evict a retained chain, its page rows are OFFLOADED to a
+    # host-RAM store (same chained block hash keys, int8 pages kept
+    # quantized) via a non-blocking device gather, and a prefix-cache
+    # hit against an offloaded chain RESTORES the pages into freshly
+    # allocated device rows with the upload overlapped against in-flight
+    # decode work — the LRU cascades device -> host -> gone. Requires
+    # the prefix cache; off restores the PR-2 lifecycle exactly.
+    kv_offload: bool = True
+    # host-tier byte budget (the host->gone edge of the LRU cascade)
+    kv_host_pool_mb: int = 256
+    # persist the host store here on graceful shutdown and reload it at
+    # init (version/scope-checked; a mismatched or corrupt file is
+    # ignored). "" = no persistence.
+    kv_host_store_path: str = ""
     # speculative decoding: draft proposals per round (0 disables even
     # when a draft model is loaded); greedy slots only
     n_draft: int = 4
@@ -234,6 +249,40 @@ class _PendingPrefill:
         self.err = None
 
 
+class _PendingOffload:
+    """A dispatched device->host page gather awaiting its transfer.
+
+    The gather itself is issued between decode dispatches (one jit call,
+    no sync); the blocking np.asarray runs on the SYNC WORKER thread in
+    dispatch order, so offloading never stalls the serving loop. Once
+    materialized, the worker inserts the pages straight into the host
+    store (HostPageStore locks internally)."""
+    __slots__ = ("metas", "k_rows", "v_rows", "store", "err")
+
+    def __init__(self, metas, k_rows, v_rows, store):
+        self.metas = metas        # [(key, parent, depth), ...] per page
+        self.k_rows = k_rows      # device [L, B, pg, KV, hd] (+ scales)
+        self.v_rows = v_rows
+        self.store = store
+        self.err = None
+
+    def run(self):
+        """Sync the gather and hand each page to the host store."""
+        import jax as _jax
+
+        k_np = _jax.tree.map(np.asarray, self.k_rows)
+        v_np = _jax.tree.map(np.asarray, self.v_rows)
+
+        def page(rows, i):
+            if isinstance(rows, dict):
+                return {"q": np.ascontiguousarray(rows["q"][:, i]),
+                        "s": np.ascontiguousarray(rows["s"][:, i])}
+            return np.ascontiguousarray(rows[:, i])
+
+        for i, (key, parent, depth) in enumerate(self.metas):
+            self.store.put(key, parent, depth, page(k_np, i), page(v_np, i))
+
+
 class _Slot:
     __slots__ = (
         "req", "detok", "generated", "held_text", "prompt_len",
@@ -333,6 +382,8 @@ class Engine:
             or (self.ecfg.kv_layout == "auto" and bus is None))
         self._pool = None
         self._pcache = None
+        self._hstore = None
+        self._pool_pages = 0     # resolved physical pool size (0 = full)
         pg = 0
         if self._paged:
             from localai_tpu.engine.paging import PagePool
@@ -340,7 +391,20 @@ class Engine:
             pg = max(1, min(self.ecfg.kv_page_size, C))
             while C % pg:     # page size must divide the context
                 pg -= 1
-            self._pool = PagePool(S, C, pg, self.ecfg.kv_pool_pages)
+            offload_on = self.ecfg.kv_prefix_cache and self.ecfg.kv_offload
+            self._pool_pages = self.ecfg.kv_pool_pages
+            full = S * (C // pg)
+            if self._pool_pages == 0 and offload_on and full >= 64:
+                # ROADMAP follow-up: with oversubscription telemetry AND
+                # a host tier absorbing evictions, the default pool no
+                # longer needs the worst-case contiguous reservation —
+                # serving-sized pools shrink 25% (evicted chains offload
+                # instead of re-prefilling). Tiny test/bench pools (< 64
+                # pages) keep the full reservation: at that scale one
+                # slot's context is a large pool fraction and shrinkage
+                # would manufacture admission failures, not save HBM.
+                self._pool_pages = max(full * 3 // 4, S + C // pg)
+            self._pool = PagePool(S, C, pg, self._pool_pages)
             if self.ecfg.kv_prefix_cache:
                 # cross-release page retention; NEVER built for the
                 # contiguous fallbacks (lockstep / self-extend / mamba /
@@ -350,6 +414,22 @@ class Engine:
                 self._pcache = prefix_cache.PrefixPageCache(
                     prefix_cache.build_scope(self._fam_name, model_cfg, pg,
                                              self.ecfg.cache_dtype), pg)
+                if self.ecfg.kv_offload:
+                    # the host-RAM tier under the pool (the scope doubles
+                    # as the persisted file's model/geometry check)
+                    from localai_tpu.engine.kv_offload import HostPageStore
+
+                    self._hstore = HostPageStore(
+                        self._pcache.scope, pg, self.ecfg.kv_host_pool_mb)
+                    if self.ecfg.kv_host_store_path:
+                        n = self._hstore.load(self.ecfg.kv_host_store_path)
+                        if n:
+                            import logging as _logging
+
+                            _logging.getLogger(__name__).info(
+                                "kv host store: reloaded %d offloaded "
+                                "pages from %s", n,
+                                self.ecfg.kv_host_store_path)
         # device-resident state: big (KV cache), rarely-mutated (bias), or
         # not host-mirrorable (PRNG keys). Everything per-slot and small
         # lives as HOST numpy — admissions/releases are then free in-place
@@ -357,7 +437,7 @@ class Engine:
         # to the device as ordinary jit args each step.
         self.ck, self.cv = self.family.init_cache(
             model_cfg, S, C, self.ecfg.cache_dtype,
-            **({"page_size": pg, "num_pages": self.ecfg.kv_pool_pages}
+            **({"page_size": pg, "num_pages": self._pool_pages}
                if self._paged else {}))
         # draft cache is allocated LAZILY at the first spec-eligible
         # admission (r2 allocated it up front, doubling per-slot KV HBM
@@ -484,11 +564,23 @@ class Engine:
             try:
                 if isinstance(item, _Burst):
                     item.pack_np = np.asarray(item.pack)
+                elif isinstance(item, _PendingOffload):
+                    # terminal here: offloads produce no tokens, so they
+                    # never enter the dispatch FIFO — sync + store insert
+                    # both live on this thread, off the serving loop
+                    item.run()
+                    continue
                 else:
                     item.ids_np = np.asarray(item.out_ids)
                     item.lps_np = np.asarray(item.logprobs)
                     item.mu_np = np.asarray(item.mu_out)
             except Exception as e:  # surfaced when the item is processed
+                if isinstance(item, _PendingOffload):
+                    # a failed offload only loses a reusable copy — log
+                    # and keep serving (the chain just re-prefills later)
+                    __import__("logging").getLogger(__name__).exception(
+                        "kv page offload failed")
+                    continue
                 item.err = e
             item.ready.set()
             self._wake.set()
@@ -574,7 +666,7 @@ class Engine:
         self.cv = kvcache.with_page_table(self.cv, tabs[1])
         self._pool.dirty = False
 
-    def _reclaim_pages(self, slot: int, need_free: int):
+    def _reclaim_pages(self, slot, need_free: int):
         """Two-tier reclaim under pool pressure, cheapest truth first:
           1. free slots' retained TABLES are released (their
              _cache_tokens cleared so _pick_slot stops advertising the
@@ -585,14 +677,32 @@ class Engine:
         Purely host-side and non-blocking — admission either gets its
         pages or sees PoolExhausted from the retried alloc, never a
         deadlock against work the scheduler still has to run."""
+        # ``slot`` (int or tuple) names tables reclaim must NOT release:
+        # mid-admission the destination slot is still unoccupied, and a
+        # share/restore source may be a free slot — freeing either would
+        # invalidate pages the caller is actively splicing
+        protect = slot if isinstance(slot, tuple) else (slot,)
         for i, s in enumerate(self.slots):
             if self._pool.free_pages >= need_free:
                 return
-            if s is None and i != slot and self._pool.owned[i]:
+            if s is None and i not in protect and self._pool.owned[i]:
                 self._pool.release(i, 0)
                 self._cache_tokens[i] = []
         if self._pcache is not None:
-            self._pcache.evict(self._pool, need_free)
+            victims = []
+            on_evict = None
+            if self._hstore is not None:
+                # device->host handoff: collect each evicted entry while
+                # its page id still names valid rows; one batched gather
+                # goes out below, BEFORE any dispatch that could reuse
+                # the freed pages (device program order makes the copy
+                # read the pre-eviction content)
+                def on_evict(e, _v=victims):
+                    if not self._hstore.contains(e.key):
+                        _v.append((e.key, e.parent, e.depth, e.page))
+            self._pcache.evict(self._pool, need_free, on_evict)
+            if victims:
+                self._dispatch_offload(victims)
 
     def _ensure_pages(self, slot: int, rows: int):
         """Lazy page allocation with reclaim-and-retry on pool pressure."""
@@ -608,16 +718,19 @@ class Engine:
         self._reclaim_pages(slot, self._pool.pages_for(rows))
         self._pool.ensure(slot, rows)   # raises PoolExhausted if truly full
 
-    def _alloc_detached(self) -> int:
+    def _alloc_detached(self, slot=-1) -> int:
         """alloc_detached with the same reclaim-and-retry discipline as
         _ensure_pages: a COW boundary clone must not fail while retained
-        pages are still evictable."""
+        pages are still evictable. ``slot`` is the table being built —
+        reclaim must not release it (mid-admission the slot is still
+        unoccupied, so without the exclusion reclaim would free the
+        pages just spliced into it)."""
         from localai_tpu.engine.paging import PoolExhausted
 
         try:
             return self._pool.alloc_detached()
         except PoolExhausted:
-            self._reclaim_pages(-1, 1)
+            self._reclaim_pages(slot, 1)
             return self._pool.alloc_detached()
 
     def _get_page_clone_fn(self):
@@ -641,12 +754,113 @@ class Engine:
         pi = self._pool.cow_page(slot, row)
         if pi < 0:
             return
+        new = self._alloc_detached(slot)
         old = int(self._pool.ptab[slot, pi])
-        new = self._alloc_detached()
         self._commit_ptab()
         self.ck, self.cv = self._get_page_clone_fn()(
             self.ck, self.cv, np.int32(old), np.int32(new))
         self._pool.replace(slot, pi, new)
+
+    def _get_offload_gather_fn(self, batch: int):
+        key = ("offload_gather", batch)
+        fn = self._fork_fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda ck, cv, idx: (kvcache.gather_pages(ck, idx),
+                                              kvcache.gather_pages(cv, idx)))
+            self._fork_fns[key] = fn
+        return fn
+
+    def _get_restore_scatter_fn(self, batch: int):
+        key = ("restore_scatter", batch)
+        fn = self._fork_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda ck, cv, idx, kr, vr: (
+                    kvcache.scatter_pages(ck, idx, kr),
+                    kvcache.scatter_pages(cv, idx, vr)),
+                donate_argnums=(0, 1))
+            self._fork_fns[key] = fn
+        return fn
+
+    def _dispatch_offload(self, victims: list):
+        """Issue ONE non-blocking device gather for a batch of evicted
+        pages and queue the host transfer on the sync worker. The batch
+        pads to a power of two (repeat-last — duplicate reads are free)
+        so only log2 gather programs ever compile."""
+        t0 = time.monotonic()
+        n = len(victims)
+        B = 1
+        while B < n:
+            B *= 2
+        idx = np.full((B,), victims[-1][3], np.int32)
+        for i, (_k, _p, _d, page) in enumerate(victims):
+            idx[i] = page
+        k_rows, v_rows = self._get_offload_gather_fn(B)(self.ck, self.cv,
+                                                        idx)
+        item = _PendingOffload([(k, p, d) for k, p, d, _pg in victims],
+                               k_rows, v_rows, self._hstore)
+        self._sync_q.put(item)
+        self._tmark("offload_dispatch", t0)
+
+    def _restore_offloaded(self, slot: int, host_hits: list) -> int:
+        """Upload offloaded pages into freshly allocated device rows and
+        splice them onto the slot's table — DISPATCH-THEN-SPLICE: the
+        host->device copy is issued as one async jit call (it overlaps
+        whatever decode bursts are already in flight; by device program
+        order it completes before the slot's prefill reads the rows),
+        the table edit is pure host work, and the serving loop never
+        syncs. Partial allocation under pool pressure degrades to a
+        shorter restored chain (still contiguous from the root).
+        Returns the number of pages actually restored."""
+        pool = self._pool
+        pages = pool.alloc_many(len(host_hits))
+        if len(pages) < len(host_hits):
+            self._reclaim_pages(slot, len(host_hits) - len(pages))
+            pages.extend(pool.alloc_many(len(host_hits) - len(pages)))
+        host_hits = host_hits[:len(pages)]
+        if not host_hits:
+            for p in pages:
+                pool.unref_detached(p)
+            return 0
+        t0 = time.monotonic()
+        n = len(host_hits)
+        B = 1
+        while B < n:
+            B *= 2
+        # sentinel-pad the scatter batch: out-of-pool page ids DROP
+        idx = np.full((B,), pool.num_pages, np.int32)
+        idx[:n] = pages[:n]
+
+        def stack(get):
+            first = get(host_hits[0])
+            if isinstance(first, dict):
+                def pad(leaf):
+                    a = np.stack([get(e)[leaf] for e in host_hits], axis=1)
+                    if B > n:
+                        a = np.concatenate(
+                            [a, np.zeros(a.shape[:1] + (B - n,)
+                                         + a.shape[2:], a.dtype)], axis=1)
+                    return a
+                return {"q": pad("q"), "s": pad("s")}
+            a = np.stack([get(e) for e in host_hits], axis=1)
+            if B > n:
+                a = np.concatenate(
+                    [a, np.zeros(a.shape[:1] + (B - n,) + a.shape[2:],
+                                 a.dtype)], axis=1)
+            return a
+
+        self.ck, self.cv = self._get_restore_scatter_fn(B)(
+            self.ck, self.cv, idx, stack(lambda e: e.k),
+            stack(lambda e: e.v))
+        for e, p in zip(host_hits, pages[:n]):
+            pool.adopt(slot, p)
+            # restored pages re-enter the device tier immediately: the
+            # attach hold makes refs >= 2, so the admitting prefill's
+            # boundary write COW-clones instead of corrupting the copy
+            self._pcache.attach(pool, e.key, e.parent, p, e.depth)
+        self._hstore.note_restore(n)
+        self._tmark("restore_dispatch", t0)
+        return n
 
     def _share_prefix(self, src: int, dst: int, rows: int) -> int:
         """Zero-copy prefix transfer: full pages covering rows[0:rows] are
@@ -656,8 +870,8 @@ class Engine:
         shared = self._pool.share(src, dst, rows)
         if shared < rows:
             pi = shared // self._pool.page_size
+            new = self._alloc_detached((src, dst))
             src_page = int(self._pool.ptab[src, pi])
-            new = self._alloc_detached()
             self._commit_ptab()
             self.ck, self.cv = self._get_page_clone_fn()(
                 self.ck, self.cv, np.int32(src_page), np.int32(new))
@@ -708,11 +922,42 @@ class Engine:
                     best_src, best_rows = j, n
         if self._pcache is not None and self.ecfg.ga_n <= 1:
             cached_pages = self._pcache.match(ids, pool.max_pages)
-            cached_rows = min(len(cached_pages) * pool.page_size, cap)
+            host_hits = []
+            if self._hstore is not None:
+                # TWO-TIER chain walk: the device tier is prefix-closed
+                # (eviction cascades subtrees), so the host tier can only
+                # CONTINUE the chain past the device pages — same key
+                # sequence, links [d, h) served from offloaded copies
+                want = min(pool.max_pages, cap // pool.page_size + 1)
+                for i, key in enumerate(self._pcache.chain_keys(ids)):
+                    if i < len(cached_pages):
+                        continue
+                    if len(cached_pages) + len(host_hits) >= want:
+                        break
+                    e = self._hstore.get(key)
+                    if e is None:
+                        break
+                    host_hits.append(e)
+            cached_rows = min(
+                (len(cached_pages) + len(host_hits)) * pool.page_size, cap)
             if cached_rows >= min_rows and cached_rows > max(common,
                                                             best_rows):
                 pool.release(slot, 0)
                 pool.splice(slot, cached_pages)
+                restored = 0
+                if host_hits:
+                    # dispatch-then-splice (see _restore_offloaded): the
+                    # upload overlaps in-flight decode work; a partial
+                    # restore under pool pressure shortens the reuse,
+                    # never fails the admission
+                    restored = self._restore_offloaded(slot, host_hits)
+                    cached_rows = min(
+                        (len(cached_pages) + restored) * pool.page_size,
+                        cap)
+                if cached_rows == 0:
+                    # pathological: nothing spliced and nothing restored
+                    self._pcache.note_miss()
+                    return 0
                 # a retained page re-entering a table carries refs >= 2
                 # (table + cache hold), so the existing COW guard clones
                 # the boundary page before the first prefill write —
@@ -720,6 +965,12 @@ class Engine:
                 self._cow_guard(slot, cached_rows)
                 self._pcache.note_hit(cached_rows)
                 return cached_rows
+            if self._hstore is not None and not host_hits \
+                    and len(ids) // pool.page_size > len(cached_pages):
+                # the host tier was consulted past the device chain and
+                # had nothing usable — the restore-miss path: plain
+                # prefill, byte-identical to PR-2 behavior
+                self._hstore.note_miss()
             self._pcache.note_miss()
         if best_rows > common and best_rows >= min_rows:
             pool.release(slot, 0)
@@ -1101,9 +1352,41 @@ class Engine:
                     self.mu, no_ov,
                     np.zeros((B, bucket), np.int32), np.ones((B,), np.int32),
                     np.zeros((B,), np.int32), np.zeros((B,), np.int32))
+        if self._hstore is not None:
+            # host-tier transfer programs: the first eviction/restore
+            # must not pay a cold compile mid-serving. Gather reads page
+            # 0 (harmless); the scatter warm-up writes nothing (all
+            # sentinel ids drop).
+            B = 1
+            while B <= 16:
+                idx_g = np.zeros((B,), np.int32)
+                idx_s = np.full((B,), self._pool.num_pages, np.int32)
+                rows = self._get_offload_gather_fn(B)(self.ck, self.cv,
+                                                      idx_g)
+                zeros = jax.tree.map(
+                    lambda a: np.zeros(a.shape, a.dtype),
+                    jax.tree.map(np.asarray, rows[0]))
+                self.ck, self.cv = self._get_restore_scatter_fn(B)(
+                    self.ck, self.cv, idx_s, zeros, zeros)
+                B *= 2
         jax.block_until_ready(self.ck)
 
     def start(self, precompile: bool = False):
+        if self._paged and self._pool.oversubscription > 1.5:
+            # sizing hint (ROADMAP follow-up): an operator who shrank
+            # kv_pool_pages past 1.5x logical demand should know what
+            # admission now leans on — one line, at start, not per event
+            import logging as _logging
+
+            _logging.getLogger(__name__).info(
+                "kv pool oversubscription %.2fx (%d pages for %d logical):"
+                " admission relies on %s under full load; watch "
+                "localai_kv_pool_pages{state=\"free\"} and grow "
+                "kv_pool_pages if admissions fail",
+                self._pool.oversubscription, self._pool.num_pages,
+                self.ecfg.num_slots * self._pool.max_pages,
+                "prefix-cache eviction + host-RAM offload"
+                if self._hstore is not None else "prefix-cache eviction")
         if precompile:
             self.precompile()
         self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
@@ -1115,6 +1398,12 @@ class Engine:
         if self._thread:
             self._thread.join(timeout=10)
         self._sync_q.put(None)
+        if self._hstore is not None and self.ecfg.kv_host_store_path:
+            # graceful-shutdown persistence: let the worker drain any
+            # in-flight offload gathers into the store first, then
+            # serialize it for the next engine of this model
+            self._sync_thread.join(timeout=30)
+            self._hstore.save(self.ecfg.kv_host_store_path)
         if self._bus is not None:
             self._bus.close()
         if self._trace and self._tstats:
@@ -1153,15 +1442,17 @@ class Engine:
 
             self._pool = PagePool(S, self.ecfg.max_context,
                                   self._pool.page_size,
-                                  self.ecfg.kv_pool_pages)
+                                  self._pool_pages)
             if self._pcache is not None:
                 # the pool (and its holds) died with the device state;
-                # forget the index, keep the telemetry counters
+                # forget the index, keep the telemetry counters. The
+                # HOST tier survives — its numpy copies don't reference
+                # the dead pool, so offloaded chains stay restorable.
                 self._pcache.clear()
         self.ck, self.cv = self.family.init_cache(
             self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype,
             **({"page_size": self._pool.page_size,
-                "num_pages": self.ecfg.kv_pool_pages}
+                "num_pages": self._pool_pages}
                if self._paged else {}))
         self.dck = self.dcv = None   # re-ensured at the next spec admission
         self.ring, self.ring_pos = sampling.make_ring(S)
@@ -1248,6 +1539,10 @@ class Engine:
                 self._pool.oversubscription, 4)
             if self._pcache is not None:
                 out["prefix_cache"] = self._pcache.stats()
+            if self._hstore is not None:
+                # host tier: state=offloaded pool gauge + transfer totals
+                out["kv_pages_offloaded"] = self._hstore.pages
+                out["kv_offload"] = self._hstore.stats()
         else:
             out["kv_layout"] = "contiguous"
         with self._decomp_lock:

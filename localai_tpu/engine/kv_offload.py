@@ -1,0 +1,328 @@
+"""Host-RAM tier of the paged KV store: offloaded pages + disk persistence.
+
+PR 2's PrefixPageCache retains released chains only while their pages are
+resident in the DEVICE pool — under pool pressure `_reclaim_pages` evicts
+LRU chains and the next turn of that conversation pays a full prefill
+again. DejaVu (arXiv:2403.01876) shows KV state streams off-accelerator
+and restores faster than recompute; PRESERVE (arXiv:2501.08192) shows the
+restore cost hides entirely when issued ahead of the step that needs it.
+This module is the second tier those papers describe, applied at page
+granularity under the existing pool:
+
+  * ENTRIES are keyed by the SAME chained block hash the device-tier
+    store uses (kvcache.page_chain_hash, model+page-size scoped), so a
+    chain lookup spans both tiers with one key sequence: device pages
+    cover links [0, d), host entries continue [d, h). Eviction cascades
+    subtrees (prefix_cache._remove_tree), so the device tier is always
+    prefix-closed and the two tiers never interleave.
+  * CONTENT is the page's raw device representation copied to pinned
+    host numpy — int8 pages keep their quantized {q, scales} leaves,
+    bf16 pages stay bf16 (ml_dtypes) — so a restore is a byte-exact
+    upload, never a requantization.
+  * The LRU now CASCADES device -> host -> gone: the engine offloads a
+    chain as `_reclaim_pages` evicts it (the device->host handoff), and
+    this store evicts its own entries LRU-first when `kv_host_pool_mb`
+    is exceeded (the host->gone edge). Orphaned children cascade away
+    exactly like the device tier — match() walks root-down.
+  * PERSISTENCE: save() serializes the store to one .npz (the prompt-
+    cache container format) with a version tag and the full page SCOPE
+    (family + attention geometry + cache dtype + page size); load()
+    ignores — never crashes on — a corrupted, truncated, mismatched-
+    version or mismatched-scope file, so offloaded chains survive
+    graceful restarts of the same model only.
+
+Thread safety: the engine loop matches/takes entries while the sync
+worker inserts freshly gathered pages — every public method locks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+STORE_VERSION = 1
+
+
+def _to_savable(a: np.ndarray):
+    """(.npy-safe array, dtype name): ml_dtypes bfloat16 is not a numpy
+    wire dtype, so it rides as a same-shape uint16 view."""
+    name = str(a.dtype)
+    if name == "bfloat16":
+        return a.view(np.uint16), name
+    return a, name
+
+
+def _from_savable(a: np.ndarray, name: str) -> np.ndarray:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return a.view(ml_dtypes.bfloat16)
+    return a.astype(name, copy=False) if str(a.dtype) != name else a
+
+
+def _leaf_bytes(rows) -> int:
+    if isinstance(rows, dict):
+        return sum(int(v.nbytes) for v in rows.values())
+    return int(rows.nbytes)
+
+
+class _HostEntry:
+    __slots__ = ("key", "parent", "depth", "tick", "k", "v", "nbytes")
+
+    def __init__(self, key: bytes, parent: bytes, depth: int, tick: int,
+                 k, v):
+        self.key = key
+        self.parent = parent
+        self.depth = depth
+        self.tick = tick
+        # one page of K / V rows in the device representation:
+        # [L, page_size, KV, hd] arrays, or {"q", "s"} dicts when int8
+        self.k = k
+        self.v = v
+        self.nbytes = _leaf_bytes(k) + _leaf_bytes(v)
+
+
+class HostPageStore:
+    """Byte-budgeted host-RAM index of offloaded pages."""
+
+    def __init__(self, scope: bytes, page_size: int, budget_mb: int):
+        self.scope = scope
+        self.page_size = page_size
+        self.budget_bytes = max(1, int(budget_mb)) << 20
+        self._lock = threading.Lock()
+        self._entries: dict[bytes, _HostEntry] = {}
+        self._children: dict[bytes, set] = {}
+        self._tick = 0
+        self._bytes = 0
+        # telemetry (monotonic totals -> localai_kv_offload_*_total)
+        self.offloaded_pages = 0
+        self.offloaded_bytes = 0
+        self.restored_pages = 0
+        self.restores = 0        # admissions that restored from this tier
+        self.hits = 0            # = restores (exported under _hits_total)
+        self.misses = 0          # tier consulted, chain not present
+        self.evicted_pages = 0   # host -> gone (budget eviction)
+
+    # ---------- introspection ----------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def pages(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pages": len(self._entries),
+                "bytes": self._bytes,
+                "budget_bytes": self.budget_bytes,
+                "offloaded_pages": self.offloaded_pages,
+                "offloaded_bytes": self.offloaded_bytes,
+                "restored_pages": self.restored_pages,
+                "restores": self.restores,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evicted_pages": self.evicted_pages,
+            }
+
+    # ---------- store operations ----------
+
+    def put(self, key: bytes, parent: bytes, depth: int, k, v) -> bool:
+        """Insert one offloaded page (device->host handoff). Duplicate
+        keys are touched, not replaced — content is identical by hash
+        construction. Evicts LRU-first past the byte budget."""
+        with self._lock:
+            self._tick += 1
+            e = self._entries.get(key)
+            if e is not None:
+                e.tick = self._tick
+                return False
+            e = _HostEntry(key, parent, depth, self._tick, k, v)
+            if e.nbytes > self.budget_bytes:
+                return False     # a single page over budget: never admit
+            self._entries[key] = e
+            self._children.setdefault(parent, set()).add(key)
+            self._bytes += e.nbytes
+            self.offloaded_pages += 1
+            self.offloaded_bytes += e.nbytes
+            self._evict_to_budget_locked()
+            return True
+
+    def get(self, key: bytes):
+        """Entry for a chain key (LRU-touched), or None — the host half
+        of the two-tier chain walk."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                self._tick += 1
+                e.tick = self._tick
+            return e
+
+    def contains(self, key: bytes) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def note_restore(self, n_pages: int):
+        with self._lock:
+            self.restores += 1
+            self.hits += 1
+            self.restored_pages += int(n_pages)
+
+    def note_miss(self):
+        with self._lock:
+            self.misses += 1
+
+    def _evict_to_budget_locked(self):
+        if self._bytes <= self.budget_bytes:
+            return
+        victims = sorted(self._entries.values(),
+                         key=lambda e: (e.tick, -e.depth))
+        for e in victims:
+            if self._bytes <= self.budget_bytes:
+                return
+            if e.key in self._entries:
+                self._remove_tree_locked(e.key)
+
+    def _remove_tree_locked(self, key: bytes) -> int:
+        """Remove an entry and every descendant (an orphaned child is
+        unreachable — the chain walk is root-down). host -> gone."""
+        n = 0
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            e = self._entries.pop(k, None)
+            if e is None:
+                continue
+            stack.extend(self._children.pop(k, ()))
+            kids = self._children.get(e.parent)
+            if kids is not None:
+                kids.discard(k)
+                if not kids:
+                    del self._children[e.parent]
+            self._bytes -= e.nbytes
+            self.evicted_pages += 1
+            n += 1
+        return n
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._children.clear()
+            self._bytes = 0
+
+    # ---------- disk persistence ----------
+
+    def save(self, path: str) -> bool:
+        """Serialize the store (atomically) for reload at the next engine
+        start. Entries are written in LRU order so load() replays the
+        recency ranking."""
+        with self._lock:
+            entries = sorted(self._entries.values(), key=lambda e: e.tick)
+        if not entries:
+            # nothing retained: leave no stale file behind
+            try:
+                if os.path.exists(path):
+                    os.remove(path)
+            except OSError:
+                pass
+            return False
+        quant = isinstance(entries[0].k, dict)
+        payload = {
+            "version": np.int32(STORE_VERSION),
+            "scope": np.frombuffer(self.scope, np.uint8),
+            "page_size": np.int32(self.page_size),
+            "keys": np.stack([np.frombuffer(e.key, np.uint8)
+                              for e in entries]),
+            "parents": np.stack([np.frombuffer(e.parent, np.uint8)
+                                 for e in entries]),
+            "depths": np.asarray([e.depth for e in entries], np.int32),
+            "quant": np.int32(1 if quant else 0),
+        }
+        if quant:
+            payload["kq"] = np.stack([e.k["q"] for e in entries])
+            payload["ks"] = np.stack([e.k["s"] for e in entries])
+            payload["vq"] = np.stack([e.v["q"] for e in entries])
+            payload["vs"] = np.stack([e.v["s"] for e in entries])
+            payload["dtype"] = np.asarray("int8")
+        else:
+            karr, kname = _to_savable(np.stack([e.k for e in entries]))
+            varr, _ = _to_savable(np.stack([e.v for e in entries]))
+            payload["kd"] = karr
+            payload["vd"] = varr
+            payload["dtype"] = np.asarray(kname)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **payload)
+            os.replace(tmp, path)
+            return True
+        except Exception:
+            log.exception("kv host store save failed: %s", path)
+            return False
+
+    def load(self, path: str) -> int:
+        """Reload a persisted store. Any defect — unreadable, truncated,
+        wrong version, wrong scope (model/geometry/dtype/page size) —
+        means the file is IGNORED, never crashed on. Returns the number
+        of entries restored."""
+        if not path or not os.path.exists(path):
+            return 0
+        try:
+            data = np.load(path, allow_pickle=False)
+            if int(data["version"]) != STORE_VERSION:
+                log.warning("kv host store %s: version %s != %s, ignoring",
+                            path, int(data["version"]), STORE_VERSION)
+                return 0
+            if (bytes(data["scope"].tobytes()) != self.scope
+                    or int(data["page_size"]) != self.page_size):
+                log.warning("kv host store %s: scope/page-size mismatch "
+                            "(different model or layout), ignoring", path)
+                return 0
+            keys = data["keys"]
+            parents = data["parents"]
+            depths = data["depths"]
+            quant = bool(int(data["quant"]))
+            if quant:
+                kq, ks, vq, vs = (data["kq"], data["ks"], data["vq"],
+                                  data["vs"])
+            else:
+                name = str(data["dtype"])
+                kd = _from_savable(data["kd"], name)
+                vd = _from_savable(data["vd"], name)
+            n = 0
+            loaded_bytes = 0
+            for i in range(keys.shape[0]):
+                if quant:
+                    k = {"q": kq[i], "s": ks[i]}
+                    v = {"q": vq[i], "s": vs[i]}
+                else:
+                    k, v = kd[i], vd[i]
+                if self.put(bytes(keys[i].tobytes()),
+                            bytes(parents[i].tobytes()),
+                            int(depths[i]), k, v):
+                    n += 1
+                    loaded_bytes += _leaf_bytes(k) + _leaf_bytes(v)
+            # loaded pages were offloaded by a PREVIOUS process — don't
+            # double-count them in this process's offload totals
+            with self._lock:
+                self.offloaded_pages = max(0, self.offloaded_pages - n)
+                self.offloaded_bytes = max(
+                    0, self.offloaded_bytes - loaded_bytes)
+            return n
+        except Exception:
+            log.exception("kv host store %s unreadable, ignoring", path)
+            return 0

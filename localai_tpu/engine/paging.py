@@ -29,8 +29,14 @@ more HBM than before; sharing + lazy allocation make it use less.
 Shrinking num_pages oversubscribes HBM against actual (not worst-case)
 usage; the engine reclaims retained pages of free slots on pressure.
 
-Page lifecycle (PR 2, cross-release prefix cache): every page moves
-through  free -> active -> retained -> (reused | evicted | free).
+Page lifecycle (PR 2 cross-release prefix cache, PR 3 host offload):
+every page moves through
+    free -> active -> retained -> (reused | offloaded | free)
+where OFFLOADED means the page's rows were copied to the host-RAM tier
+(engine/kv_offload.py) as `_reclaim_pages` evicted its retention hold —
+the device page itself returns to the free list, and a later prefix-
+cache hit on the chain RESTORES the rows into freshly allocated device
+pages (alloc_many below) spliced into the new slot's table.
 "Active" means some slot's table references it; "retained" means its
 ONLY references are holds placed by the engine's PrefixPageCache
 (engine/prefix_cache.py) — the page's KV rows outlive the slot that
@@ -130,6 +136,17 @@ class PagePool:
         """One page owned by nobody yet (copy-on-write clone target);
         hand it to replace() or free it via unref_detached()."""
         return self._alloc()
+
+    def alloc_many(self, n: int) -> list:
+        """Up to ``n`` detached pages (host-tier RESTORE allocation) —
+        returns what the free list can give without raising, so a
+        partial host-chain restore degrades to a shorter reuse instead
+        of failing admission. Callers adopt() or unref_detached() each
+        page."""
+        out = []
+        while len(out) < n and self._free:
+            out.append(self._alloc())
+        return out
 
     def unref_detached(self, page: int):
         self.refs[page] -= 1

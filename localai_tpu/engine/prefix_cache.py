@@ -151,11 +151,18 @@ class PrefixPageCache:
             pages.append(e.page)
         return pages
 
-    def evict(self, pool, need_free: int) -> int:
+    def evict(self, pool, need_free: int, on_evict=None) -> int:
         """Drop entries LRU-first until the pool has need_free free
         pages or the store is empty. Ties evict the deepest chain link
         first, and removal cascades to descendants (an orphaned child is
-        unreachable — match() walks root-down). Returns pages dropped."""
+        unreachable — match() walks root-down). Returns pages dropped.
+
+        ``on_evict(entry)`` is the device->host OFFLOAD handoff: called
+        for every removed entry BEFORE its pool reference drops, while
+        the page id still names valid rows — the engine collects the
+        victims and dispatches one device gather for the batch (the
+        copy executes before any later dispatch can overwrite the freed
+        page, by device program order)."""
         if not self._entries or pool.free_pages >= need_free:
             return 0
         victims = sorted(self._entries.values(),
@@ -166,11 +173,11 @@ class PrefixPageCache:
                 break
             if e.key not in self._entries:
                 continue    # already cascaded away
-            dropped += self._remove_tree(pool, e.key)
+            dropped += self._remove_tree(pool, e.key, on_evict)
         self.evicted_pages += dropped
         return dropped
 
-    def _remove_tree(self, pool, key: bytes) -> int:
+    def _remove_tree(self, pool, key: bytes, on_evict=None) -> int:
         n = 0
         stack = [key]
         while stack:
@@ -184,9 +191,26 @@ class PrefixPageCache:
                 kids.discard(k)
                 if not kids:
                     del self._children[e.parent]
+            if on_evict is not None:
+                on_evict(e)
             pool.drop(e.page)
             n += 1
         return n
+
+    def attach(self, pool, key: bytes, parent: bytes, page: int,
+               depth: int) -> bool:
+        """Re-enter a RESTORED page into the device tier: the host-tier
+        hit just uploaded its rows into a freshly allocated page already
+        referenced by the admitting slot's table — hold it and index it
+        so the next match is device-resident (restore repopulates tier
+        1, it doesn't bypass it). No-op if the key re-appeared (two
+        concurrent restores of one chain dedup to the first)."""
+        if key in self._entries:
+            return False
+        pool.hold(page)
+        self._entries[key] = _Entry(key, parent, page, depth, self._tick)
+        self._children.setdefault(parent, set()).add(key)
+        return True
 
     def clear(self):
         """Forget everything WITHOUT touching a pool — for device-state

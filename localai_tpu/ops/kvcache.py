@@ -385,6 +385,37 @@ def clone_page(cache: Cache, src_page, dst_page) -> Cache:
     return out
 
 
+def gather_pages(cache: Cache, page_ids: jax.Array) -> Cache:
+    """Read whole physical pages [L, n, page_size, KV, hd] (+ scales
+    [L, n, page_size, KV] when int8) — the device->host OFFLOAD read.
+    Dtype-preserving: int8 pages stay quantized, bf16 stays bf16, so the
+    host tier stores the exact device representation. page_ids out of
+    range clip (callers pad with repeats and slice host-side)."""
+    rows = jnp.take(cache["pages"], page_ids, axis=1, mode="clip")
+    if "scales" in cache:
+        return {"q": rows,
+                "s": jnp.take(cache["scales"], page_ids, axis=1,
+                              mode="clip")}
+    return rows
+
+
+def scatter_pages(cache: Cache, page_ids: jax.Array, rows: Cache) -> Cache:
+    """Write whole pages back into the pool — the host->device RESTORE
+    upload, gather_pages' inverse. rows carries the representation
+    gather_pages produced; sentinel page_ids (>= n_pages) DROP, so
+    callers pad restore batches to a compiled bucket size."""
+    out = dict(cache)
+    if "scales" in cache:
+        out["pages"] = cache["pages"].at[:, page_ids].set(
+            rows["q"], mode="drop")
+        out["scales"] = cache["scales"].at[:, page_ids].set(
+            rows["s"], mode="drop")
+    else:
+        out["pages"] = cache["pages"].at[:, page_ids].set(
+            rows.astype(cache["pages"].dtype), mode="drop")
+    return out
+
+
 def slot_rows(cache: Cache, slot) -> Cache:
     """cache[:, slot] per leaf -> [L, C, KV, hd] (+ scales)."""
     if is_paged(cache):

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The ONE CI incantation (ISSUE 3 satellite): tier-1 verify, then a
+# budgeted bench smoke — so builders stop re-typing the pieces.
+#
+#   scripts/ci.sh            # or: make ci
+#
+# Fails (rc != 0) if either stage fails. Environment knobs:
+#   TIER1_BUDGET_S          tier-1 wall clock (default 870, run_tier1.sh)
+#   LOCALAI_BENCH_BUDGET_S  bench smoke wall clock (default 300 here)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ci: tier-1 =="
+scripts/run_tier1.sh
+
+echo "== ci: bench smoke =="
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-300}" \
+    python bench.py --smoke
+
+echo "== ci: OK =="
