@@ -97,6 +97,16 @@ _OFFLOAD_COUNTERS = (("offloaded_pages", "pages"),
                      ("misses", "misses"),
                      ("evicted_pages", "evicted_pages"),
                      ("restored_pages", "restored_pages"))
+# per-request TTFT decomposition (engine.py _ttft_decomp rolling window,
+# p50 over the last 512 finished requests) — loaded-TTFT regressions
+# show up here without running bench: queue_wait (admission backlog),
+# admit_to_first (prefill scheduling + other slots' work), and the pure
+# prefill dispatch time. stats key -> localai_ttft_<metric>_p50_ms
+_TTFT_GAUGES = (("queue_wait", "queue_wait"),
+                ("admit_to_first", "admit_to_first"),
+                ("prefill_dispatch", "prefill_dispatch"))
+# packed-prefill scheduling totals (engine.py metrics()["packed_prefill"])
+_PACKED_COUNTERS = ("dispatches", "tokens", "segments", "pad_tokens")
 
 
 def _refresh_engine_metrics(state):
@@ -110,6 +120,9 @@ def _refresh_engine_metrics(state):
 
     for g in ("kv_pool_pages", "kv_pool_oversubscription",
               "prefix_cache_entries", "kv_offload_host_bytes",
+              "ttft_samples",
+              *(f"ttft_{m}_p50_ms" for _k, m in _TTFT_GAUGES),
+              *(f"prefill_packed_{k}_total" for k in _PACKED_COUNTERS),
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
               *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS)):
         METRICS.clear_instrument(g)
@@ -122,6 +135,20 @@ def _refresh_engine_metrics(state):
             stats = _json.loads(m.prompt_json_for_slot or "{}")
         except Exception:
             continue
+        # TTFT decomposition + packed-prefill scheduling: any engine
+        # layout (the gauges exist for contiguous caches too)
+        td = stats.get("ttft_decomp_p50_ms")
+        if td:
+            for skey, mkey in _TTFT_GAUGES:
+                METRICS.set_gauge(f"ttft_{mkey}_p50_ms",
+                                  td.get(skey, 0.0), f'model="{name}"')
+            METRICS.set_gauge("ttft_samples", td.get("n", 0),
+                              f'model="{name}"')
+        pp = stats.get("packed_prefill")
+        if pp and stats.get("prefill_packed"):
+            for key in _PACKED_COUNTERS:
+                METRICS.set_counter(f"prefill_packed_{key}_total",
+                                    pp.get(key, 0), f'model="{name}"')
         if stats.get("kv_layout") != "paged":
             continue
         for key in _POOL_GAUGES:

@@ -296,6 +296,20 @@ class EngineServicer(BackendServicer):
                 extra.get("kv_host_pool_mb", 0) or 0)) > 0 else {}),
             **({"kv_host_store_path": hsp} if (hsp := self._host_store_path(
                 extra, request)) else {}),
+            # ragged packed prefill (this PR): prefill_packed=0 opts
+            # back into the per-slot bucketed path bit-for-bit;
+            # prefill_token_budget caps packed prompt tokens per
+            # scheduler tick (0 = engine auto, 2x prefill_chunk)
+            **({"prefill_packed": False} if str(
+                extra.get("prefill_packed", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"prefill_token_budget": ptb} if (ptb := int(
+                extra.get("prefill_token_budget", 0) or 0)) > 0 else {}),
+            # prefill_packed_fuse=auto|0|1: fuse the packed step with
+            # the decode burst (auto = real-chip backends only)
+            **({"prefill_packed_fuse": ppf} if (ppf := str(
+                extra.get("prefill_packed_fuse", "") or "")) in
+               ("auto", "0", "1") else {}),
         )
         draft = None
         if request.draft_model:

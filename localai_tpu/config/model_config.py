@@ -134,9 +134,14 @@ class ModelConfig:
     # kv_prefix_cache_min_rows=N (reuse threshold, default 16),
     # kv_offload=0|1 (host-RAM page offload tier, default on),
     # kv_host_pool_mb=N (host tier byte budget), kv_host_store=path
-    # (persist offloaded chains across restarts). The known kv_* knobs
-    # are value-validated in validate() so a typo fails at config scan
-    # instead of silently running the default.
+    # (persist offloaded chains across restarts), or the ragged
+    # packed-prefill knobs prefill_packed=0|1 (default on; 0 restores
+    # per-slot bucketed prefill), prefill_token_budget=N (max packed
+    # prompt tokens per scheduler tick, 0 = engine auto) and
+    # prefill_packed_fuse=auto|0|1 (fuse the packed step with the
+    # decode burst; auto = real-chip backends only). The known
+    # knobs are value-validated in validate() so a typo fails at config
+    # scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
@@ -222,14 +227,18 @@ class ModelConfig:
                     f"kv_layout must be auto|paged|contiguous, got {v!r}")
             elif k in ("kv_page_size", "kv_pool_pages",
                        "kv_prefix_cache_min_rows",
-                       "kv_host_pool_mb") and not v.isdigit():
+                       "kv_host_pool_mb",
+                       "prefill_token_budget") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
-            elif k in ("kv_prefix_cache",
-                       "kv_offload") and v.lower() not in bool_vals:
+            elif k in ("kv_prefix_cache", "kv_offload",
+                       "prefill_packed") and v.lower() not in bool_vals:
                 problems.append(
                     f"{k} must be one of {bool_vals}, got {v!r}")
+            elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):
+                problems.append(
+                    f"prefill_packed_fuse must be auto|0|1, got {v!r}")
         return problems
 
     def usecases(self) -> Usecase:

@@ -7,11 +7,21 @@ compilation model:
 
   * The decode step is ONE jitted function over ALL slots, compiled once —
     inactive slots ride along masked (static shapes, no recompiles).
-  * Prompts are prefilled in CHUNKS between decode steps (reference packs
-    prompt chunks and decode tokens into one llama_batch, :1671+; XLA's
-    static shapes make separate interleaved steps the natural mapping), so
-    admitting a long prompt never stalls decode for active slots by more
-    than one chunk's compute.
+  * Prompts are ingested by a RAGGED PACKED PREFILL step between decode
+    steps (reference packs prompt chunks and decode tokens into one
+    llama_batch, :1671+): each tick packs the pending prompt tails of
+    ALL queued slots — fresh finals, continued prefix-reuse tails, long
+    prompts' chunks, context-shift re-prefills — into ONE
+    [total_tokens] batch padded only to a small set of total-token
+    buckets, and runs one compiled program that writes every segment's
+    KV rows through its own slot's page table and samples first tokens
+    for the final segments (models/llama.py ragged_prefill;
+    ops/ragged_prefill.py + ops/pallas/ragged_prefill.py). A
+    prefill_token_budget caps packed tokens per tick so decode ITL
+    stays bounded, and admitting a long prompt never stalls decode for
+    active slots by more than one budget's compute.
+    ``prefill_packed=0`` restores the per-slot bucketed path (chunks +
+    batched same-bucket finals + fused admission) bit-for-bit.
   * KV PREFIX REUSE: per-slot cache contents are tracked host-side; a new
     request is admitted into the free slot sharing the longest common
     token prefix and only the suffix is prefilled (reference:
@@ -54,7 +64,27 @@ class EngineConfig:
     num_slots: int = 8
     max_context: int = 2048
     prefill_buckets: tuple = (32, 128, 512, 2048)
-    prefill_chunk: int = 512   # max prompt tokens processed between decode steps
+    prefill_chunk: int = 512   # max prompt tokens per slot per prefill tick
+    # RAGGED PACKED PREFILL (module doc): pack every queued slot's
+    # pending prompt tail into ONE ragged dispatch per tick instead of
+    # per-slot bucket-padded chunks/finals. llama-family, non-lockstep,
+    # ga_n == 1 only — ineligible slots (multimodal, self-extend,
+    # draft-mirrored) transparently take the per-slot path. 0 restores
+    # the per-slot scheduling bit-for-bit.
+    prefill_packed: bool = True
+    # max packed prompt tokens per tick — the decode-ITL bound of the
+    # packed path (a tick's pack stalls decode for one pack's compute).
+    # 0 = auto: 2 * prefill_chunk, clamped to max_context.
+    prefill_token_budget: int = 0
+    # fuse the packed prefill step WITH the decode burst into one
+    # dispatch (_fused_packed_body) when a full burst is runnable.
+    # Fusing saves one dispatch per tick but delays first-token
+    # emission by the burst's compute, so the right answer is a
+    # platform property: "auto" fuses on real accelerator backends
+    # (per-dispatch overhead ~3-30 ms on the serving tunnel, r4) and
+    # stays unfused on CPU (dispatch costs ~nothing; measured 1.5x
+    # worse loaded TTFT when fused on the smoke rig). "1"/"0" force.
+    prefill_packed_fuse: str = "auto"
     context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
     # KV layout (llama family): "auto" -> the PAGED page-pool layout
@@ -529,6 +559,33 @@ class Engine:
         # 4 groups of 8 through one pending slot, stalling the device ~1s
         # per wave): one group should swallow half the fleet.
         self._final_pad = max(8, min(16, self.ecfg.num_slots))
+        # ragged packed prefill (module doc): one dispatch per tick for
+        # ALL queued slots' prompt tails. Families without the ragged
+        # forward, lockstep (the pack op is not in the descriptor set)
+        # and self-extend (grouped positions go singly) keep the
+        # per-slot path; ineligible SLOTS (multimodal, draft-mirrored)
+        # fall back per-slot inside _prefill_step.
+        self._packed = (self.ecfg.prefill_packed and self._fam_llama
+                        and bus is None and self.ecfg.ga_n <= 1)
+        fuse = str(self.ecfg.prefill_packed_fuse)
+        try:
+            on_chip = jax.default_backend() not in ("cpu",)
+        except Exception:  # pragma: no cover
+            on_chip = False
+        self._pack_fuse = fuse == "1" or (fuse == "auto" and on_chip)
+        budget = self.ecfg.prefill_token_budget or 2 * self._chunk
+        self._pack_budget = max(1, min(budget, C))
+        # total-token pad buckets for the pack: the per-slot ladder
+        # capped at the budget, plus the budget itself (the loaded
+        # steady state) — a handful of compiled variants, warmed by
+        # precompile()
+        self._pack_buckets = tuple(sorted(
+            {min(b, self._pack_budget) for b in self._buckets}
+            | {self._pack_budget}))
+        # packed-prefill telemetry (metrics(); exercised by tests):
+        # dispatches, packed real tokens, segments, and pad waste
+        self._pack_stats = {"dispatches": 0, "tokens": 0, "segments": 0,
+                            "pad_tokens": 0}
 
         # grammar-constrained decoding (lazy: built on first grammar request)
         self._grammar_cache: dict[str, Any] = {}
@@ -1179,6 +1236,138 @@ class Engine:
         mu = jnp.asarray(mu).at[slot].set(new_mu)
         return ids, logprobs, ck, cv, keys, mu
 
+    def _packed_prefill_body(self, params, tokens, positions, seg_of,
+                             seg_slots, seg_start, seg_off, seg_len,
+                             final_mask, ck, cv, ring, ring_pos, bias, keys,
+                             slot_params, mu, continued: bool):
+        """RAGGED PACKED PREFILL step (one compiled program per
+        (total-token bucket, continued?)): every segment's KV rows are
+        written through its own slot's page table, FINAL segments (their
+        slot's whole remaining prompt fits this pack) sample their first
+        output token, non-final segments only write KV — the
+        generalization of the fused final-prefill groups to arbitrary
+        fresh/continued mixes. Pad segments carry the slot sentinel S,
+        so their state writes DROP and their RNG is never consumed; a
+        real non-final segment's gated write puts its OWN old value
+        back (slots are unique per pack, so the scatter stays
+        well-defined)."""
+        logits, ck, cv = self.family.ragged_prefill(
+            params, self.cfg, tokens, positions, seg_of, seg_slots,
+            seg_start, seg_off, seg_len, ck, cv, continued=continued)
+        slot_params = sampling.unpack_slot_params(slot_params)
+        sp_rows = jax.tree.map(
+            lambda a: jnp.take(jnp.asarray(a), seg_slots, axis=0),
+            slot_params)
+        ring_rows = jnp.take(jnp.asarray(ring), seg_slots, axis=0)
+        rpos_rows = jnp.take(jnp.asarray(ring_pos), seg_slots, axis=0)
+        bias_rows = jnp.take(bias, seg_slots, axis=0)
+        key_rows = jnp.take(keys, seg_slots, axis=0)
+        mu_rows = jnp.take(jnp.asarray(mu), seg_slots, axis=0)
+        ids, logprobs, new_keys, new_mu = sampling.sample(
+            logits, sp_rows, ring_rows, rpos_rows, bias_rows, key_rows,
+            mu_rows)
+        keys = keys.at[seg_slots].set(
+            jnp.where(final_mask[:, None], new_keys, key_rows),
+            mode="drop")
+        mu = jnp.asarray(mu).at[seg_slots].set(
+            jnp.where(final_mask, new_mu, mu_rows), mode="drop")
+        return ids, logprobs, ck, cv, keys, mu
+
+    def _get_packed_fn(self, bucket: int, continued: bool):
+        key = ("packed", bucket, continued)
+        fn = self._final_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._packed_prefill_body(*a,
+                                                     continued=continued),
+                donate_argnums=(9, 10, 14))
+            self._final_fns[key] = fn
+        return fn
+
+    def _fused_packed_body(self, params, tokens, ck, cv, lengths, ring,
+                           ring_pos, bias, keys, slot_params, active, mu,
+                           ov_pack, p_tokens, p_positions, seg_of, seg_slots,
+                           seg_start, seg_off, seg_len, final_mask,
+                           n_steps: int, continued: bool):
+        """FUSED packed admission — the packed generalization of
+        _fused_body: ragged-prefill EVERY queued segment (fresh or
+        continued), sample first tokens for the FINAL segments, and run
+        the decode burst with those slots already active — all in ONE
+        dispatch. This is the full llama_batch analogue (module doc):
+        under load one tick costs one dispatch for prompt ingestion AND
+        decode, so admission latency stops scaling with the number of
+        pending prompts. Pad / non-final segments are gated exactly as
+        in _packed_prefill_body (sentinel slots drop, finals-only state
+        writes)."""
+        sp = sampling.unpack_slot_params(slot_params)
+        tokens, lengths, ring, ring_pos, mu, pos_offset = \
+            self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
+                                    ov_pack)
+
+        logits, ck, cv = self.family.ragged_prefill(
+            params, self.cfg, p_tokens, p_positions, seg_of, seg_slots,
+            seg_start, seg_off, seg_len, ck, cv, continued=continued)
+        sp_rows = jax.tree.map(
+            lambda a: jnp.take(jnp.asarray(a), seg_slots, axis=0), sp)
+        ring_rows = jnp.take(ring, seg_slots, axis=0)
+        rpos_rows = jnp.take(ring_pos, seg_slots, axis=0)
+        ids_f, lps_f, new_keys, new_mu = sampling.sample(
+            logits, sp_rows, ring_rows, rpos_rows,
+            jnp.take(bias, seg_slots, axis=0),
+            jnp.take(keys, seg_slots, axis=0),
+            jnp.take(mu, seg_slots, axis=0))
+        gate = final_mask
+        keys = keys.at[seg_slots].set(
+            jnp.where(gate[:, None], new_keys,
+                      jnp.take(keys, seg_slots, axis=0)), mode="drop")
+        mu = mu.at[seg_slots].set(
+            jnp.where(gate, new_mu, jnp.take(mu, seg_slots, axis=0)),
+            mode="drop")
+        lengths = lengths.at[seg_slots].set(
+            jnp.where(gate, seg_start + seg_len,
+                      jnp.take(lengths, seg_slots, axis=0)), mode="drop")
+        tokens = tokens.at[seg_slots].set(
+            jnp.where(gate, ids_f, jnp.take(tokens, seg_slots, axis=0)),
+            mode="drop")
+        # the sampled first token enters the penalty ring (finals only)
+        rcol = rpos_rows % sampling.RING_N
+        ring = ring.at[seg_slots, rcol].set(
+            jnp.where(gate, ids_f, ring[seg_slots, rcol]), mode="drop")
+        ring_pos = ring_pos.at[seg_slots].set(
+            jnp.where(gate, rpos_rows + 1, rpos_rows), mode="drop")
+        active = jnp.asarray(active).at[seg_slots].set(
+            jnp.where(gate, True,
+                      jnp.take(jnp.asarray(active), seg_slots, axis=0)),
+            mode="drop")
+
+        step = self._make_scan_step(params, sp, bias, active,
+                                    (True, True, True), pos_offset)
+        carry = (tokens, ck, cv, lengths, ring, ring_pos, keys, mu)
+        carry, (ids_all, lps_all) = jax.lax.scan(step, carry, None,
+                                                 length=n_steps)
+        tokens, ck, cv, lengths, ring, ring_pos, keys, mu = carry
+        S = self.ecfg.num_slots
+        first_ids = jnp.zeros((S,), jnp.float32).at[seg_slots].set(
+            jnp.where(gate, ids_f.astype(jnp.float32), 0.0), mode="drop")
+        first_lps = jnp.zeros((S,), jnp.float32).at[seg_slots].set(
+            jnp.where(gate, lps_f, 0.0), mode="drop")
+        pack = jnp.concatenate(
+            [ids_all.astype(jnp.float32), lps_all, mu[None, :],
+             first_ids[None, :], first_lps[None, :]], axis=0)
+        return pack, ck, cv, keys, (tokens, lengths, ring, ring_pos, mu)
+
+    def _get_fused_packed_fn(self, bucket: int, continued: bool):
+        key = ("fused_packed", bucket, continued)
+        fn = self._burst_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda *a: self._fused_packed_body(
+                    *a, n_steps=self.ecfg.decode_burst,
+                    continued=continued),
+                donate_argnums=(2, 3, 8))
+            self._burst_fns[key] = fn
+        return fn
+
     def _get_burst_fn(self, n_steps: int, flags: tuple = (True, True, True)):
         key = (n_steps, flags)
         fn = self._burst_fns.get(key)
@@ -1352,6 +1541,35 @@ class Engine:
                     self.mu, no_ov,
                     np.zeros((B, bucket), np.int32), np.ones((B,), np.int32),
                     np.zeros((B,), np.int32), np.zeros((B,), np.int32))
+        if self._packed:
+            # ragged packed prefill variants: one program per
+            # (total-token bucket, continued?). The warmup pack is ALL
+            # PADS (sentinel segments/positions/slots), so it writes no
+            # KV rows and consumes no slot state — invisible to traffic.
+            S_ = self.ecfg.num_slots
+            C_ = self.ecfg.max_context
+            sent = np.full((S_,), S_, np.int32)
+            zs = np.zeros((S_,), np.int32)
+            nofinal = np.zeros((S_,), np.bool_)
+            for bucket in self._pack_buckets:
+                for continued in (False, True):
+                    pack_args = (np.zeros((bucket,), np.int32),
+                                 np.full((bucket,), C_, np.int32),
+                                 np.full((bucket,), S_, np.int32),
+                                 sent, zs, zs, zs, nofinal)
+                    fn = self._get_packed_fn(bucket, continued)
+                    _, _, self.ck, self.cv, self.rng_keys, _ = fn(
+                        self.params, *pack_args,
+                        self.ck, self.cv, self.ring, self.ring_pos,
+                        self.bias, self.rng_keys, spp, self.mu)
+                    if not self._pack_fuse:
+                        continue
+                    ffn = self._get_fused_packed_fn(bucket, continued)
+                    _, self.ck, self.cv, self.rng_keys, _ = ffn(
+                        self.params, self.cur_tokens, self.ck, self.cv,
+                        self.lengths, self.ring, self.ring_pos, self.bias,
+                        self.rng_keys, spp, self.active_dev, self.mu,
+                        no_ov, *pack_args)
         if self._hstore is not None:
             # host-tier transfer programs: the first eviction/restore
             # must not pay a cold compile mid-serving. Gather reads page
@@ -1522,6 +1740,13 @@ class Engine:
             "tokens_per_second_active": tok_s,
             "prompt_tokens_reused": self._reused_total,
             "uptime_s": time.monotonic() - self._load_time,
+            # ragged packed prefill (module doc): scheduling mode +
+            # per-dispatch packing efficiency (pad_tokens / tokens is
+            # the bucket-pad waste the packing removed per-slot)
+            "prefill_packed": self._packed,
+            "prefill_packed_fuse": self._pack_fuse,
+            "prefill_token_budget": self._pack_budget,
+            "packed_prefill": dict(self._pack_stats),
         }
         if self._paged:
             out["kv_layout"] = "paged"
@@ -2423,6 +2648,16 @@ class Engine:
             # positions, singly (never grouped or fused)
             return self._prefill_ga_piece(slot, s)
 
+        # RAGGED PACKED PREFILL (module doc): when the head slot is
+        # eligible, one dispatch packs EVERY eligible queued slot's
+        # pending tail under the token budget — replacing per-slot
+        # chunks and the same-bucket final groups. Ineligible slots
+        # (multimodal shapes, draft-mirrored spec slots) keep their
+        # place in the queue and take this per-slot path when they
+        # reach the head.
+        if self._packed and self._pack_eligible(s):
+            return self._prefill_step_packed()
+
         final, take, bucket, continued = self._prefill_plan(slot)
 
         def mm_rel(mm_pos, start, take, bucket):
@@ -2558,6 +2793,219 @@ class Engine:
             out_ids, logprobs, mu_out, t0)
         self._fifo.append(item)
         self._sync_q.put(item)
+        return True
+
+    def _pack_eligible(self, s: "_Slot") -> bool:
+        """May this slot's prompt tail ride a ragged pack? Multimodal
+        prompts keep their per-request injection shapes (own compiled
+        variants), self-extend slots need explicit grouped positions,
+        and spec_ok slots mirror every chunk into the draft cache via
+        the per-slot draft program — all three go singly."""
+        return s.mm_pos is None and s.ga_blocks == 0 and not s.spec_ok
+
+    def _prefill_step_packed(self) -> bool:
+        """ONE ragged dispatch for this tick's prompt ingestion: walk the
+        prefill queue in order, take each eligible slot's pending tail
+        (up to prefill_chunk per slot) until the token budget fills,
+        and run the packed program. Final segments (ordered FIRST so
+        the _PendingPrefill group indexes the output rows 0..F-1)
+        sample their first token and ride the dispatch FIFO exactly
+        like a legacy final group; non-final segments only advance
+        their written/committed bookkeeping — their next chunk packs
+        on a later tick, decode bursts interleaving in between."""
+        S = self.ecfg.num_slots
+        C = self.ecfg.max_context
+        budget = self._pack_budget
+        segs = []                   # (slot, s, take, final)
+        total = 0
+        for slot in self._prefill_queue:
+            if len(segs) >= S or total >= budget:
+                break
+            s = self.slots[slot]
+            if s is None or s.phase != "prefill" \
+                    or not self._pack_eligible(s) or not s.pending:
+                continue
+            take = min(len(s.pending), self._chunk, budget - total)
+            if take <= 0:
+                continue
+            segs.append((slot, s, take, take == len(s.pending)))
+            total += take
+        if not segs:
+            return False
+        # finals first: _process_prefill reads ids_np[b] for group row b
+        segs.sort(key=lambda t: not t[3])
+
+        t0 = time.monotonic()
+        for slot, s, take, _f in segs:
+            self._ensure_pages(slot, s.written + take)
+        self._commit_ptab()
+
+        bucket = next(b for b in self._pack_buckets if total <= b)
+        tokens = np.zeros((bucket,), np.int32)
+        positions = np.full((bucket,), C, np.int32)   # pad: scatter drops
+        seg_of = np.full((bucket,), S, np.int32)      # pad: own segment id
+        seg_slots = np.full((S,), S, np.int32)        # pad: state writes drop
+        seg_start = np.zeros((S,), np.int32)
+        seg_off = np.zeros((S,), np.int32)
+        seg_len = np.zeros((S,), np.int32)
+        final_mask = np.zeros((S,), np.bool_)
+        off = 0
+        for b, (slot, s, take, final) in enumerate(segs):
+            tokens[off:off + take] = s.pending[:take]
+            positions[off:off + take] = np.arange(s.written,
+                                                  s.written + take)
+            seg_of[off:off + take] = b
+            seg_slots[b] = slot
+            seg_start[b] = s.written
+            seg_off[b] = off
+            seg_len[b] = take
+            final_mask[b] = final
+            off += take
+        continued = any(s.written > 0 for _sl, s, _t, _f in segs)
+
+        args = [tokens, positions, seg_of]
+        meta = [seg_slots, seg_start, seg_off, seg_len, final_mask]
+        if self.mesh is not None:
+            # explicit replicated placement for the ragged batch
+            # (parallel/sharding.py ragged specs) — the pack has no
+            # slot/dp axis for GSPMD to infer
+            from jax.sharding import NamedSharding
+
+            from localai_tpu.parallel import sharding as shardlib
+
+            psh = NamedSharding(self.mesh, shardlib.ragged_pack_spec())
+            ssh = NamedSharding(self.mesh, shardlib.ragged_seg_spec())
+            args = [jax.device_put(a, psh) for a in args]
+            meta = [jax.device_put(a, ssh) for a in meta]
+
+        self._pack_stats["dispatches"] += 1
+        self._pack_stats["tokens"] += total
+        self._pack_stats["segments"] += len(segs)
+        self._pack_stats["pad_tokens"] += bucket - total
+
+        # FUSED packed admission: when the pipeline has room and a
+        # full-size burst is runnable, ragged prefill + first tokens +
+        # the decode burst go out as ONE dispatch (_fused_packed_body) —
+        # the packed generalization of _dispatch_fused, now covering
+        # continued segments too
+        finals = [(slot, s, take) for slot, s, take, f in segs if f]
+        if (finals and self._pack_fuse
+                and self._n_inflight_bursts() < self.ecfg.pipeline_depth
+                and self._pick_burst(
+                    extra=[(s.written + t, s.req.max_new_tokens)
+                           for _sl, s, t in finals])
+                == self.ecfg.decode_burst):
+            return self._dispatch_packed_fused(segs, args, meta, bucket,
+                                               continued, t0)
+
+        fn = self._get_packed_fn(bucket, continued)
+        # ring/ring_pos/mu copied: in-flight dispatches must not see
+        # host mutations (same aliasing rule as the legacy finals)
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(
+            self.params, *args, *meta, self.ck, self.cv,
+            self.ring.copy(), self.ring_pos.copy(), self.bias,
+            self.rng_keys, sampling.pack_slot_params(self.slot_params),
+            self.mu.copy())
+
+        group = []
+        t1 = time.monotonic()
+        for slot, s, take, final in segs:
+            s.pending = s.pending[take:]
+            s.written += take
+            if final:
+                if slot in self._prefill_queue:
+                    self._prefill_queue.remove(slot)
+                group.append((slot, s))
+            else:
+                # non-final: KV rows are committed in device dispatch
+                # order (same contract as the legacy chunk path)
+                s.committed = s.written
+                s.t_prefill_ms += (t1 - t0) * 1e3
+        self._tmark("dispatch_packed", t0)
+        if group:
+            item = _PendingPrefill(group, out_ids, logprobs, mu_out, t0)
+            self._fifo.append(item)
+            self._sync_q.put(item)
+        return True
+
+    def _dispatch_packed_fused(self, segs, args, meta, bucket: int,
+                               continued: bool, t0: float) -> bool:
+        """Dispatch ragged prefill + first-token sampling + a full decode
+        burst in ONE device call (_fused_packed_body). Final segments'
+        slots flip to decode NOW and their first tokens come back in the
+        burst's packed results (_process_burst group handling, identical
+        to the legacy fused path); non-final segments only advance their
+        prefill bookkeeping."""
+        S = self.ecfg.num_slots
+        C = self.ecfg.max_context
+        K = self.ecfg.decode_burst
+        group_snaps = []
+        t1 = time.monotonic()
+        for slot, s, take, final in segs:
+            s.pending = s.pending[take:]
+            s.written += take
+            if not final:
+                s.committed = s.written
+                s.t_prefill_ms += (t1 - t0) * 1e3
+                continue
+            s.phase = "decode"
+            # cache_len must reflect the prompt rows NOW (_pick_burst /
+            # _spec_eligible cost capacity against in-flight steps)
+            s.cache_len = s.written
+            self.lengths[slot] = s.written
+            self.active_dev[slot] = True
+            self._override.add(slot)
+            if slot in self._prefill_queue:
+                self._prefill_queue.remove(slot)
+            group_snaps.append((slot, s))
+        # budget-mask other decoding slots exactly like _dispatch_decode
+        active = self.active_dev.copy()
+        included = list(group_snaps)
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "decode" \
+                    or any(g == i for g, _ in group_snaps):
+                continue
+            if (s.req.max_new_tokens - s.n_decoded
+                    - self._inflight_steps(i) <= 0):
+                active[i] = False
+                continue
+            included.append((i, s))
+        for gslot, gs in group_snaps:
+            # pages for the prompt rows AND the K fused burst steps
+            self._ensure_pages(gslot, min(C, gs.written + K + 2))
+        for i, s in included:
+            if any(g == i for g, _ in group_snaps):
+                continue
+            self._ensure_pages(i, min(C, int(self.lengths[i])
+                                      + self._inflight_steps(i) + K + 2))
+        self._commit_ptab()
+        ov_mask = np.zeros((S,), np.bool_)
+        if self._chain is None:
+            chain = (self.cur_tokens.copy(), self.lengths.copy(),
+                     self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
+        else:
+            chain = self._chain
+            for i in self._override:
+                ov_mask[i] = True
+        self._override.clear()
+        fn = self._get_fused_packed_fn(bucket, continued)
+        spp = sampling.pack_slot_params(self.slot_params)
+        ovp = self._pack_ov(ov_mask)
+        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+            self.params, chain[0], self.ck, self.cv, chain[1],
+            chain[2], chain[3], self.bias, self.rng_keys,
+            spp, active, chain[4], ovp, *args, *meta)
+        self._tmark("dispatch_packed_fused", t0)
+        if self._trace:
+            s_ = self._tstats.setdefault("burst_steps", [0.0, 0])
+            s_[0] += K
+            s_[1] += 1
+            occ = self._tstats.setdefault("active_slots", [0.0, 0])
+            occ[0] += len(included)
+            occ[1] += 1
+        b = _Burst(K, included, pack, group=group_snaps, t_dispatch=t0)
+        self._fifo.append(b)
+        self._sync_q.put(b)
         return True
 
     def _dispatch_fused(self, group, bucket: int) -> bool:

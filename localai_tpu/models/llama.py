@@ -342,6 +342,105 @@ def prefill(
     return logits, cache_k, cache_v
 
 
+def _ragged_pallas_ok(lck, N: int, cfg: LlamaConfig) -> bool:
+    """Use the Pallas ragged-prefill kernel for this pack? Real TPU
+    backend, plain-float PAGED cache, pack-key blocks divide the bucket,
+    and the per-head online-softmax scratch (m/l/acc over all N*G query
+    rows, f32) fits comfortably in VMEM."""
+    if not (_pallas_decode() and kvcache.is_paged(lck)
+            and not kvcache.is_quant(lck)):
+        return False
+    if N % min(N, 128):
+        return False
+    scratch = cfg.num_kv_heads * N * cfg.q_per_kv * (cfg.head_dim_ + 2) * 4
+    return scratch <= 8 * 1024 * 1024
+
+
+def ragged_prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,      # [N] int32 packed prompt tokens (pads 0)
+    positions: jax.Array,   # [N] int32 absolute cache position (pads: C)
+    seg_of: jax.Array,      # [N] int32 segment per token (pads: sentinel)
+    seg_slots: jax.Array,   # [B] int32 slot per segment (pads: sentinel)
+    seg_start: jax.Array,   # [B] int32 committed rows per segment
+    seg_off: jax.Array,     # [B] int32 pack offset of each segment
+    seg_len: jax.Array,     # [B] int32 tokens in each segment (pads: 0)
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    continued: bool = False,  # STATIC: True when any seg_start may be > 0
+):
+    """RAGGED PACKED PREFILL: process the prompt tails of up to B slots
+    as ONE [N]-token batch — per-segment causal self-attention plus
+    (``continued`` only) attention over each slot's committed cache
+    rows, with the new KV rows written through every token's own slot's
+    page table in one ragged scatter (ops/kvcache.py::scatter_ragged).
+
+    This is the reference's llama_batch packing (engine.py module doc:
+    grpc-server.cpp:1671+ packs prompt chunks of all slots into one
+    batch) expressed TPU-natively: the pack pads only to a small set of
+    TOTAL-token buckets, so a tick's worth of ragged prompt tails costs
+    one dispatch and near-zero pad compute instead of one padded
+    per-slot bucket each (see engine.py packed-prefill scheduling).
+
+    Returns (logits [B, V] at each segment's last packed token,
+    cache_k, cache_v). Pad segments (seg_len == 0) produce garbage
+    logits rows the caller must gate on; their tokens write nothing
+    (position sentinel C drops the scatter) and their state is never
+    sampled (slot sentinel drops the engine's key/mu writes).
+    """
+    from localai_tpu.ops.ragged_prefill import ragged_prefill_attention
+
+    N = tokens.shape[0]
+    B = seg_slots.shape[0]
+    sin, cos = rope_frequencies(cfg, positions[None, :])
+    x = _embed_rows(params["embed"], tokens, cfg.dtype)[None]   # [1, N, D]
+    # per-token target slot for the ragged KV scatter (pads ride the
+    # clipped lookup; their position sentinel drops the write)
+    slot_of = jnp.take(seg_slots, jnp.minimum(seg_of, B - 1))
+
+    def layer_fn(carry, layer):
+        x, ck, cv = carry
+        li = layer.pop("_idx")
+        h = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(h, layer, cfg)     # [1, N, {H|KV}, hd]
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        lck, lcv = kvcache.layer(ck, li), kvcache.layer(cv, li)
+        # committed rows are read BEFORE this pack's scatter (the same
+        # no-read-after-write rule as every other attention path here)
+        if continued and _ragged_pallas_ok(lck, N, cfg):
+            from localai_tpu.ops.pallas.ragged_prefill import (
+                ragged_prefill_attention_pallas)
+
+            attn = ragged_prefill_attention_pallas(
+                q[0], k[0], v[0], lck["pages"], lcv["pages"], lck["ptab"],
+                seg_slots, seg_start, seg_off, seg_len, cfg.q_per_kv,
+                pkb=min(N, 128))
+        else:
+            attn = ragged_prefill_attention(
+                q[0], k[0], v[0], seg_of, seg_slots, seg_start, lck, lcv,
+                cfg.q_per_kv, continued=continued)
+        ck = kvcache.scatter_ragged(ck, li, slot_of, positions, k[0])
+        cv = kvcache.scatter_ragged(cv, li, slot_of, positions, v[0])
+        x = x + jnp.einsum("bth,hd->btd", attn[None].reshape(1, N, -1),
+                           _mat(layer["wo"], x.dtype))
+        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, layer)
+        return (x, ck, cv), None
+
+    layers = dict(params["layers"])
+    layers["_idx"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, cache_k, cache_v), _ = jax.lax.scan(layer_fn, (x, cache_k, cache_v),
+                                            layers)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # hidden state at each segment's LAST packed token (pads clamp to 0)
+    last = jnp.maximum(seg_off + seg_len - 1, 0)
+    hs = jnp.take(x[0], last, axis=0)                           # [B, D]
+    logits = _unembed(hs[None], params, cfg)[0]
+    return logits, cache_k, cache_v
+
+
 def _decode_attend_write(q1, k1, v1, lck, lcv, lengths, cfg: LlamaConfig):
     """One decode token per slot: attend + scatter the new K/V row.
 
@@ -361,13 +460,25 @@ def _decode_attend_write(q1, k1, v1, lck, lcv, lengths, cfg: LlamaConfig):
     slot_idx = jnp.arange(S, dtype=jnp.int32)
     mode = _decode_attn_mode()
     if kvcache.is_paged(lck):
-        # PAGED layout: the ragged paged kernel on real TPU backends
+        # PAGED layout: the ragged paged kernels on real TPU backends
         # (pages consumed in place, page table scalar-prefetched into the
-        # block pipeline); pure-jnp page gather + append-attention
-        # everywhere else (JAX_PLATFORMS=cpu tests, int8 paged caches —
+        # block pipeline; int8 caches use the {q, scales} kernel variant
+        # so pages stay quantized in HBM); pure-jnp page gather +
+        # append-attention everywhere else (JAX_PLATFORMS=cpu tests —
         # the gathered {"q","s"} rows fold scales exactly like the
         # contiguous path)
-        if _pallas_decode() and not kvcache.is_quant(lck):
+        if _pallas_decode() and kvcache.is_quant(lck):
+            # int8 pages stay quantized in HBM: the {q, scales} kernel
+            # variant folds the scales in VMEM (ROADMAP PR-1 follow-up —
+            # previously int8 paged decode fell back to the dense jnp
+            # gather even where the pallas kernel ran)
+            from localai_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_append_quant)
+
+            attn = paged_decode_attention_append_quant(
+                q1, k1, v1, lck["pages"], lck["scales"], lcv["pages"],
+                lcv["scales"], lck["ptab"], lengths, cfg.q_per_kv)
+        elif _pallas_decode():
             from localai_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention_append)
 

@@ -343,6 +343,21 @@ def scatter_prefill(cache: Cache, li, rows: jax.Array, cols: jax.Array,
         new_kv.astype(cache.dtype), mode="drop")
 
 
+def scatter_ragged(cache: Cache, li, slot_of: jax.Array, cols: jax.Array,
+                   new_kv: jax.Array) -> Cache:
+    """RAGGED packed-prefill scatter: cache[li, slot_of[n], cols[n]] =
+    new_kv[n] for a [N]-token pack whose tokens belong to many slots.
+
+    slot_of/cols: [N] int32; new_kv: [N, KV, hd] float. Pad tokens use
+    the column sentinel C (paged: any col >= MP*page_size) so the write
+    DROPS — the same OOB discipline every other scatter here uses. For
+    the paged layout the write goes through each token's own slot's page
+    table, i.e. this is the "ragged scatter into the page pool" of the
+    packed prefill step (engine.py)."""
+    return scatter_prefill(cache, li, slot_of[None], cols[None],
+                           new_kv[None])
+
+
 def tree_slot_update(cache: Cache, dst, new_rows: Cache) -> Cache:
     """cache[:, dst] = new_rows per leaf (fork / restore bodies).
 

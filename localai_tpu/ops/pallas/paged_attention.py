@@ -24,8 +24,12 @@ every layer of every step; this kernel reads pages IN PLACE:
     fallback used on CPU (kvcache.gather_all_rows) and the parity
     reference in tests.
 
-Plain float caches only (like decode_attention.py): the int8 paged
-cache folds scales through the jnp fallback path.
+The int8 paged cache has its own kernel variant below
+(paged_decode_attention_append_quant): pages stay int8 in HBM and the
+per-(row, kv-head) scales are folded OUTSIDE the contraction — scores
+for K, probs for V — exactly the fold ops/attention.py::_split_cache
+does on the jnp path, so HBM reads stay 1 byte/element on the decode
+hot path instead of falling back to the dense gather.
 """
 
 from __future__ import annotations
@@ -153,4 +157,131 @@ def paged_decode_attention_append(q, new_k, new_v, pages_k, pages_v, ptab,
         out_shape=jax.ShapeDtypeStruct((S, kv_heads, G, hd), q.dtype),
         interpret=interpret,
     )(ptab, lengths, qg, nk, nv, pages_k, pages_v)
+    return out.reshape(S, H, hd)
+
+
+def _kernel_quant(ptab_ref, len_ref, q_ref, nk_ref, nv_ref, kp_ref, sk_ref,
+                  vp_ref, sv_ref, out_ref, m_ref, l_ref, acc_ref):
+    """_kernel with the int8 {q, scales} page representation: k/v pages
+    arrive int8 and their per-(row, kv-head) scales ride as separate
+    [1, Pg, KV] blocks of the same page walk. The scale fold matches
+    ops/attention.py (scores * s_k per key column; probs * s_v before
+    the value contraction) so no dequantized page ever materializes.
+    The current token's own k/v (nk/nv) stays float — the engine holds
+    it in registers; only cache rows are quantized."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    mp = pl.num_programs(1)
+    length = len_ref[s]
+    pg = kp_ref.shape[1]
+    kv_heads = kp_ref.shape[2]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    for h in range(kv_heads):
+        q = q_ref[0, h]                               # [G, hd]
+        k = kp_ref[0, :, h, :]                        # [Pg, hd] int8
+        v = vp_ref[0, :, h, :]
+        sk = sk_ref[0, :, h]                          # [Pg] f32
+        sv = sv_ref[0, :, h]
+        scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+        qf = q.astype(jnp.float32) * scale
+        scores = jax.lax.dot_general(                 # [G, Pg] NT matmul
+            qf, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        scores = scores * sk[None, :]                 # key scale fold
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + p * pg
+        scores = jnp.where(col < length, scores, _NEG_INF)
+
+        m_prev = m_ref[h]                             # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(scores - m_new)               # [G, Pg]
+        l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+            probs * sv[None, :], v.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[h] = m_new
+
+    @pl.when(p == mp - 1)
+    def _finish():
+        for h in range(kv_heads):
+            q = q_ref[0, h]
+            nk = nk_ref[0, h]                         # [1, hd] float
+            nv = nv_ref[0, h]
+            scale = jax.lax.rsqrt(jnp.float32(q.shape[-1]))
+            qf = q.astype(jnp.float32) * scale
+            s_self = jnp.sum(qf * nk.astype(jnp.float32), axis=-1,
+                             keepdims=True)           # [G, 1]
+            m_fin = jnp.maximum(m_ref[h], s_self)
+            alpha = jnp.exp(m_ref[h] - m_fin)
+            p_self = jnp.exp(s_self - m_fin)
+            denom = l_ref[h] * alpha + p_self
+            out = (acc_ref[h] * alpha + p_self * nv.astype(jnp.float32))
+            out_ref[0, h] = (out / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_per_kv", "interpret"))
+def paged_decode_attention_append_quant(q, new_k, new_v, pages_k, scales_k,
+                                        pages_v, scales_v, ptab, lengths,
+                                        q_per_kv: int,
+                                        interpret: bool = False):
+    """Int8-KV variant of paged_decode_attention_append: pages_k/v are
+    int8 [n_pages, page_size, KV, hd] and scales_k/v are their f32
+    [n_pages, page_size, KV] companions (the {"pages","scales"} leaves
+    of the quantized paged cache, ops/kvcache.py). new_k/new_v stay
+    float. Semantics match decode_attention_append over the
+    dense-gathered {"q","s"} rows (the jnp fallback / parity
+    reference)."""
+    S, H, hd = q.shape
+    n_pages, pg, kv_heads, _ = pages_k.shape
+    mp = ptab.shape[1]
+    G = q_per_kv
+    qg = q.reshape(S, kv_heads, G, hd)
+    nk = new_k.reshape(S, kv_heads, 1, hd)
+    nv = new_v.reshape(S, kv_heads, 1, hd)
+
+    def page_map(s, p, ptab_ref, len_ref):
+        n_valid = (len_ref[s] + pg - 1) // pg
+        last = jnp.maximum(n_valid - 1, 0)
+        pid = ptab_ref[s, jnp.minimum(p, last)]
+        return (jnp.clip(pid, 0, n_pages - 1), 0, 0, 0)
+
+    def scale_map(s, p, ptab_ref, len_ref):
+        return page_map(s, p, ptab_ref, len_ref)[:3]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # ptab, lengths
+        grid=(S, mp),
+        in_specs=[
+            pl.BlockSpec((1, kv_heads, G, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv_heads, 1, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv_heads, 1, hd),
+                         lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, pg, kv_heads, hd), page_map),
+            pl.BlockSpec((1, pg, kv_heads), scale_map),
+            pl.BlockSpec((1, pg, kv_heads, hd), page_map),
+            pl.BlockSpec((1, pg, kv_heads), scale_map),
+        ],
+        out_specs=pl.BlockSpec((1, kv_heads, G, hd),
+                               lambda s, p, pt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kv_heads, G, 1), jnp.float32),    # running max
+            pltpu.VMEM((kv_heads, G, 1), jnp.float32),    # running denom
+            pltpu.VMEM((kv_heads, G, hd), jnp.float32),   # running out
+        ],
+    )
+    out = pl.pallas_call(
+        _kernel_quant,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, kv_heads, G, hd), q.dtype),
+        interpret=interpret,
+    )(ptab, lengths, qg, nk, nv, pages_k, scales_k, pages_v, scales_v)
     return out.reshape(S, H, hd)

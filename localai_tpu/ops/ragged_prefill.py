@@ -1,0 +1,148 @@
+"""RAGGED PACKED PREFILL attention — jnp reference / CPU fallback.
+
+One scheduler tick's worth of prompt tails from MANY slots is packed
+into a single [N]-token batch ("Ragged Paged Attention", PAPERS.md
+arxiv 2604.15464; TokenWeave, arxiv 2505.11329, motivates collapsing
+the per-slot dispatches): segment b occupies the contiguous pack range
+[seg_off[b], seg_off[b] + seg_len[b]) and its token at pack index n sits
+at absolute cache position seg_start[b] + (n - seg_off[b]) of slot
+seg_slots[b]. Each query attends over
+
+  * its slot's COMMITTED cache rows [0, seg_start[b])  (continued
+    segments only — prefix reuse, chunked long prompts, context-shift
+    re-prefill), and
+  * the pack's own keys at indices m <= n with seg_of[m] == seg_of[n]
+    (intra-chunk causal attention).
+
+Together that is exactly full causal attention for every packed token —
+the same math the per-slot paths (ops/attention.py causal_attention /
+mixed_prefill_attention) compute, so greedy output is preserved.
+
+The cache term walks segments with a lax.scan and SELECT-accumulates
+per-token online-softmax state (each token belongs to exactly one
+segment, so the "online" merge is a select): peak memory stays one
+segment's [KV, G, N, C] score block instead of a dense [B, ...] blow-up,
+mirroring the page walk the Pallas kernel
+(ops/pallas/ragged_prefill.py) does in VMEM. Follows the module rule of
+ops/attention.py: cache rows are read BEFORE the caller scatters this
+pack's keys, and int8 {"q","s"} rows fold their scales outside the
+contraction (scores for K, probs for V) — no dequantized cache
+materializes.
+
+Pad conventions (shared with the engine packer and the Pallas kernel):
+pad tokens carry seg_of == B_sentinel (>= the real segment count) so
+they only ever attend other pads (a pad always sees itself — no NaN
+softmax rows); pad SEGMENTS carry seg_len == 0 and a sentinel slot id,
+so they select nothing and their (clipped) cache gather is dead weight
+the masks discard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.ops import kvcache
+
+_NEG_INF = -1e30
+
+
+def _rows_scales(rows):
+    """Split a gathered row set into (float rows, scales|None) — the
+    int8 fold contract of ops/attention.py::_split_cache."""
+    if isinstance(rows, dict):
+        return rows["q"], rows["s"]
+    return rows, None
+
+
+def ragged_prefill_attention(q, chunk_k, chunk_v, seg_of, seg_slots,
+                             seg_start, lck, lcv, q_per_kv: int,
+                             continued: bool = False):
+    """Packed ragged prefill attention (see module doc).
+
+    q: [N, H, hd]; chunk_k/chunk_v: [N, KV, hd] (this pack's keys/values,
+    NOT yet scattered into the cache); seg_of: [N] int32 (pad sentinel >=
+    B); seg_slots/seg_start: [B] int32 (pad slot ids may be any value —
+    pad segments match no token); lck/lcv: single-layer cache in any
+    layout (paged / contiguous / int8), only read when ``continued``.
+    ``continued`` is STATIC: False compiles the pure intra-pack program
+    (fresh prompts have no committed rows). Returns [N, H, hd] (q.dtype).
+    """
+    dtype = q.dtype
+    N, H, hd = q.shape
+    KV = chunk_k.shape[1]
+    G = q_per_kv
+    qg = q.reshape(N, KV, G, hd)
+    scale = jnp.float32(1.0) / jnp.sqrt(hd).astype(jnp.float32)
+    sc_pack = jnp.einsum("nkgd,mkd->kgnm", qg,
+                         chunk_k).astype(jnp.float32) * scale
+    idx = jnp.arange(N, dtype=jnp.int32)
+    mask_pack = (seg_of[:, None] == seg_of[None, :]) \
+        & (idx[None, :] <= idx[:, None])                       # [N(q), N(k)]
+    sc_pack = jnp.where(mask_pack[None, None], sc_pack, _NEG_INF)
+    if not continued:
+        probs = jax.nn.softmax(sc_pack, axis=-1).astype(dtype)
+        out = jnp.einsum("kgnm,mkd->nkgd", probs, chunk_v)
+        return out.reshape(N, H, hd)
+
+    B = seg_slots.shape[0]
+    m0 = jnp.full((KV, G, N), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((KV, G, N), jnp.float32)
+    a0 = jnp.zeros((KV, G, N, hd), jnp.float32)
+    # ONE batched page gather for all segments' committed rows (the
+    # per-layer cost the decode fallback already pays per step); the
+    # scan then walks the stacked rows — per-iteration work is one
+    # [N, C] score block, never a gather
+    k_all, sk_all = _rows_scales(kvcache.gather_layer_rows(lck, seg_slots))
+    v_all, sv_all = _rows_scales(kvcache.gather_layer_rows(lcv, seg_slots))
+    def seg_term(carry, seg):
+        m_c, l_c, a_c = carry
+        if sk_all is None:
+            b, start, k_rows, v_rows = seg
+            sk = sv = None
+        else:
+            b, start, k_rows, sk, v_rows, sv = seg
+        C = k_rows.shape[0]
+        sc = jnp.einsum("nkgd,ckd->kgnc", qg,
+                        k_rows.astype(dtype)).astype(jnp.float32) * scale
+        if sk is not None:
+            sc = sc * sk.T[:, None, None, :]                 # [KV,1,1,C]
+        mask = (seg_of == b)[:, None] \
+            & (jnp.arange(C, dtype=jnp.int32)[None, :] < start)  # [N, C]
+        sc = jnp.where(mask[None, None], sc, _NEG_INF)
+        m_b = jnp.max(sc, axis=-1)                           # [KV, G, N]
+        # explicit zero for masked columns: an all-masked row has
+        # m_b == _NEG_INF and exp(sc - m_b) would be exp(0) == 1 there
+        p = jnp.where(mask[None, None], jnp.exp(sc - m_b[..., None]), 0.0)
+        l_b = jnp.sum(p, axis=-1)
+        if sv is not None:
+            p = p * sv.T[:, None, None, :]
+        a_b = jnp.einsum("kgnc,ckd->kgnd", p,
+                         v_rows.astype(jnp.float32))
+        sel = (seg_of == b)[None, None, :]                   # [1, 1, N]
+        return (jnp.where(sel, m_b, m_c), jnp.where(sel, l_b, l_c),
+                jnp.where(sel[..., None], a_b, a_c)), None
+
+    bs = jnp.arange(B, dtype=jnp.int32)
+    xs = (bs, seg_start, k_all, v_all) if sk_all is None else \
+        (bs, seg_start, k_all, sk_all, v_all, sv_all)
+    (m_c, l_c, a_c), _ = jax.lax.scan(seg_term, (m0, l0, a0), xs)
+    return _combine(qg, chunk_v, sc_pack, mask_pack, m_c, l_c, a_c,
+                    N, H, hd, dtype)
+
+
+def _combine(qg, chunk_v, sc_pack, mask_pack, m_c, l_c, a_c, N, H, hd,
+             dtype):
+    """Joint softmax over [cache cols, pack cols] via the accumulated
+    cache-side stats: every token has at least its own pack key, so
+    m_tot is finite and the denominator is positive."""
+    m_pack = jnp.max(sc_pack, axis=-1)                       # [KV, G, N]
+    m_tot = jnp.maximum(m_c, m_pack)
+    p_pack = jnp.where(mask_pack[None, None],
+                       jnp.exp(sc_pack - m_tot[..., None]), 0.0)
+    alpha = jnp.exp(m_c - m_tot)                             # 0 when no cache
+    denom = l_c * alpha + jnp.sum(p_pack, axis=-1)
+    out = (a_c * alpha[..., None]
+           + jnp.einsum("kgnm,mkd->kgnd", p_pack,
+                        chunk_v.astype(jnp.float32))) / denom[..., None]
+    return out.transpose(2, 0, 1, 3).reshape(N, H, hd).astype(dtype)

@@ -101,6 +101,21 @@ def batch_spec() -> P:
     return P("dp")
 
 
+def ragged_pack_spec() -> P:
+    # [N] packed-prefill token axis (tokens/positions/seg_of): REPLICATED
+    # — segments are ragged, so no token range maps to a fixed slot/dp
+    # shard; parallelism comes from the head/F splits of the params the
+    # pack flows through (tp), exactly like the decode token vector
+    return P(None)
+
+
+def ragged_seg_spec() -> P:
+    # [B] per-segment metadata (slots/start/offsets/lengths): replicated
+    # — every shard resolves the same segment -> slot mapping, and the
+    # tables are tiny (like page_table_spec)
+    return P(None)
+
+
 def fit_spec(mesh: Mesh, shape, spec: P) -> P:
     """Drop (replicate) any spec axis whose dimension the mesh degree
     does not divide — e.g. a 258-row test vocab on tp=8. Every case the

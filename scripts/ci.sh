@@ -7,6 +7,11 @@
 # Fails (rc != 0) if either stage fails. Environment knobs:
 #   TIER1_BUDGET_S          tier-1 wall clock (default 870, run_tier1.sh)
 #   LOCALAI_BENCH_BUDGET_S  bench smoke wall clock (default 300 here)
+#
+# Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
+# the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
+# phase — the number the ragged packed prefill exists to hold down — so
+# regressions show up in every CI log without reading the JSON blob.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -15,7 +20,24 @@ echo "== ci: tier-1 =="
 scripts/run_tier1.sh
 
 echo "== ci: bench smoke =="
+smoke_out=$(mktemp)
 LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-300}" \
-    python bench.py --smoke
+    python bench.py --smoke | tee "$smoke_out"
+
+echo "== ci: tracked =="
+python - "$smoke_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{"):
+        line = json.loads(ln)
+pp = line.get("packed_prefill") or {}
+print(f"TTFT_LOADED_UNLOADED_RATIO={line.get('ttft_loaded_unloaded_ratio')} "
+      f"packed_vs_sequential_speedup={pp.get('ttft_speedup')} "
+      f"greedy_match={pp.get('greedy_match')}")
+PY
+rm -f "$smoke_out"
 
 echo "== ci: OK =="
