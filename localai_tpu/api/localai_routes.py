@@ -49,6 +49,8 @@ def register(app: web.Application):
     r.add_get("/system", system_info)
     r.add_get("/version", version)
     r.add_get("/v1/tokenMetrics", token_metrics)
+    r.add_get("/debug/trace", debug_trace)
+    r.add_get("/debug/profile", debug_profile)
     # gallery (reference: routes/localai.go:14-44)
     r.add_post("/models/apply", models_apply)
     r.add_post("/models/delete/{name}", models_delete)
@@ -107,6 +109,10 @@ _TTFT_GAUGES = (("queue_wait", "queue_wait"),
                 ("prefill_dispatch", "prefill_dispatch"))
 # packed-prefill scheduling totals (engine.py metrics()["packed_prefill"])
 _PACKED_COUNTERS = ("dispatches", "tokens", "segments", "pad_tokens")
+# engine-owned latency histograms (engine.py metrics()["histograms"]):
+# re-exposed verbatim with proper _bucket/_sum/_count exposition
+_LATENCY_HISTOGRAMS = ("ttft_seconds", "itl_seconds",
+                       "decode_burst_seconds", "prefill_dispatch_seconds")
 
 
 def _refresh_engine_metrics(state):
@@ -120,7 +126,8 @@ def _refresh_engine_metrics(state):
 
     for g in ("kv_pool_pages", "kv_pool_oversubscription",
               "prefix_cache_entries", "kv_offload_host_bytes",
-              "ttft_samples",
+              "ttft_samples", "queue_depth", "slots_in_flight",
+              *_LATENCY_HISTOGRAMS,
               *(f"ttft_{m}_p50_ms" for _k, m in _TTFT_GAUGES),
               *(f"prefill_packed_{k}_total" for k in _PACKED_COUNTERS),
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
@@ -144,6 +151,16 @@ def _refresh_engine_metrics(state):
                                   td.get(skey, 0.0), f'model="{name}"')
             METRICS.set_gauge("ttft_samples", td.get("n", 0),
                               f'model="{name}"')
+        # scheduler load gauges + latency histograms (any layout)
+        METRICS.set_gauge("queue_depth", stats.get("queued", 0),
+                          f'model="{name}"')
+        METRICS.set_gauge("slots_in_flight", stats.get("slots_active", 0),
+                          f'model="{name}"')
+        for hname, h in (stats.get("histograms") or {}).items():
+            if hname in _LATENCY_HISTOGRAMS:
+                METRICS.set_histogram(hname, f'model="{name}"',
+                                      h.get("le", ()), h.get("counts", ()),
+                                      h.get("sum", 0.0), h.get("count", 0))
         pp = stats.get("packed_prefill")
         if pp and stats.get("prefill_packed"):
             for key in _PACKED_COUNTERS:
@@ -184,6 +201,74 @@ async def metrics(request):
         return api_error("metrics disabled", 404)
     await state.run_blocking(_refresh_engine_metrics, state)
     return web.Response(text=METRICS.render(), content_type="text/plain")
+
+
+def _collect_traces(state) -> dict:
+    """Merge every loaded model's span ring into ONE Chrome trace JSON:
+    each backend becomes its own process (pid) with its slot/scheduler
+    tracks under it. Backends without GetTrace (fake/tts/...) and RPC
+    failures are skipped — a debug surface must never 500 because one
+    backend is old."""
+    import json as _json
+
+    events: list = []
+    pid = 0
+    for name in state.caps.loader.list_loaded():
+        lm = state.caps.loader.get(name)
+        if lm is None:
+            continue
+        try:
+            r = lm.client.get_trace(timeout=5.0)
+            trace = _json.loads(bytes(r.message).decode("utf-8"))
+        except Exception:
+            continue
+        pid += 1
+        for ev in trace.get("traceEvents", []):
+            ev["pid"] = pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev["args"] = {"name": f"localai-engine:{name}"}
+            events.append(ev)
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+async def debug_trace(request):
+    """Chrome trace-event JSON of every loaded engine's span ring —
+    load the response body at https://ui.perfetto.dev."""
+    state = get_state(request)
+    trace = await state.run_blocking(_collect_traces, state)
+    return web.json_response(trace)
+
+
+async def debug_profile(request):
+    """Capture a jax.profiler device trace on a loaded backend:
+    GET /debug/profile?seconds=N[&model=name]. Returns the backend-local
+    directory holding the TensorBoard/perfetto capture."""
+    state = get_state(request)
+    try:
+        seconds = float(request.query.get("seconds", 3))
+    except ValueError:
+        return api_error("seconds must be a number", 400)
+    model = request.query.get("model", "")
+    loaded = state.caps.loader.list_loaded()
+    if model and model not in loaded:
+        return api_error(f"model {model} is not loaded", 404)
+    names = [model] if model else list(loaded)
+    for name in names:
+        lm = state.caps.loader.get(name)
+        if lm is None:
+            continue
+        try:
+            r = await state.run_blocking(
+                lm.client.profile, seconds, max(30.0, seconds + 30.0))
+        except Exception as e:
+            return api_error(f"profile RPC failed: {e}", 502)
+        return web.json_response({
+            "model": name,
+            "success": bool(r.success),
+            "capture_dir": r.message,
+            "seconds": seconds,
+        }, status=200 if r.success else 500)
+    return api_error("no profilable model loaded", 404)
 
 
 # --------------- tts / sound ---------------

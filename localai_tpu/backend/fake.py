@@ -129,6 +129,21 @@ class FakeServicer(BackendServicer):
     def GetMetrics(self, request, context):
         return pb.MetricsResponse(slots_total=1, slots_active=0)
 
+    def GetTrace(self, request, context):
+        # minimal valid Chrome trace (the /debug/trace merge path needs
+        # a backend that answers; shape mirrors services/tracing.py)
+        import json
+
+        return pb.Reply(message=json.dumps({
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                 "args": {"name": "fake"}},
+                {"name": "decode", "cat": "engine", "ph": "X", "pid": 1,
+                 "tid": 1, "ts": 0.0, "dur": 100.0, "args": {}},
+            ],
+        }).encode("utf-8"))
+
     # --- stores: real in-memory implementation ---
     def StoresSet(self, request, context):
         for k, v in zip(request.keys, request.values):

@@ -310,6 +310,17 @@ class EngineServicer(BackendServicer):
             **({"prefill_packed_fuse": ppf} if (ppf := str(
                 extra.get("prefill_packed_fuse", "") or "")) in
                ("auto", "0", "1") else {}),
+            # observability (this PR): trace=0 turns the span tracer into
+            # a hot-path no-op; trace_ring_size bounds retained spans;
+            # slow_request_ms logs a span decomposition when TTFT or e2e
+            # exceeds the threshold
+            **({"trace": False} if str(
+                extra.get("trace", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"trace_ring_size": trs} if (trs := int(
+                extra.get("trace_ring_size", 0) or 0)) > 0 else {}),
+            **({"slow_request_ms": srm} if (srm := int(
+                extra.get("slow_request_ms", 0) or 0)) > 0 else {}),
         )
         draft = None
         if request.draft_model:
@@ -544,6 +555,47 @@ class EngineServicer(BackendServicer):
             uptime_s=m["uptime_s"],
             prompt_json_for_slot=stats_json,
         )
+
+    # ---- observability side-channel (service.py METHODS additions) ----
+
+    def GetTrace(self, request, context) -> pb.Reply:
+        """Chrome trace-event JSON of the engine's span ring. The span
+        data itself is process-local (the engine lives in this backend
+        subprocess); the core's /debug/trace endpoint merges one of
+        these per loaded model."""
+        self._require_ready(context)
+        try:
+            payload = json.dumps(self.engine.trace_events())
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"trace export failed: {type(e).__name__}: {e}")
+        return pb.Reply(message=payload.encode("utf-8"))
+
+    def Profile(self, request, context) -> pb.Result:
+        """Capture a jax.profiler trace (TensorBoard/perfetto format) for
+        the requested number of seconds while the engine keeps serving.
+        Request rides PredictOptions.prompt as JSON {"seconds": N}."""
+        self._require_ready(context)
+        import tempfile
+        import time as _time
+
+        try:
+            req = json.loads(request.prompt or "{}")
+        except ValueError:
+            req = {}
+        seconds = min(60.0, max(0.1, float(req.get("seconds", 3) or 3)))
+        out_dir = req.get("dir") or tempfile.mkdtemp(prefix="localai-prof-")
+        try:
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+            _time.sleep(seconds)
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return pb.Result(
+                success=False,
+                message=f"profiler capture failed: {type(e).__name__}: {e}")
+        return pb.Result(success=True, message=out_dir)
 
     def _require_ready(self, context):
         if self.engine is None:

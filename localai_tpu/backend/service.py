@@ -37,6 +37,13 @@ METHODS = {
     "Rerank": (pb.RerankRequest, pb.RerankResult, False),
     "Status": (pb.HealthMessage, pb.StatusResponse, False),
     "GetMetrics": (pb.MetricsRequest, pb.MetricsResponse, False),
+    # observability side-channel (no new proto messages — the hand-rolled
+    # stubs can't grow fields, but METHODS can grow RPCs):
+    #   GetTrace: Reply.message carries Chrome trace-event JSON (UTF-8)
+    #   Profile:  PredictOptions.prompt carries a JSON {"seconds": N};
+    #             Result.message is the capture directory
+    "GetTrace": (pb.MetricsRequest, pb.Reply, False),
+    "Profile": (pb.PredictOptions, pb.Result, False),
     "StoresSet": (pb.StoresSetOptions, pb.Result, False),
     "StoresDelete": (pb.StoresDeleteOptions, pb.Result, False),
     "StoresGet": (pb.StoresGetOptions, pb.StoresGetResult, False),
@@ -189,6 +196,19 @@ class BackendClient:
 
     def get_metrics(self, timeout: float = 10.0) -> pb.MetricsResponse:
         return self._stubs["GetMetrics"](pb.MetricsRequest(), timeout=timeout)
+
+    def get_trace(self, timeout: float = 10.0) -> pb.Reply:
+        """Chrome trace-event JSON of the engine's span ring (UTF-8 in
+        Reply.message)."""
+        return self._stubs["GetTrace"](pb.MetricsRequest(), timeout=timeout)
+
+    def profile(self, seconds: float, timeout: float = 120.0) -> pb.Result:
+        """Capture a jax.profiler trace for `seconds`; Result.message is
+        the directory holding the capture."""
+        import json
+
+        opts = pb.PredictOptions(prompt=json.dumps({"seconds": seconds}))
+        return self._stubs["Profile"](opts, timeout=timeout)
 
     def stores_set(self, req: pb.StoresSetOptions, timeout: float = 60.0) -> pb.Result:
         return self._stubs["StoresSet"](req, timeout=timeout)

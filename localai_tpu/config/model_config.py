@@ -139,7 +139,11 @@ class ModelConfig:
     # per-slot bucketed prefill), prefill_token_budget=N (max packed
     # prompt tokens per scheduler tick, 0 = engine auto) and
     # prefill_packed_fuse=auto|0|1 (fuse the packed step with the
-    # decode burst; auto = real-chip backends only). The known
+    # decode burst; auto = real-chip backends only), or the
+    # observability knobs trace=0|1 (request-lifecycle span tracer,
+    # default on), trace_ring_size=N (retained spans, default 4096) and
+    # slow_request_ms=N (log a span decomposition when TTFT or e2e
+    # exceeds N ms; 0 = off). The known
     # knobs are value-validated in validate() so a typo fails at config
     # scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
@@ -228,12 +232,14 @@ class ModelConfig:
             elif k in ("kv_page_size", "kv_pool_pages",
                        "kv_prefix_cache_min_rows",
                        "kv_host_pool_mb",
-                       "prefill_token_budget") and not v.isdigit():
+                       "prefill_token_budget",
+                       "trace_ring_size",
+                       "slow_request_ms") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
             elif k in ("kv_prefix_cache", "kv_offload",
-                       "prefill_packed") and v.lower() not in bool_vals:
+                       "prefill_packed", "trace") and v.lower() not in bool_vals:
                 problems.append(
                     f"{k} must be one of {bool_vals}, got {v!r}")
             elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):

@@ -58,6 +58,32 @@ from localai_tpu.engine.detok import IncrementalDetokenizer
 from localai_tpu.models import llama
 from localai_tpu.ops import kvcache
 
+# Engine-owned latency histograms, re-exposed over /metrics as real
+# Prometheus histograms (services/metrics.py set_histogram). Buckets in
+# seconds, sized for serving latencies: sub-ms dispatch costs up to
+# multi-second TTFTs.
+_HIST_BUCKETS = {
+    "ttft_seconds": (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5, 5.0, 10.0, 30.0),
+    "itl_seconds": (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0),
+    "decode_burst_seconds": (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                             0.1, 0.25, 0.5, 1.0, 2.5),
+    "prefill_dispatch_seconds": (0.0005, 0.001, 0.0025, 0.005, 0.01,
+                                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+}
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -154,6 +180,17 @@ class EngineConfig:
     # governs capacity).
     ga_n: int = 1
     ga_w: int = 512
+    # request-lifecycle tracing (services/tracing.py): per-request spans
+    # (queue_wait / admission / prefill dispatch / decode burst / detok /
+    # stream flush) in a fixed ring, host-vs-device decomposition in
+    # metrics()["trace"], Chrome trace export via trace_events().
+    # trace=0 makes every record() call a no-op on the hot path.
+    trace: bool = True
+    trace_ring_size: int = 4096
+    # slow-request structured log: when a finished request's TTFT or
+    # end-to-end wall exceeds this many ms, log one WARNING with the
+    # span decomposition. 0 disables.
+    slow_request_ms: int = 0
 
 
 @dataclasses.dataclass
@@ -235,8 +272,8 @@ class _Burst:
     convoy on the client's transfer path and can invert completion
     order, which metastably collapsed serving throughput ~7x)."""
     __slots__ = ("n_steps", "slots", "pack", "group", "t_dispatch",
-                 "pack_np", "ids_np", "lps_np", "first_ids", "first_lps",
-                 "folded", "skip_slots", "ready", "err")
+                 "t_ready", "pack_np", "ids_np", "lps_np", "first_ids",
+                 "first_lps", "folded", "skip_slots", "ready", "err")
 
     def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0):
         self.n_steps = n_steps
@@ -244,6 +281,7 @@ class _Burst:
         self.pack = pack            # device [2K+1(+2), S] f32
         self.group = list(group)    # fused-admission slots (subset of slots)
         self.t_dispatch = t_dispatch
+        self.t_ready = 0.0          # sync-worker completion stamp
         self.pack_np = None
         self.ids_np = None
         self.lps_np = None
@@ -266,7 +304,7 @@ class _PendingPrefill:
     loop never blocks on a prefill that is still queued behind in-flight
     decode bursts — r3 polled is_ready(), which lies on this platform."""
     __slots__ = ("group", "out_ids", "logprobs", "mu_out", "t0",
-                 "ids_np", "lps_np", "mu_np", "ready", "err")
+                 "t_ready", "ids_np", "lps_np", "mu_np", "ready", "err")
 
     def __init__(self, group, out_ids, logprobs, mu_out, t0):
         self.group = group
@@ -274,6 +312,7 @@ class _PendingPrefill:
         self.logprobs = logprobs
         self.mu_out = mu_out
         self.t0 = t0
+        self.t_ready = 0.0          # sync-worker completion stamp
         self.ids_np = self.lps_np = self.mu_np = None
         self.ready = threading.Event()
         self.err = None
@@ -599,6 +638,19 @@ class Engine:
 
         self._trace = _os.environ.get("LOCALAI_ENGINE_TRACE", "") == "1"
         self._tstats: dict = {}
+        # request-lifecycle span tracer (services/tracing.py): always
+        # constructed; trace=0 makes record() a no-op on the hot path
+        from localai_tpu.services.tracing import RingTracer
+
+        self.tracer = RingTracer(self.ecfg.trace_ring_size,
+                                 enabled=bool(self.ecfg.trace))
+        self._slow_ms = float(self.ecfg.slow_request_ms)
+        # per-request latency histograms (re-exposed by /metrics as real
+        # Prometheus histograms): name -> [bucket counts + +Inf, sum, n].
+        # Single writer (engine thread); metrics() reads are snapshots.
+        self._hists = {name: [[0] * (len(b) + 1), 0.0, 0]
+                       for name, b in _HIST_BUCKETS.items()}
+        self._t_last_burst = 0.0
         # non-None while _process_burst coalesces per-slot events
         self._sink_buf: Optional[dict] = None
         # in-flight prefill dedup: leader slot -> [(sib_slot, snap, leader
@@ -639,6 +691,11 @@ class Engine:
                         "kv page offload failed")
                     continue
                 item.err = e
+            # the ready-set stamp IS the device-completion observation
+            # point (block_until_ready/is_ready lie on this platform):
+            # span t_dispatch->t_ready is device time, t_ready->process
+            # pickup is finish-detection latency
+            item.t_ready = time.monotonic()
             item.ready.set()
             self._wake.set()
 
@@ -648,6 +705,28 @@ class Engine:
             s = self._tstats.setdefault(key, [0.0, 0])
             s[0] += t - t0
             s[1] += 1
+
+    def _hobserve(self, name: str, seconds: float):
+        h = self._hists[name]
+        for i, b in enumerate(_HIST_BUCKETS[name]):
+            if seconds <= b:
+                h[0][i] += 1
+                break
+        else:
+            h[0][-1] += 1
+        h[1] += seconds
+        h[2] += 1
+
+    def _annot(self, name: str):
+        """jax.profiler annotation around a dispatch, so device traces
+        captured via /debug/profile line up with engine spans. No-op
+        context when trace=0 or the profiler is unavailable."""
+        if not self.tracer.enabled:
+            return _NULL_CTX
+        try:
+            return jax.profiler.TraceAnnotation(name)
+        except Exception:  # pragma: no cover - profiler unavailable
+            return _NULL_CTX
 
     def _make_state_shardings(self) -> Optional[dict]:
         """NamedShardings for the engine's device state when serving on a
@@ -852,12 +931,16 @@ class Engine:
         idx = np.full((B,), victims[-1][3], np.int32)
         for i, (_k, _p, _d, page) in enumerate(victims):
             idx[i] = page
-        k_rows, v_rows = self._get_offload_gather_fn(B)(self.ck, self.cv,
-                                                        idx)
+        with self._annot("kv_offload_gather"):
+            k_rows, v_rows = self._get_offload_gather_fn(B)(self.ck,
+                                                            self.cv, idx)
         item = _PendingOffload([(k, p, d) for k, p, d, _pg in victims],
                                k_rows, v_rows, self._hstore)
         self._sync_q.put(item)
         self._tmark("offload_dispatch", t0)
+        if self.tracer.enabled:
+            self.tracer.record("offload_dispatch", "engine", t0,
+                               time.monotonic(), args={"pages": n})
 
     def _restore_offloaded(self, slot: int, host_hits: list) -> int:
         """Upload offloaded pages into freshly allocated device rows and
@@ -906,9 +989,10 @@ class Engine:
                                  a.dtype)], axis=1)
             return a
 
-        self.ck, self.cv = self._get_restore_scatter_fn(B)(
-            self.ck, self.cv, idx, stack(lambda e: e.k),
-            stack(lambda e: e.v))
+        with self._annot("kv_restore_scatter"):
+            self.ck, self.cv = self._get_restore_scatter_fn(B)(
+                self.ck, self.cv, idx, stack(lambda e: e.k),
+                stack(lambda e: e.v))
         for e, p in zip(host_hits, pages[:n]):
             pool.adopt(slot, p)
             # restored pages re-enter the device tier immediately: the
@@ -917,6 +1001,9 @@ class Engine:
             self._pcache.attach(pool, e.key, e.parent, p, e.depth)
         self._hstore.note_restore(n)
         self._tmark("restore_dispatch", t0)
+        if self.tracer.enabled:
+            self.tracer.record("restore_dispatch", "engine", t0,
+                               time.monotonic(), args={"pages": n})
         return n
 
     def _share_prefix(self, src: int, dst: int, rows: int) -> int:
@@ -1781,7 +1868,23 @@ class Engine:
                 "prefill_dispatch": round(pf[mid], 1),
                 "n": len(d),
             }
+        # latency histograms (re-exposed by /metrics as Prometheus
+        # histograms) + span-tracer aggregates incl. the host-vs-device
+        # walltime decomposition
+        out["histograms"] = {
+            name: {"le": list(_HIST_BUCKETS[name]),
+                   "counts": list(h[0]),
+                   "sum": round(h[1], 6), "count": h[2]}
+            for name, h in self._hists.items()}
+        out["trace"] = self.tracer.summary()
         return out
+
+    def trace_events(self) -> dict:
+        """The span ring as Chrome trace-event JSON (perfetto-loadable):
+        one track per slot + scheduler + engine dispatch tracks."""
+        from localai_tpu.services import tracing
+
+        return tracing.chrome_trace(self.tracer)
 
     # ---------- grammar-constrained decoding ----------
 
@@ -1946,6 +2049,7 @@ class Engine:
         while not self._stop:
             try:
                 t0 = time.monotonic()
+                t_tick = t0
                 admitted = self._admit()
                 self._tmark("admit", t0)
                 t0 = time.monotonic()
@@ -1953,6 +2057,14 @@ class Engine:
                 self._tmark("prefill", t0)
                 dispatched = self._dispatch_decode()
                 drained = self._drain_fifo(can_feed=dispatched or prefilled)
+                if self.tracer.enabled and (admitted or prefilled
+                                            or dispatched or drained):
+                    self.tracer.record(
+                        "tick", "sched", t_tick, time.monotonic(),
+                        args={"admitted": int(admitted),
+                              "prefilled": int(prefilled),
+                              "dispatched": int(dispatched),
+                              "drained": int(drained)})
                 if not (admitted or prefilled or dispatched or drained):
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -2055,6 +2167,7 @@ class Engine:
             raise ValueError(
                 "multimodal injection is not supported in multi-host "
                 "lockstep mode")
+        t_adm = time.monotonic()
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
         # truncate the prompt head, keeping the tail (reference semantics:
@@ -2196,6 +2309,17 @@ class Engine:
         self._cache_tokens[slot] = [] if mm_pos is not None else list(ids)
         self.slots[slot] = s
         self._prefill_queue.append(slot)
+        tr = self.tracer
+        if tr.enabled:
+            t1 = time.monotonic()
+            if req.t_submit:
+                tr.record("queue_wait", f"slot{slot}", req.t_submit,
+                          s.t_start, rid=req.request_id)
+            # admission covers prefix-cache splice + host-tier restore
+            # (_paged_admission / _restore_prompt_cache above)
+            tr.record("admission", f"slot{slot}", t_adm, t1,
+                      rid=req.request_id,
+                      args={"prompt_tokens": len(ids), "reused_rows": common})
         return slot, ids, s
 
     def _start_fork_sibling(self, req: GenRequest, leader_slot: int,
@@ -2685,7 +2809,8 @@ class Engine:
                     self._bus.send("chunk", bucket=bucket, tokens=tokens,
                                    seq_len=args[2], slot=args[5],
                                    start=args[6])
-            self.ck, self.cv = fn(*args)
+            with self._annot("prefill_chunk"):
+                self.ck, self.cv = fn(*args)
             if self.dck is not None and s.spec_ok:
                 # mirror the prompt into the draft cache (speculative
                 # rounds need the same context; see engine/speculative.py)
@@ -2696,7 +2821,13 @@ class Engine:
             s.pending = s.pending[take:]
             s.written += take
             s.committed = s.written
-            s.t_prefill_ms += (time.monotonic() - t0) * 1e3
+            t1 = time.monotonic()
+            s.t_prefill_ms += (t1 - t0) * 1e3
+            self._hobserve("prefill_dispatch_seconds", t1 - t0)
+            if self.tracer.enabled:
+                self.tracer.record("prefill_chunk", f"slot{slot}", t0, t1,
+                                   rid=s.req.request_id,
+                                   args={"tokens": take, "bucket": bucket})
             return True
 
         # collect a batch of fresh finals with the same bucket (queue order);
@@ -2765,7 +2896,9 @@ class Engine:
                                seq_len=seq_len, slots_v=slots_v,
                                start_v=start_v, ring=args[7],
                                ring_pos=args[8], spp=args[11], mu=args[12])
-        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(*args)
+        with self._annot("prefill_final"):
+            out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = \
+                fn(*args)
         if self.dck is not None and any(
                 self.slots[g].spec_ok for g, _ in group):
             # draft ingests the same prompt rows (no sampling needed);
@@ -2793,6 +2926,11 @@ class Engine:
             out_ids, logprobs, mu_out, t0)
         self._fifo.append(item)
         self._sync_q.put(item)
+        t1 = time.monotonic()
+        self._hobserve("prefill_dispatch_seconds", t1 - t0)
+        if self.tracer.enabled:
+            self.tracer.record("prefill_dispatch", "engine", t0, t1,
+                               args={"slots": len(group), "bucket": bucket})
         return True
 
     def _pack_eligible(self, s: "_Slot") -> bool:
@@ -2901,11 +3039,12 @@ class Engine:
         fn = self._get_packed_fn(bucket, continued)
         # ring/ring_pos/mu copied: in-flight dispatches must not see
         # host mutations (same aliasing rule as the legacy finals)
-        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(
-            self.params, *args, *meta, self.ck, self.cv,
-            self.ring.copy(), self.ring_pos.copy(), self.bias,
-            self.rng_keys, sampling.pack_slot_params(self.slot_params),
-            self.mu.copy())
+        with self._annot("prefill_pack"):
+            out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = fn(
+                self.params, *args, *meta, self.ck, self.cv,
+                self.ring.copy(), self.ring_pos.copy(), self.bias,
+                self.rng_keys, sampling.pack_slot_params(self.slot_params),
+                self.mu.copy())
 
         group = []
         t1 = time.monotonic()
@@ -2922,6 +3061,11 @@ class Engine:
                 s.committed = s.written
                 s.t_prefill_ms += (t1 - t0) * 1e3
         self._tmark("dispatch_packed", t0)
+        self._hobserve("prefill_dispatch_seconds", t1 - t0)
+        if self.tracer.enabled:
+            self.tracer.record("prefill_dispatch", "engine", t0, t1,
+                               args={"tokens": total, "segments": len(segs),
+                                     "bucket": bucket, "packed": True})
         if group:
             item = _PendingPrefill(group, out_ids, logprobs, mu_out, t0)
             self._fifo.append(item)
@@ -2991,11 +3135,18 @@ class Engine:
         fn = self._get_fused_packed_fn(bucket, continued)
         spp = sampling.pack_slot_params(self.slot_params)
         ovp = self._pack_ov(ov_mask)
-        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
-            self.params, chain[0], self.ck, self.cv, chain[1],
-            chain[2], chain[3], self.bias, self.rng_keys,
-            spp, active, chain[4], ovp, *args, *meta)
+        with self._annot("prefill_pack_fused"):
+            pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+                self.params, chain[0], self.ck, self.cv, chain[1],
+                chain[2], chain[3], self.bias, self.rng_keys,
+                spp, active, chain[4], ovp, *args, *meta)
         self._tmark("dispatch_packed_fused", t0)
+        self._hobserve("prefill_dispatch_seconds", time.monotonic() - t0)
+        if self.tracer.enabled:
+            self.tracer.record("prefill_dispatch", "engine", t0,
+                               time.monotonic(),
+                               args={"segments": len(segs), "bucket": bucket,
+                                     "packed": True, "fused": True})
         if self._trace:
             s_ = self._tstats.setdefault("burst_steps", [0.0, 0])
             s_[0] += K
@@ -3089,17 +3240,24 @@ class Engine:
                            spp=spp, active=active, ovp=ovp,
                            p_tokens=p_tokens, p_seq=p_seq, p_slots=p_slots,
                            p_start=p_start)
-        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
-            self.params, chain[0], self.ck, self.cv, chain[1],
-            chain[2], chain[3], self.bias, self.rng_keys,
-            spp, active, chain[4], ovp,
-            p_tokens, p_seq, p_slots, p_start,
-        )
+        with self._annot("prefill_fused"):
+            pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+                self.params, chain[0], self.ck, self.cv, chain[1],
+                chain[2], chain[3], self.bias, self.rng_keys,
+                spp, active, chain[4], ovp,
+                p_tokens, p_seq, p_slots, p_start,
+            )
         if self.dck is not None and any(s.spec_ok for _, s in group_snaps):
             self.dck, self.dcv = self._get_draft_chunk_fn(bucket)(
                 self.draft_params, p_tokens, p_seq, self.dck, self.dcv,
                 p_slots, p_start)
         self._tmark("dispatch_fused", t_d)
+        self._hobserve("prefill_dispatch_seconds", time.monotonic() - t_d)
+        if self.tracer.enabled:
+            self.tracer.record("prefill_dispatch", "engine", t_d,
+                               time.monotonic(),
+                               args={"slots": len(group_snaps),
+                                     "bucket": bucket, "fused": True})
         if self._trace:
             s_ = self._tstats.setdefault("burst_steps", [0.0, 0])
             s_[0] += K
@@ -3132,6 +3290,14 @@ class Engine:
             if self.slots[gslot] is snap:
                 self.mu[gslot] = mu_np[gslot]
         t1 = time.monotonic()
+        trc = self.tracer
+        if trc.enabled and item.t_ready:
+            # dispatch start -> sync-worker ready: device compute (plus
+            # queueing behind earlier dispatches); ready -> now: the
+            # engine loop's pickup lag
+            trc.record("prefill_device", "engine", t0, item.t_ready,
+                       args={"slots": len(group)})
+            trc.record("finish_detect", "engine", item.t_ready, t1)
 
         for b, (gslot, snap) in enumerate(group):
             gs = self.slots[gslot]
@@ -3153,6 +3319,12 @@ class Engine:
             gs.t_prefill_ms += (t1 - t0) * 1e3
             if gs.t_first_token == 0.0:
                 gs.t_first_token = t1
+                if gs.req.t_submit:
+                    self._hobserve("ttft_seconds", t1 - gs.req.t_submit)
+                if trc.enabled:
+                    trc.record("prefill", f"slot{gslot}", t0, t1,
+                               rid=gs.req.request_id,
+                               args={"prompt_tokens": gs.prompt_len})
             self._emit_token(gslot, first_id, float(lps_np[b]))
         # leaders just committed: fork their rows to any waiting siblings
         # (vanished leaders downgrade the siblings to full prefills)
@@ -3427,12 +3599,18 @@ class Engine:
             self._bus.send("burst", k=n_steps, flags=flags,
                            chain=chain if cold else None,
                            spp=spp, active=active, ovp=ovp)
-        pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
-            self.params, chain[0], self.ck, self.cv, chain[1],
-            chain[2], chain[3], self.bias, self.rng_keys,
-            spp, active, chain[4], ovp,
-        )
+        with self._annot("decode_burst"):
+            pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+                self.params, chain[0], self.ck, self.cv, chain[1],
+                chain[2], chain[3], self.bias, self.rng_keys,
+                spp, active, chain[4], ovp,
+            )
         self._tmark("dispatch", t_d)
+        if self.tracer.enabled:
+            self.tracer.record("decode_dispatch", "engine", t_d,
+                               time.monotonic(),
+                               args={"steps": n_steps,
+                                     "slots": len(included)})
         if self._trace:
             s = self._tstats.setdefault("burst_steps", [0.0, 0])
             s[0] += n_steps
@@ -3498,6 +3676,29 @@ class Engine:
             dt = (time.monotonic() - b.t_dispatch) * 1e3
             self._burst_ms_ema += 0.2 * (dt - self._burst_ms_ema)
         t0 = time.monotonic()
+        t_proc = t0
+        tr = self.tracer
+        if b.t_dispatch:
+            t_rdy = b.t_ready or t_proc
+            self._hobserve("decode_burst_seconds",
+                           max(0.0, t_rdy - b.t_dispatch))
+            if self._t_last_burst:
+                # burst-to-burst cadence / steps: the stream-visible ITL
+                self._hobserve("itl_seconds",
+                               max(0.0, t_proc - self._t_last_burst)
+                               / max(1, b.n_steps))
+            self._t_last_burst = t_proc
+            if tr.enabled:
+                tr.record("decode_burst_device", "engine",
+                          b.t_dispatch, t_rdy,
+                          args={"steps": b.n_steps, "slots": len(b.slots),
+                                "fused": bool(b.group)})
+                tr.record("finish_detect", "engine", t_rdy, t_proc)
+                for i, snap in b.slots:
+                    if self._live(i, snap) and i not in b.skip_slots:
+                        tr.record("decode", f"slot{i}", b.t_dispatch, t_rdy,
+                                  rid=snap.req.request_id,
+                                  args={"steps": b.n_steps})
         self._sink_buf = {}
         rolled: set = set()   # grammar slots rolled back mid-burst
         try:
@@ -3516,6 +3717,14 @@ class Engine:
                     0.0, (t1 - b.t_dispatch) * 1e3 - self._burst_ms_ema)
                 if snap.t_first_token == 0.0:
                     snap.t_first_token = t1
+                    if snap.req.t_submit:
+                        self._hobserve("ttft_seconds",
+                                       t1 - snap.req.t_submit)
+                    if tr.enabled:
+                        tr.record("prefill", f"slot{i}", b.t_dispatch, t1,
+                                  rid=snap.req.request_id,
+                                  args={"prompt_tokens": snap.prompt_len,
+                                        "fused": True})
                 if not self._emit_token(i, int(b.first_ids[i]),
                                         float(b.first_lps[i])):
                     rolled.add(i)
@@ -3536,9 +3745,16 @@ class Engine:
             self._tmark("emit_loop", t0)
             self._flush_grammar_bias()
             t0 = time.monotonic()
+            if tr.enabled:
+                # emit = detok + stop-scan walltime; flush is separate
+                tr.record("emit", "engine", t_proc, t0,
+                          args={"steps": b.n_steps})
             for (_slot, out), evs in buf.items():
                 out.put(evs[0] if len(evs) == 1 else _merge_events(evs))
             self._tmark("emit_flush", t0)
+            if tr.enabled:
+                tr.record("stream_flush", "engine", t0, time.monotonic(),
+                          args={"streams": len(buf)})
 
     def _emit_token(self, slot: int, token_id: int, logprob: float) -> bool:
         """Emit one token for a slot. Returns False when the token was a
@@ -3629,6 +3845,32 @@ class Engine:
             with self._decomp_lock:
                 self._ttft_decomp.append(
                     (queue_wait_ms, admit_to_first_ms, s.t_prefill_ms))
+            t_done = time.monotonic()
+            if self.tracer.enabled and s.req.t_submit:
+                self.tracer.record("request", f"slot{slot}",
+                                   s.req.t_submit, t_done,
+                                   rid=s.req.request_id,
+                                   args={"completion_tokens": s.n_decoded,
+                                         "finish": finish})
+            if self._slow_ms > 0:
+                ttft_ms = queue_wait_ms + admit_to_first_ms
+                e2e_ms = (t_done - s.req.t_submit) * 1e3 \
+                    if s.req.t_submit else 0.0
+                if ttft_ms > self._slow_ms or e2e_ms > self._slow_ms:
+                    import json as _json
+                    import logging as _logging
+
+                    _logging.getLogger(__name__).warning(
+                        "slow request %s: %s", s.req.request_id,
+                        _json.dumps({
+                            "threshold_ms": self._slow_ms,
+                            "e2e_ms": round(e2e_ms, 1),
+                            "ttft_ms": round(ttft_ms, 1),
+                            "completion_tokens": s.n_decoded,
+                            "spans": {k: (round(v, 1)
+                                          if isinstance(v, float) else v)
+                                      for k, v in ev.timings.items()},
+                        }, sort_keys=True))
             self._save_prompt_cache(slot, s)
             self._release_slot(slot)
             if buf is not None:

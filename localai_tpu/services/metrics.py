@@ -35,6 +35,10 @@ class Metrics:
         # them, which Prometheus rate() handles as a counter reset).
         self._gauges: dict = {}
         self._abs_counters: dict = {}
+        # named histograms: locally observed (observe_histogram) or
+        # pull-updated from backend snapshots (set_histogram). Keyed
+        # (name, labels) -> [buckets(tuple), counts(+Inf last), sum, n]
+        self._named_hists: dict = {}
 
     def observe_api_call(self, method: str, path: str, seconds: float):
         with self._lock:
@@ -61,11 +65,40 @@ class Metrics:
         with self._lock:
             self._abs_counters[(name, labels)] = int(value)
 
+    def observe_histogram(self, name: str, seconds: float,
+                          labels: str = "", buckets=None):
+        """Observe one sample into a named histogram (cumulative
+        exposition with _bucket/_sum/_count happens in render())."""
+        buckets = tuple(buckets) if buckets else _BUCKETS
+        with self._lock:
+            h = self._named_hists.get((name, labels))
+            if h is None or h[0] != buckets:
+                h = self._named_hists[(name, labels)] = [
+                    buckets, [0] * (len(buckets) + 1), 0.0, 0]
+            for i, b in enumerate(buckets):
+                if seconds <= b:
+                    h[1][i] += 1
+                    break
+            else:
+                h[1][-1] += 1
+            h[2] += seconds
+            h[3] += 1
+
+    def set_histogram(self, name: str, labels: str, buckets, counts,
+                      hsum: float, count: int):
+        """Expose a backend-owned histogram snapshot (non-cumulative
+        per-bucket counts, +Inf last) at its current state — same
+        pull-updated contract as set_counter."""
+        with self._lock:
+            self._named_hists[(name, labels)] = [
+                tuple(buckets), [int(c) for c in counts],
+                float(hsum), int(count)]
+
     def clear_instrument(self, name: str):
         """Drop every series of a pull-updated instrument (a model was
         unloaded; stale per-model series must not linger)."""
         with self._lock:
-            for d in (self._gauges, self._abs_counters):
+            for d in (self._gauges, self._abs_counters, self._named_hists):
                 for k in [k for k in d if k[0] == name]:
                     del d[k]
 
@@ -86,6 +119,25 @@ class Metrics:
                 lines.append(f'localai_api_call_bucket{{{labels},le="+Inf"}} {cum}')
                 lines.append(f'localai_api_call_sum{{{labels}}} {total:.6f}')
                 lines.append(f'localai_api_call_count{{{labels}}} {count}')
+            hseen = set()
+            for (name, labels), (buckets, counts, hsum, count) in sorted(
+                    self._named_hists.items()):
+                if name not in hseen:
+                    hseen.add(name)
+                    lines.append(f"# TYPE localai_{name} histogram")
+                sep = "," if labels else ""
+                cum = 0
+                for i, b in enumerate(buckets):
+                    cum += counts[i]
+                    lines.append(
+                        f'localai_{name}_bucket{{{labels}{sep}le="{b}"}} '
+                        f'{cum}')
+                cum += counts[-1]
+                lines.append(
+                    f'localai_{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+                label_part = f"{{{labels}}}" if labels else ""
+                lines.append(f'localai_{name}_sum{label_part} {hsum:.6f}')
+                lines.append(f'localai_{name}_count{label_part} {count}')
             for (name, labels), v in sorted(self._counters.items()):
                 label_part = f"{{{labels}}}" if labels else ""
                 lines.append(f"localai_{name}{label_part} {v}")

@@ -1,0 +1,193 @@
+"""Request-lifecycle tracing: a low-overhead ring-buffer span tracer.
+
+The engine records timestamped spans (queue_wait, admission, prefill
+dispatches, decode bursts, detok, stream flush) keyed by the request's
+correlation id into a fixed-size ring — bounded memory, no allocation
+churn beyond one tuple per span, one lock. Aggregate totals per span
+name survive ring wraparound, so the host-walltime vs device-time
+decomposition (``summary()["decomp_ms"]``) reflects the whole engine
+lifetime even when individual spans have been overwritten.
+
+``chrome_trace()`` renders the ring as Chrome trace-event JSON
+(https://ui.perfetto.dev loads it directly): one track per slot plus
+one for the scheduler tick loop and one for engine-level dispatches.
+
+The reference exposes per-slot timings as plain struct fields
+(grpc-server.cpp:2465-2488 slot timing block); this module is that
+layer rebuilt around the dispatch-first engine, where "where did the
+wall-clock go" must distinguish host dispatch cost from device compute
+observed at sync-worker completion.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+# Span names counted as HOST loop work in the decomposition: time the
+# engine thread spends dispatching / detokenizing / flushing, measured
+# as plain walltime deltas on the engine thread.
+HOST_SPANS = frozenset({
+    "admission",
+    "prefill_chunk",
+    "prefill_dispatch",
+    "decode_dispatch",
+    "emit",
+    "stream_flush",
+    "offload_dispatch",
+    "restore_dispatch",
+})
+
+# Span names counted as DEVICE time: dispatch call → sync-worker
+# ready-set (the only trustworthy device-completion observation point
+# on this platform — block_until_ready/is_ready lie here, see
+# engine._sync_worker).
+DEVICE_SPANS = frozenset({
+    "prefill_device",
+    "decode_burst_device",
+})
+
+# Sync-worker ready-set → engine loop picking the result up: the
+# finish-detection latency called out in the r5 verdict.
+FINISH_DETECT_SPAN = "finish_detect"
+
+
+class RingTracer:
+    """Fixed-size span ring with always-on per-name aggregates.
+
+    ``record()`` is the only hot-path entry point; when ``enabled`` is
+    False it returns immediately without taking the lock (trace=0 is a
+    true no-op). Spans are (name, track, t0, t1, rid, args) tuples with
+    t0/t1 from time.monotonic().
+    """
+
+    def __init__(self, size: int = 4096, enabled: bool = True):
+        self.size = max(1, int(size))
+        self.enabled = bool(enabled) and int(size) > 0
+        self._buf: list = [None] * self.size
+        self._n = 0  # total spans ever recorded (monotonic)
+        self._agg: dict = {}  # name -> [total_s, count]
+        self._lock = threading.Lock()
+        # Trace epoch: chrome_trace timestamps are relative to this so
+        # perfetto's timeline starts near zero.
+        self.t0 = time.monotonic()
+        self.t0_epoch = time.time()
+
+    def record(self, name, track, t0, t1, rid="", args=None):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._buf[self._n % self.size] = (name, track, t0, t1, rid, args)
+            self._n += 1
+            a = self._agg.get(name)
+            if a is None:
+                a = self._agg[name] = [0.0, 0]
+            a[0] += t1 - t0
+            a[1] += 1
+
+    def spans(self) -> list:
+        """Retained spans, oldest first, as dicts."""
+        with self._lock:
+            n = self._n
+            if n <= self.size:
+                raw = self._buf[:n]
+            else:
+                cut = n % self.size
+                raw = self._buf[cut:] + self._buf[:cut]
+        return [
+            {"name": s[0], "track": s[1], "t0": s[2], "t1": s[3],
+             "rid": s[4], "args": s[5]}
+            for s in raw if s is not None
+        ]
+
+    def reset(self):
+        with self._lock:
+            self._buf = [None] * self.size
+            self._n = 0
+            self._agg = {}
+            self.t0 = time.monotonic()
+            self.t0_epoch = time.time()
+
+    def summary(self) -> dict:
+        """Aggregate totals + the host-vs-device decomposition."""
+        if not self.enabled:
+            return {"enabled": False}
+        with self._lock:
+            n = self._n
+            agg = {k: (v[0], v[1]) for k, v in self._agg.items()}
+        by_span = {
+            name: {"total_ms": round(tot * 1e3, 3), "count": cnt,
+                   "avg_ms": round(tot * 1e3 / cnt, 4) if cnt else 0.0}
+            for name, (tot, cnt) in sorted(agg.items())
+        }
+        host = sum(t for name, (t, _) in agg.items() if name in HOST_SPANS)
+        device = sum(t for name, (t, _) in agg.items() if name in DEVICE_SPANS)
+        fin = agg.get(FINISH_DETECT_SPAN, (0.0, 0))[0]
+        return {
+            "enabled": True,
+            "ring_size": self.size,
+            "spans_recorded": n,
+            "spans_dropped": max(0, n - self.size),
+            "by_span_ms": by_span,
+            "decomp_ms": {
+                "host_loop": round(host * 1e3, 3),
+                "device": round(device * 1e3, 3),
+                "finish_detect": round(fin * 1e3, 3),
+            },
+        }
+
+
+def _track_order_key(track: str):
+    # scheduler first, engine dispatches second, slots in numeric order.
+    if track == "sched":
+        return (0, 0)
+    if track == "engine":
+        return (1, 0)
+    if track.startswith("slot"):
+        try:
+            return (2, int(track[4:]))
+        except ValueError:
+            pass
+    return (3, track)
+
+
+def chrome_trace(tracer: RingTracer, pid: int = 1,
+                 process_name: str = "localai-engine") -> dict:
+    """Render the ring as a Chrome trace-event JSON object.
+
+    One thread (track) per slot plus "sched" (the engine tick loop) and
+    "engine" (dispatch/device spans). Load the serialized dict at
+    https://ui.perfetto.dev or chrome://tracing.
+    """
+    spans = tracer.spans()
+    tracks = sorted({s["track"] for s in spans}, key=_track_order_key)
+    tid = {t: i for i, t in enumerate(tracks)}
+    events: list = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": process_name},
+    }]
+    for t in tracks:
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid[t],
+            "args": {"name": t},
+        })
+        events.append({
+            "name": "thread_sort_index", "ph": "M", "pid": pid,
+            "tid": tid[t], "args": {"sort_index": tid[t]},
+        })
+    base = tracer.t0
+    for s in spans:
+        args = dict(s["args"]) if s["args"] else {}
+        if s["rid"]:
+            args["request_id"] = s["rid"]
+        events.append({
+            "name": s["name"],
+            "cat": "engine",
+            "ph": "X",
+            "pid": pid,
+            "tid": tid[s["track"]],
+            "ts": round((s["t0"] - base) * 1e6, 1),
+            "dur": round(max(0.0, s["t1"] - s["t0"]) * 1e6, 1),
+            "args": args,
+        })
+    return {"displayTimeUnit": "ms", "traceEvents": events}

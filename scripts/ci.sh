@@ -37,6 +37,12 @@ pp = line.get("packed_prefill") or {}
 print(f"TTFT_LOADED_UNLOADED_RATIO={line.get('ttft_loaded_unloaded_ratio')} "
       f"packed_vs_sequential_speedup={pp.get('ttft_speedup')} "
       f"greedy_match={pp.get('greedy_match')}")
+# host-loop vs device-time decomposition from the span tracer (this is
+# the 505-vs-809 tok/s gap, measured — track it across rounds)
+d = (line.get("host_device_decomp") or {}).get("host_device_decomp_ms") or {}
+print(f"HOST_LOOP_MS={d.get('host_loop')} "
+      f"DEVICE_MS={d.get('device')} "
+      f"FINISH_DETECT_MS={d.get('finish_detect')}")
 PY
 rm -f "$smoke_out"
 

@@ -26,6 +26,14 @@ try:
 except AttributeError:
     pass  # pre-jax_num_cpu_devices release: XLA_FLAGS above covers it
 
+# Persistent compilation cache: the suite builds dozens of Engine
+# instances over the same tiny-llama shapes; deserializing repeat
+# programs instead of recompiling keeps the whole tier-1 run inside
+# its wall-clock budget (same helper the serving path uses).
+from localai_tpu.utils.jaxtools import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 

@@ -222,6 +222,28 @@ def test_metrics_endpoint(server):
     assert "localai_api_call" in r.text
 
 
+def test_debug_trace_endpoint(server):
+    """/debug/trace merges per-model chrome traces (fake backend returns
+    a minimal one) into a single perfetto-loadable document."""
+    # force-load a model: traces come only from loaded backends
+    httpx.post(f"{server.base}/v1/completions", json={
+        "model": "tiny", "prompt": "warm up", "max_tokens": 2,
+    }, timeout=60)
+    r = httpx.get(f"{server.base}/debug/trace", timeout=30)
+    assert r.status_code == 200
+    doc = r.json()
+    assert doc["displayTimeUnit"] == "ms"
+    ev = doc["traceEvents"]
+    # process_name metadata rewritten to localai-engine:<model>, one pid
+    # per loaded model
+    procs = {e["args"]["name"]: e["pid"] for e in ev
+             if e.get("name") == "process_name"}
+    assert any(n.startswith("localai-engine:") for n in procs)
+    assert len(set(procs.values())) == len(procs)
+    xs = [e for e in ev if e.get("ph") == "X"]
+    assert xs and all("ts" in e and "dur" in e for e in xs)
+
+
 def test_client_sdk(server):
     """The Python client SDK (reference parity: core/clients/store.go)."""
     from localai_tpu.client import Client

@@ -23,6 +23,9 @@ import os
 import numpy as np
 import pytest
 
+# oracle parity is thorough but slow; keep tier-1 (-m 'not slow') fast
+pytestmark = pytest.mark.slow
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
