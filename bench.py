@@ -657,6 +657,135 @@ def bench_packed_prefill(cfg, S, C, max_new=24, rounds=4):
     return out
 
 
+def bench_chaos(cfg, S, C, max_new=16, flood=12):
+    """Fault-lifecycle SLO scenario (ISSUE 7), on ONE engine:
+
+    1. saturation shed — queue bound dropped to 1, then ``flood``
+       concurrent submits; every refused request must carry a
+       structured "shed" event (not a hang, not a raw traceback) and
+       carry it within 50 ms of submit;
+    2. stall recovery — a one-shot injected sync-worker delay wedges a
+       prefill; the watchdog must abort ONLY that request, dump the
+       span ring to disk, and the next request must reproduce the
+       pre-fault greedy baseline byte-for-byte (f32 weights, same
+       parity reasoning as bench_packed_prefill)."""
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.weights import random_params
+    from localai_tpu.services.faults import FAULTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 255, size=max(8, C // 8)).tolist()
+    flood_prompts = [rng.integers(0, 255, size=max(8, C // 8)).tolist()
+                     for _ in range(flood)]
+
+    ecfg = eng.EngineConfig(num_slots=S, max_context=C,
+                            prefill_buckets=(32, 128),
+                            cache_dtype=jnp.float32)
+    engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
+                        eos_token_ids={cfg.vocab_size - 1})
+    engine.start(precompile=True)
+
+    def make_req(p):
+        return eng.GenRequest(
+            prompt_ids=list(p), max_new_tokens=max_new, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+
+    def run_one(p):
+        o = engine.submit(make_req(p))
+        ids, last = [], None
+        while True:
+            ev = o.get()
+            if ev is None:
+                break
+            last = ev
+            if ev.token_ids:
+                ids.extend(ev.token_ids)
+            elif ev.token_id >= 0:
+                ids.append(ev.token_id)
+        return ids, last
+
+    out = {}
+    saved_maxq = engine.ecfg.max_queued_requests
+    saved_stall = engine.ecfg.dispatch_stall_ms
+    try:
+        baseline, _ = run_one(prompt)
+        out["baseline_tokens"] = len(baseline)
+
+        # ---- saturation shed ----
+        engine.ecfg.max_queued_requests = 1
+        lock = threading.Lock()
+        shed_lat, counts = [], {"shed": 0, "served": 0, "other": 0}
+
+        def flood_one(i):
+            t1 = time.monotonic()
+            o = engine.submit(make_req(flood_prompts[i]))
+            first_dt = None
+            ids, last = [], None
+            while True:
+                ev = o.get()
+                if ev is None:
+                    break
+                if first_dt is None:
+                    first_dt = time.monotonic() - t1
+                last = ev
+                if ev.token_ids:
+                    ids.extend(ev.token_ids)
+                elif ev.token_id >= 0:
+                    ids.append(ev.token_id)
+            with lock:
+                if last is not None and getattr(
+                        last, "error_kind", None) == "shed":
+                    counts["shed"] += 1
+                    shed_lat.append(first_dt or 0.0)
+                elif ids:
+                    counts["served"] += 1
+                else:
+                    counts["other"] += 1
+
+        threads = [threading.Thread(target=flood_one, args=(i,),
+                                    daemon=True) for i in range(flood)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        engine.ecfg.max_queued_requests = saved_maxq
+        out["shed"] = counts["shed"]
+        out["served"] = counts["served"]
+        out["unstructured"] = counts["other"]
+        out["shed_p95_ms"] = (round(float(
+            np.percentile(shed_lat, 95) * 1e3), 2) if shed_lat else None)
+        out["shed_under_50ms"] = bool(shed_lat) and max(shed_lat) < 0.05
+
+        # ---- stall abort + ring dump + byte-exact recovery ----
+        dump_dir = tempfile.mkdtemp(prefix="localai-chaos-")
+        engine.ecfg.dispatch_stall_ms = 300
+        engine.ecfg.stall_dump_dir = dump_dir
+        FAULTS.arm("sync_delay_ms", "2000", count=1)
+        _ids, last = run_one(prompt)
+        out["stall_aborted"] = bool(
+            last is not None and getattr(last, "error_kind", None) == "stall")
+        out["stall_dump"] = len([f for f in os.listdir(dump_dir)
+                                 if f.endswith(".trace.json")])
+        time.sleep(2.2)  # let the delayed sync worker drain its item
+        engine.ecfg.dispatch_stall_ms = saved_stall
+        recovered, _ = run_one(prompt)
+        out["survivors_identical"] = recovered == baseline
+        out["recovered"] = int(out["stall_aborted"] and out["stall_dump"] > 0
+                               and out["survivors_identical"])
+        m = engine.metrics()
+        out["lifecycle"] = m.get("lifecycle")
+    finally:
+        FAULTS.reset()
+        engine.shutdown()
+    return out
+
+
 def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
                     pressure=False):
     """Multi-turn shared-prefix scenario (PR 2 acceptance): N greedy
@@ -1026,6 +1155,64 @@ def _engine_direct_packed(deadline: float, partial: dict) -> dict:
     return out
 
 
+def _engine_direct_chaos(deadline: float, partial: dict) -> dict:
+    """The fault-lifecycle SLO scenario (ISSUE 7) as a bench phase:
+    saturation-shed latency plus stall-abort/ring-dump recovery with
+    greedy byte parity, engine-direct in a subprocess on the CPU-safe
+    smoke shape (LOCALAI_BENCH_CHAOS_PRESET to override)."""
+    import subprocess
+
+    ch_preset = os.environ.get("LOCALAI_BENCH_CHAOS_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(ch_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": ch_preset,
+        "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_BENCH_DEADLINE_S": "0",
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    env.pop("LOCALAI_FAULTS", None)  # the scenario arms its own faults
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--chaos"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"ok": r.get("value"),
+                       "shed": r.get("shed"),
+                       "served": r.get("served"),
+                       "unstructured": r.get("unstructured"),
+                       "shed_p95_ms": r.get("shed_p95_ms"),
+                       "shed_under_50ms": r.get("shed_under_50ms"),
+                       "stall_aborted": r.get("stall_aborted"),
+                       "stall_dump": r.get("stall_dump"),
+                       "recovered": r.get("recovered"),
+                       "survivors_identical": r.get("survivors_identical")}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"chaos_{k}": v for k, v in out.items()})
+    _emit_phase("chaos", out)
+    return out
+
+
 def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     """The PR-2 acceptance scenario as a default-bench phase: multi-turn
     conversations under slot churn, prefix cache on vs off, in one
@@ -1203,7 +1390,8 @@ def main():
     _GLOBAL_DEADLINE = deadline
 
     if ("--engine" in sys.argv or "--kernel" in sys.argv
-            or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv):
+            or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
+            or "--chaos" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -1274,6 +1462,27 @@ def main():
                 "metric": f"packed_prefill_{preset}",
                 "value": r["ttft_speedup"], "unit": "x loaded TTFT",
                 **r,
+            }))
+            return
+
+        if "--chaos" in sys.argv:
+            # fault-lifecycle SLO (ISSUE 7): f32 weights so the
+            # post-stall recovery request can be byte-compared against
+            # the pre-fault greedy baseline
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(96, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_chaos(cfg, S, C)
+            ok = (r.get("recovered") == 1 and r.get("shed", 0) >= 1
+                  and r.get("unstructured", 0) == 0
+                  and r.get("shed_under_50ms") is True)
+            print(json.dumps({
+                "metric": f"chaos_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", **r,
             }))
             return
 
@@ -1366,6 +1575,7 @@ def main():
     packed_cmp = _engine_direct_packed(deadline, partial)
     multiturn = _engine_direct_multiturn(deadline, partial)
     offload_cmp = _engine_direct_offload(deadline, partial)
+    chaos_cmp = _engine_direct_chaos(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -1390,6 +1600,7 @@ def main():
                 "packed_prefill": packed_cmp,
                 "multiturn_prefix_cache": multiturn,
                 "kv_offload_pressure": offload_cmp,
+                "chaos": chaos_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -1488,6 +1699,7 @@ def main():
         "packed_prefill": packed_cmp,
         "multiturn_prefix_cache": multiturn,
         "kv_offload_pressure": offload_cmp,
+        "chaos": chaos_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

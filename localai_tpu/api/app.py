@@ -13,6 +13,7 @@ import asyncio
 import contextlib
 import json
 import logging
+import math
 import re
 import secrets
 import threading
@@ -22,6 +23,7 @@ from typing import Iterator, Optional
 
 from aiohttp import web
 
+from localai_tpu.services.errors import ServingError
 from localai_tpu.services.metrics import METRICS
 
 log = logging.getLogger("localai_tpu.api")
@@ -43,6 +45,13 @@ async def error_middleware(request, handler):
         return await handler(request)
     except web.HTTPException:
         raise
+    except ServingError as e:
+        # structured lifecycle failures (shed / backend down / circuit
+        # open / deadline): the right status + Retry-After, one WARNING —
+        # a full traceback for an expected overload would drown the logs
+        log.warning("serving error: %s %s -> %d %s: %s", request.method,
+                    request.path, e.status, e.etype, e)
+        return error_response(e)
     except Exception as e:
         log.exception("handler error: %s %s", request.method, request.path)
         return api_error(str(e), 500)
@@ -54,6 +63,22 @@ def api_error(message: str, status: int = 500, etype: str = "server_error"):
         {"error": {"message": message, "type": etype, "param": None, "code": status}},
         status=status,
     )
+
+
+def error_response(e: ServingError) -> web.Response:
+    """ServingError -> OpenAI-style envelope with its HTTP status, the
+    breaker/retryability detail merged into the error object, and a
+    Retry-After header when the engine provided a hint."""
+    body = {"message": str(e), "type": e.etype, "param": None,
+            "code": e.status}
+    body.update(e.body_extra())
+    headers = {}
+    if e.retry_after_s:
+        headers["Retry-After"] = str(math.ceil(e.retry_after_s))
+    if e.status == 429:
+        METRICS.inc("http_requests_shed_total")
+    return web.json_response({"error": body}, status=e.status,
+                             headers=headers)
 
 
 def make_metrics_middleware():
@@ -159,6 +184,17 @@ def get_state(request) -> AppState:
 async def sse_response(request, chunks: "asyncio.Queue"):
     """Drain an async queue of dicts into an SSE stream, ending with [DONE]
     (reference: chat.go:463-508 fasthttp StreamWriter)."""
+    # peek the FIRST item before committing to a 200 + event-stream: a
+    # request shed by admission control or refused by an open circuit
+    # fails before any token is produced, and the client deserves a real
+    # 429/503 with Retry-After — not a 200 stream containing an error
+    first = await chunks.get()
+    if isinstance(first, ServingError):
+        if hasattr(chunks, "cancel_event"):
+            chunks.cancel_event.set()
+        log.warning("stream refused: %s %s -> %d %s: %s", request.method,
+                    request.path, first.status, first.etype, first)
+        return error_response(first)
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -166,6 +202,7 @@ async def sse_response(request, chunks: "asyncio.Queue"):
         "X-Accel-Buffering": "no",
     })
     await resp.prepare(request)
+    seed: list = [first]
     try:
         done = False
         while not done:
@@ -173,7 +210,8 @@ async def sse_response(request, chunks: "asyncio.Queue"):
             # A decode burst delivers many tokens at once, and per-token
             # write+flush is the dominant host cost of the SSE path on a
             # 1-core rig (VERDICT r4 #2)
-            batch = [await chunks.get()]
+            batch = seed or [await chunks.get()]
+            seed = []
             while True:
                 try:
                     batch.append(chunks.get_nowait())
@@ -185,9 +223,13 @@ async def sse_response(request, chunks: "asyncio.Queue"):
                     done = True
                     break
                 if isinstance(item, Exception):
-                    payload = {"error": {"message": str(item),
-                                         "type": "server_error"}}
-                    out += f"data: {json.dumps(payload)}\n\n".encode()
+                    # mid-stream failure: the 200 is already on the wire,
+                    # so the typed error rides the stream body instead
+                    err = {"message": str(item), "type": "server_error"}
+                    if isinstance(item, ServingError):
+                        err["type"] = item.etype
+                        err.update(item.body_extra())
+                    out += f"data: {json.dumps({'error': err})}\n\n".encode()
                     done = True
                     break
                 if isinstance(item, (bytes, bytearray)):

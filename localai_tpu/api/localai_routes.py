@@ -23,9 +23,11 @@ from localai_tpu.services.metrics import METRICS
 
 def register(app: web.Application):
     r = app.router
-    # health (reference: routes/health.go)
+    # health (reference: routes/health.go). /healthz is pure liveness;
+    # /readyz is distinct (ISSUE 7): it consults the loader's circuit
+    # breakers so an orchestrator stops routing to a crash-looping node
     r.add_get("/healthz", healthz)
-    r.add_get("/readyz", healthz)
+    r.add_get("/readyz", readyz)
     # tts + sound generation
     r.add_post("/tts", tts)
     r.add_post("/sound-generation", sound_generation)
@@ -66,6 +68,28 @@ def register(app: web.Application):
 
 async def healthz(request):
     return web.Response(text="OK")
+
+
+async def readyz(request):
+    """Readiness distinct from liveness: 503 (with Retry-After) while any
+    model's load circuit breaker is open — the process is alive, but a
+    load balancer should prefer other replicas until the breaker cools."""
+    state = get_state(request)
+    try:
+        stats = state.caps.loader.stats()
+    except Exception:
+        stats = {}
+    open_breakers = {name: s["breaker"] for name, s in stats.items()
+                     if s["breaker"]["state"] == "open"}
+    if open_breakers:
+        retry_after = max(1, int(max(
+            b.get("retry_after_s", 0.0) for b in open_breakers.values())))
+        return web.json_response(
+            {"status": "unready", "circuit_open": open_breakers},
+            status=503, headers={"Retry-After": str(retry_after)})
+    return web.json_response(
+        {"status": "ready",
+         "models_loaded": len(state.caps.loader.list_loaded())})
 
 
 async def run_audio_capability(request, call) -> web.Response:
@@ -113,6 +137,12 @@ _PACKED_COUNTERS = ("dispatches", "tokens", "segments", "pad_tokens")
 # re-exposed verbatim with proper _bucket/_sum/_count exposition
 _LATENCY_HISTOGRAMS = ("ttft_seconds", "itl_seconds",
                        "decode_burst_seconds", "prefill_dispatch_seconds")
+# fault-tolerant lifecycle counters (engine.py metrics()["lifecycle"],
+# ISSUE 7): stats key -> localai_<metric> per model
+_LIFECYCLE_COUNTERS = (("requests_shed", "requests_shed_total"),
+                       ("requests_timed_out", "requests_timed_out_total"),
+                       ("stalls", "engine_stalls_total"),
+                       ("stall_dumps", "stall_dumps_total"))
 
 
 def _refresh_engine_metrics(state):
@@ -131,8 +161,21 @@ def _refresh_engine_metrics(state):
               *(f"ttft_{m}_p50_ms" for _k, m in _TTFT_GAUGES),
               *(f"prefill_packed_{k}_total" for k in _PACKED_COUNTERS),
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
-              *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS)):
+              *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS),
+              *(m for _k, m in _LIFECYCLE_COUNTERS),
+              "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
+    # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
+    # state come from the core's loader, not the backend — a model whose
+    # backend is DEAD right now is exactly the one that must still export
+    try:
+        for name, s in state.caps.loader.stats().items():
+            METRICS.set_counter("backend_respawns_total", s["respawns"],
+                                f'model="{name}"')
+            METRICS.set_gauge("circuit_state", s["circuit_state"],
+                              f'model="{name}"')
+    except Exception:
+        pass
     for name in state.caps.loader.list_loaded():
         lm = state.caps.loader.get(name)
         if lm is None:
@@ -166,6 +209,11 @@ def _refresh_engine_metrics(state):
             for key in _PACKED_COUNTERS:
                 METRICS.set_counter(f"prefill_packed_{key}_total",
                                     pp.get(key, 0), f'model="{name}"')
+        lc = stats.get("lifecycle")
+        if lc:
+            for skey, mkey in _LIFECYCLE_COUNTERS:
+                METRICS.set_counter(mkey, lc.get(skey, 0),
+                                    f'model="{name}"')
         if stats.get("kv_layout") != "paged":
             continue
         for key in _POOL_GAUGES:

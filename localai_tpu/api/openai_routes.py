@@ -154,12 +154,17 @@ async def chat_completions(request):
 
     if body.get("stream"):
         def gen():
-            first = {"id": cmpl_id, "object": "chat.completion.chunk",
-                     "created": created, "model": model,
-                     "choices": [{"index": 0, "delta": {"role": "assistant",
-                                                        "content": ""},
-                                  "finish_reason": None}]}
-            yield first
+            role = {"id": cmpl_id, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "delta": {"role": "assistant",
+                                                       "content": ""},
+                                 "finish_reason": None}]}
+            # the role delta is deferred until the backend produced its
+            # first chunk: a request refused at admission (shed, circuit
+            # open, backend down) must fail the HTTP exchange with a real
+            # 429/503 + Retry-After, not a 200 stream opened by an eager
+            # skeleton (sse_response peeks the first item for exactly this)
+            sent_role = False
             usage = [0, 0]
             finish = "stop"
             # content deltas are the per-token hot path: pre-serialize the
@@ -176,6 +181,9 @@ async def chat_completions(request):
             collected = []
             for chunk in state.caps.inference_stream(mc, prompt, overrides,
                                                      correlation_id):
+                if not sent_role:
+                    yield role
+                    sent_role = True
                 usage = [chunk.prompt_tokens, chunk.completion_tokens]
                 if chunk.finish_reason:
                     finish = chunk.finish_reason
@@ -206,6 +214,8 @@ async def chat_completions(request):
                            "choices": [{"index": 0,
                                         "delta": {"content": "".join(collected)},
                                         "finish_reason": None}]}
+            if not sent_role:
+                yield role      # empty generation: still a valid stream
             final = {"id": cmpl_id, "object": "chat.completion.chunk",
                      "created": created, "model": model,
                      "choices": [{"index": 0, "delta": {},

@@ -28,6 +28,26 @@ from localai_tpu.backend.service import (BackendServicer, make_server,
 
 log = logging.getLogger("localai_tpu.backend.runner")
 
+# engine lifecycle failure kinds -> gRPC status codes, so the core can
+# distinguish shed (retry later) from timeout from stall without parsing
+# message strings (services/errors.py maps them back to HTTP 429/504/503)
+_EVENT_STATUS = {
+    "shed": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    "timeout": grpc.StatusCode.DEADLINE_EXCEEDED,
+    "stall": grpc.StatusCode.ABORTED,
+}
+
+
+def _abort_event(context, ev):
+    """Abort the RPC for an engine error event with the kind-mapped
+    status code; the engine's Retry-After hint rides trailing metadata
+    (the hand-rolled stubs cannot grow proto fields)."""
+    if ev.retry_after_s:
+        context.set_trailing_metadata(
+            (("localai-retry-after", f"{ev.retry_after_s:g}"),))
+    context.abort(_EVENT_STATUS.get(ev.error_kind, grpc.StatusCode.INTERNAL),
+                  ev.error)
+
 
 def _sampling_from_predict(opts: pb.PredictOptions):
     from localai_tpu.engine.sampling import SamplingParamsHost
@@ -321,7 +341,32 @@ class EngineServicer(BackendServicer):
                 extra.get("trace_ring_size", 0) or 0)) > 0 else {}),
             **({"slow_request_ms": srm} if (srm := int(
                 extra.get("slow_request_ms", 0) or 0)) > 0 else {}),
+            # fault-tolerant lifecycle (ISSUE 7): admission control,
+            # per-request deadlines, stall watchdog. Explicit 0 must pass
+            # through (it DISABLES the bound), so these use isdigit
+            # instead of the >0 idiom above.
+            **({"max_queued_requests": int(v)} if (v := str(
+                extra.get("max_queued_requests", "")).strip()).isdigit()
+               else {}),
+            **({"max_queue_wait_ms": int(v)} if (v := str(
+                extra.get("max_queue_wait_ms", "")).strip()).isdigit()
+               else {}),
+            **({"request_timeout_ms": int(v)} if (v := str(
+                extra.get("request_timeout_ms", "")).strip()).isdigit()
+               else {}),
+            **({"dispatch_stall_ms": int(v)} if (v := str(
+                extra.get("dispatch_stall_ms", "")).strip()).isdigit()
+               else {}),
+            **({"stall_dump_dir": sdd} if (sdd := str(
+                extra.get("stall_dump_dir", "") or "")) else {}),
         )
+        # chaos harness: a faults=... model option arms the in-process
+        # fault table (same spec format as the LOCALAI_FAULTS env var,
+        # ';'-separated because the options wire splits on commas)
+        if extra.get("faults"):
+            from localai_tpu.services.faults import FAULTS
+
+            FAULTS.configure(str(extra["faults"]))
         draft = None
         if request.draft_model:
             ddir = request.draft_model
@@ -459,7 +504,7 @@ class EngineServicer(BackendServicer):
         text, events = self.engine.generate_text(req)
         last = events[-1] if events else None
         if last is not None and last.error:
-            context.abort(grpc.StatusCode.INTERNAL, last.error)
+            _abort_event(context, last)
         if request.echo:
             text = request.prompt + text
         return pb.Reply(
@@ -485,7 +530,7 @@ class EngineServicer(BackendServicer):
                 self.engine.cancel(req.request_id)
                 return
             if ev.error:
-                context.abort(grpc.StatusCode.INTERNAL, ev.error)
+                _abort_event(context, ev)
             yield pb.Reply(
                 message=ev.text.encode("utf-8"),
                 token_id=ev.token_id,

@@ -12,13 +12,18 @@ in SURVEY.md); here one channel is created per backend and reused.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from concurrent import futures
 from typing import Iterator, Optional
 
 import grpc
 
 from localai_tpu.backend import contract_pb2 as pb
+from localai_tpu.services.faults import FAULTS
+
+_log = logging.getLogger("localai_tpu.backend.service")
 
 SERVICE = "localai_tpu.Backend"
 
@@ -78,12 +83,53 @@ class BackendServicer:
         raise AttributeError(name)
 
 
+def _inject_faults(name: str, fn, streaming: bool):
+    """Wrap an RPC handler with the chaos-harness injection points
+    (services/faults.py). With nothing armed this is one attribute read
+    per call. Wrapping at the server layer covers every backend — the
+    real engine runner AND the fake echo backend tests spawn.
+
+    - ``rpc_unavailable=<Method>``: abort that RPC with UNAVAILABLE
+      before the handler runs (the client-side idempotent-unary retry
+      must absorb it).
+    - ``kill_backend_after_tokens=N``: hard-exit the backend process
+      after N streamed PredictStream tokens (a mid-stream crash, the
+      supervisor's worst case).
+    """
+    if streaming:
+        def wrapped(request, context):
+            if FAULTS.active and FAULTS.take("rpc_unavailable", match=name):
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              f"injected fault: rpc_unavailable on {name}")
+            tokens = 0
+            for resp in fn(request, context):
+                yield resp
+                if FAULTS.active:
+                    tokens += len(getattr(resp, "token_ids", ()) or ()) or 1
+                    kill = FAULTS.value("kill_backend_after_tokens")
+                    if kill is not None and tokens >= int(kill):
+                        FAULTS.take("kill_backend_after_tokens")
+                        _log.warning(
+                            "injected fault: killing backend after %d "
+                            "streamed tokens", tokens)
+                        import os
+
+                        os._exit(17)
+    else:
+        def wrapped(request, context):
+            if FAULTS.active and FAULTS.take("rpc_unavailable", match=name):
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              f"injected fault: rpc_unavailable on {name}")
+            return fn(request, context)
+    return wrapped
+
+
 def make_server(servicer: BackendServicer, addr: str, max_workers: int = 16,
                 options: Optional[list] = None) -> grpc.Server:
     """Build (not start) a grpc server for the contract bound to addr."""
     handlers = {}
     for name, (req_cls, resp_cls, streaming) in METHODS.items():
-        fn = getattr(servicer, name)
+        fn = _inject_faults(name, getattr(servicer, name), streaming)
         if streaming:
             h = grpc.unary_stream_rpc_method_handler(
                 fn, request_deserializer=req_cls.FromString,
@@ -103,7 +149,12 @@ def make_server(servicer: BackendServicer, addr: str, max_workers: int = 16,
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),)
     )
-    server.add_insecure_port(addr)
+    # add_insecure_port returns 0 on bind failure WITHOUT raising; an
+    # unchecked 0 surfaces later as an opaque connect timeout. Raising
+    # here makes the free_port() -> bind race a deterministic message the
+    # spawn-side retry (modelmgr/process.py) can detect in the log tail.
+    if server.add_insecure_port(addr) == 0:
+        raise RuntimeError(f"could not bind {addr}: address already in use")
     return server
 
 
@@ -147,6 +198,28 @@ class BackendClient:
             def __exit__(self, *a): return False
         return self._lock if not self.parallel else _NullCtx()
 
+    def _retry_unary(self, name: str, req, timeout: float,
+                     attempts: int = 3, base_delay: float = 0.05):
+        """Call an IDEMPOTENT unary RPC, retrying on UNAVAILABLE with
+        exponential delay (ISSUE 7 crash recovery): a one-packet blip or
+        a backend mid-respawn should cost a retry, not a client error.
+        Only read-only/stateless methods route through here — Predict*
+        may have produced tokens before dying and must never re-run
+        implicitly."""
+        delay = base_delay
+        for attempt in range(attempts):
+            try:
+                return self._stubs[name](req, timeout=timeout)
+            except grpc.RpcError as e:
+                code = e.code() if callable(getattr(e, "code", None)) else None
+                if code != grpc.StatusCode.UNAVAILABLE \
+                        or attempt == attempts - 1:
+                    raise
+                _log.warning("%s UNAVAILABLE (attempt %d/%d), retrying in "
+                             "%.2fs", name, attempt + 1, attempts, delay)
+                time.sleep(delay)
+                delay *= 2
+
     # --- typed wrappers ---
     def health(self, timeout: float = 5.0) -> bool:
         # wait_for_ready rides out gRPC's reconnect backoff while a spawned
@@ -171,10 +244,10 @@ class BackendClient:
             yield from self._stubs["PredictStream"](opts, timeout=timeout)
 
     def embedding(self, opts: pb.PredictOptions, timeout: float = 120.0) -> pb.EmbeddingResult:
-        return self._stubs["Embedding"](opts, timeout=timeout)
+        return self._retry_unary("Embedding", opts, timeout)
 
     def tokenize(self, opts: pb.PredictOptions, timeout: float = 60.0) -> pb.TokenizationResponse:
-        return self._stubs["TokenizeString"](opts, timeout=timeout)
+        return self._retry_unary("TokenizeString", opts, timeout)
 
     def generate_image(self, req: pb.GenerateImageRequest, timeout: float = 600.0) -> pb.Result:
         return self._stubs["GenerateImage"](req, timeout=timeout)
@@ -189,13 +262,14 @@ class BackendClient:
         return self._stubs["AudioTranscription"](req, timeout=timeout)
 
     def rerank(self, req: pb.RerankRequest, timeout: float = 120.0) -> pb.RerankResult:
-        return self._stubs["Rerank"](req, timeout=timeout)
+        return self._retry_unary("Rerank", req, timeout)
 
     def status(self, timeout: float = 10.0) -> pb.StatusResponse:
         return self._stubs["Status"](pb.HealthMessage(), timeout=timeout)
 
     def get_metrics(self, timeout: float = 10.0) -> pb.MetricsResponse:
-        return self._stubs["GetMetrics"](pb.MetricsRequest(), timeout=timeout)
+        return self._retry_unary("GetMetrics", pb.MetricsRequest(),
+                                 timeout)
 
     def get_trace(self, timeout: float = 10.0) -> pb.Reply:
         """Chrome trace-event JSON of the engine's span ring (UTF-8 in

@@ -18,6 +18,7 @@ from localai_tpu.backend import contract_pb2 as pb
 from localai_tpu.config.app_config import AppConfig
 from localai_tpu.config.model_config import ModelConfig
 from localai_tpu.modelmgr.loader import ModelLoader
+from localai_tpu.services.errors import wrap_backend_error
 
 
 def build_model_options(mc: ModelConfig, app: AppConfig) -> pb.ModelOptions:
@@ -173,6 +174,11 @@ class Capabilities:
                     token_ids=list(reply.token_ids) or None,
                     logprobs=list(reply.logprobs) or None,
                 )
+        except Exception as e:
+            # a backend abort (shed/timeout/stall) or a mid-stream crash
+            # must reach the client as a typed ServingError with the
+            # right HTTP status + Retry-After, never a raw RpcError
+            raise wrap_backend_error(e, mc.name) from e
         finally:
             lm.mark_idle()
 
@@ -184,6 +190,8 @@ class Capabilities:
         lm.mark_busy()
         try:
             reply = lm.client.predict(popts)
+        except Exception as e:
+            raise wrap_backend_error(e, mc.name) from e
         finally:
             lm.mark_idle()
         text = finetune_response(mc, reply.message.decode("utf-8", errors="replace"))
@@ -212,6 +220,8 @@ class Capabilities:
                 r = lm.client.embedding(pb.PredictOptions(prompt=str(text)))
                 out.append(list(r.embeddings))
             return out
+        except Exception as e:
+            raise wrap_backend_error(e, mc.name) from e
         finally:
             lm.mark_idle()
 
@@ -219,7 +229,10 @@ class Capabilities:
 
     def tokenize(self, mc: ModelConfig, text: str) -> list:
         lm = self._load(mc)
-        res = lm.client.tokenize(pb.PredictOptions(prompt=text))
+        try:
+            res = lm.client.tokenize(pb.PredictOptions(prompt=text))
+        except Exception as e:
+            raise wrap_backend_error(e, mc.name) from e
         return list(res.tokens)
 
     # ---- image ----
@@ -294,9 +307,12 @@ class Capabilities:
     def rerank(self, mc: ModelConfig, query: str, documents: list,
                top_n: int) -> pb.RerankResult:
         lm = self._load(mc)
-        return lm.client.rerank(pb.RerankRequest(
-            query=query, documents=documents, top_n=top_n,
-        ))
+        try:
+            return lm.client.rerank(pb.RerankRequest(
+                query=query, documents=documents, top_n=top_n,
+            ))
+        except Exception as e:
+            raise wrap_backend_error(e, mc.name) from e
 
     # ---- stores ----
 

@@ -234,7 +234,13 @@ class ModelConfig:
                        "kv_host_pool_mb",
                        "prefill_token_budget",
                        "trace_ring_size",
-                       "slow_request_ms") and not v.isdigit():
+                       "slow_request_ms",
+                       # fault-tolerant lifecycle knobs (ISSUE 7);
+                       # explicit 0 disables the respective bound
+                       "max_queued_requests",
+                       "max_queue_wait_ms",
+                       "request_timeout_ms",
+                       "dispatch_stall_ms") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")

@@ -55,6 +55,7 @@ import numpy as np
 
 from localai_tpu.engine import sampling
 from localai_tpu.engine.detok import IncrementalDetokenizer
+from localai_tpu.services.faults import FAULTS
 from localai_tpu.models import llama
 from localai_tpu.ops import kvcache
 
@@ -191,6 +192,27 @@ class EngineConfig:
     # end-to-end wall exceeds this many ms, log one WARNING with the
     # span decomposition. 0 disables.
     slow_request_ms: int = 0
+    # --- fault-tolerant request lifecycle (ISSUE 7) ---
+    # admission control: submit() sheds (structured 429-mapped error,
+    # never an unbounded queue) once this many requests are already
+    # waiting for a slot. 0 = unbounded (pre-PR-7 behavior).
+    max_queued_requests: int = 256
+    # queued requests that waited longer than this are shed at the next
+    # admission tick — bounds worst-case queue sojourn under sustained
+    # overload. 0 disables.
+    max_queue_wait_ms: int = 0
+    # per-request deadline from submit(): expired requests get a
+    # structured timeout error and are cancelled through the normal
+    # engine.cancel path (slot + pages released). 0 disables.
+    request_timeout_ms: int = 0
+    # stall watchdog: if a dispatched prefill/decode item sees no
+    # sync-worker ready-set transition for this long, the engine dumps
+    # the span ring to disk, aborts ONLY the stalled requests with
+    # structured errors, and keeps serving. 0 disables (pre-PR-7
+    # behavior: wait forever).
+    dispatch_stall_ms: int = 30000
+    # where stall ring dumps land; "" = the system temp dir.
+    stall_dump_dir: str = ""
 
 
 @dataclasses.dataclass
@@ -217,6 +239,7 @@ class GenRequest:
     # filled by engine:
     out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
     t_submit: float = 0.0      # stamped by Engine.submit (TTFT decomposition)
+    deadline: float = 0.0      # monotonic; stamped by submit from request_timeout_ms
 
     def __post_init__(self):
         if not self.request_id:
@@ -242,6 +265,13 @@ class StreamEvent:
     # burst). token_id/logprob above are the LAST member's.
     token_ids: Optional[list] = None
     logprobs: Optional[list] = None
+    # lifecycle failure taxonomy (ISSUE 7): set alongside `error` so the
+    # gRPC runner can map the failure to the right status code instead
+    # of a blanket INTERNAL. "shed" | "timeout" | "stall" | None.
+    error_kind: Optional[str] = None
+    # crude client back-off hint derived from live queue depth / slot
+    # occupancy; surfaced as Retry-After at the HTTP layer.
+    retry_after_s: float = 0.0
 
 
 def event_ids(events) -> list:
@@ -263,6 +293,16 @@ def _merge_events(evs: list) -> StreamEvent:
         token_ids=[e.token_id for e in evs],
         logprobs=[e.logprob for e in evs],
     )
+
+
+class _DispatchStall(Exception):
+    """Raised by _wait_ready when a dispatched item saw no sync-worker
+    ready-set transition within dispatch_stall_ms. Carries the wedged
+    item so _handle_stall can abort exactly its requests."""
+
+    def __init__(self, item):
+        super().__init__("device dispatch stalled")
+        self.item = item
 
 
 class _Burst:
@@ -651,6 +691,14 @@ class Engine:
         self._hists = {name: [[0] * (len(b) + 1), 0.0, 0]
                        for name, b in _HIST_BUCKETS.items()}
         self._t_last_burst = 0.0
+        # lifecycle telemetry + watchdog state (ISSUE 7). _t_last_ready is
+        # the last sync-worker ready-set stamp: the stall watchdog measures
+        # from max(item.t_dispatch, _t_last_ready) so a busy-but-progressing
+        # pipeline never false-triggers.
+        self._t_last_ready = 0.0
+        self._lc = {"requests_shed": 0, "requests_timed_out": 0,
+                    "stalls": 0, "stall_dumps": 0}
+        self._lc_lock = threading.Lock()
         # non-None while _process_burst coalesces per-slot events
         self._sink_buf: Optional[dict] = None
         # in-flight prefill dedup: leader slot -> [(sib_slot, snap, leader
@@ -670,6 +718,18 @@ class Engine:
             item = self._sync_q.get()
             if item is None:
                 return
+            if FAULTS.active and not isinstance(item, _PendingOffload):
+                d = FAULTS.take("sync_delay_ms")
+                if d is not None:
+                    # stall injection: the ready-set transition is late, so
+                    # the dispatch watchdog should fire on the waiting item
+                    time.sleep(int(d) / 1e3)
+                if FAULTS.take("sync_fail") is not None:
+                    item.err = RuntimeError("injected fault: sync_fail")
+                    item.t_ready = self._t_last_ready = time.monotonic()
+                    item.ready.set()
+                    self._wake.set()
+                    continue
             try:
                 if isinstance(item, _Burst):
                     item.pack_np = np.asarray(item.pack)
@@ -695,7 +755,7 @@ class Engine:
             # point (block_until_ready/is_ready lie on this platform):
             # span t_dispatch->t_ready is device time, t_ready->process
             # pickup is finish-detection latency
-            item.t_ready = time.monotonic()
+            item.t_ready = self._t_last_ready = time.monotonic()
             item.ready.set()
             self._wake.set()
 
@@ -846,6 +906,8 @@ class Engine:
             return
         from localai_tpu.engine.paging import PoolExhausted
 
+        if FAULTS.active and FAULTS.take("page_alloc_fail") is not None:
+            raise PoolExhausted("injected fault: page_alloc_fail")
         try:
             self._pool.ensure(slot, rows)
             return
@@ -1783,9 +1845,44 @@ class Engine:
 
     def submit(self, req: GenRequest) -> "queue.Queue":
         req.t_submit = time.monotonic()
+        # admission control (ISSUE 7): shed at the door instead of queuing
+        # unboundedly — the caller gets a structured "shed" event on the
+        # normal output queue within microseconds, not a growing sojourn.
+        maxq = self.ecfg.max_queued_requests
+        if maxq > 0 and self._queue.qsize() >= maxq:
+            self._shed(req, f"server overloaded: {maxq} requests already "
+                            f"queued (max_queued_requests)")
+            return req.out
+        if self.ecfg.request_timeout_ms > 0:
+            req.deadline = req.t_submit + self.ecfg.request_timeout_ms / 1e3
         self._queue.put(req)
         self._wake.set()
         return req.out
+
+    def _retry_after_hint(self) -> float:
+        """Crude client back-off from the live queue_depth / slot gauges:
+        roughly 'queue drains one request per slot per second', floored
+        at 1 s. Precision is not the point — a monotone signal is."""
+        return max(1.0, round(
+            self._queue.qsize() / max(1, self.ecfg.num_slots), 1))
+
+    def _shed(self, req: GenRequest, reason: str, kind: str = "shed"):
+        with self._lc_lock:
+            self._lc["requests_shed"] += 1
+        req.out.put(StreamEvent(
+            token_id=-1, text="", logprob=0.0, finish_reason="stop",
+            error=reason, error_kind=kind,
+            retry_after_s=self._retry_after_hint()))
+        req.out.put(None)
+
+    def _timeout_event(self, req: GenRequest) -> StreamEvent:
+        with self._lc_lock:
+            self._lc["requests_timed_out"] += 1
+        return StreamEvent(
+            token_id=-1, text="", logprob=0.0, finish_reason="stop",
+            error=(f"request deadline exceeded "
+                   f"({self.ecfg.request_timeout_ms} ms)"),
+            error_kind="timeout")
 
     def cancel(self, request_id: str):
         """Cancel a queued or running request (reference parity:
@@ -1877,6 +1974,15 @@ class Engine:
                    "sum": round(h[1], 6), "count": h[2]}
             for name, h in self._hists.items()}
         out["trace"] = self.tracer.summary()
+        # fault-tolerant lifecycle telemetry (ISSUE 7): shed/timeout/stall
+        # counters + the effective knobs, re-exposed per model on /metrics
+        with self._lc_lock:
+            lc = dict(self._lc)
+        lc["max_queued_requests"] = self.ecfg.max_queued_requests
+        lc["max_queue_wait_ms"] = self.ecfg.max_queue_wait_ms
+        lc["request_timeout_ms"] = self.ecfg.request_timeout_ms
+        lc["dispatch_stall_ms"] = self.ecfg.dispatch_stall_ms
+        out["lifecycle"] = lc
         return out
 
     def trace_events(self) -> dict:
@@ -2066,8 +2172,19 @@ class Engine:
                               "dispatched": int(dispatched),
                               "drained": int(drained)})
                 if not (admitted or prefilled or dispatched or drained):
+                    # a dispatched item the loop is NOT blocked on (e.g. a
+                    # prefill whose worker-side sync wedged) parks in the
+                    # FIFO while the loop idles here — the watchdog must
+                    # cover that wedge too, not just _wait_ready callers
+                    self._check_parked_stall()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
+            except _DispatchStall as st:
+                # stall watchdog (ISSUE 7): a narrower failure than the
+                # generic handler below — abort ONLY the stalled item's
+                # requests, dump the span ring for post-mortem, keep the
+                # device state (survivors keep serving).
+                self._handle_stall(st.item)
             except Exception as e:  # never let the loop die: fail active requests
                 log.exception("engine step failed")
                 for i, s in enumerate(self.slots):
@@ -2096,6 +2213,7 @@ class Engine:
         return not self._queue.empty() and self._free_count() > 0
 
     def _admit(self) -> bool:
+        self._reap_expired()
         self._reap_cancelled()
         if not self._admission_ready():
             return False
@@ -2159,6 +2277,122 @@ class Engine:
                 s.req.out.put(None)
                 # a cancelled LEADER must not strand fork-waiting siblings
                 self._process_fork_waiters(i)
+
+    def _reap_expired(self):
+        """Per-request deadlines + queue-wait shedding (ISSUE 7), on the
+        engine thread at admission ticks. Queued casualties are failed
+        directly; active ones go through the normal cancel path so the
+        slot, its pages, and any fork waiters are released exactly like a
+        client disconnect."""
+        timeout_on = self.ecfg.request_timeout_ms > 0
+        qwait_s = self.ecfg.max_queue_wait_ms / 1e3
+        if not timeout_on and qwait_s <= 0:
+            return
+        now = time.monotonic()
+        # queued requests: scan the underlying deque under the queue's own
+        # mutex (queue.Queue exposes it precisely for bulk maintenance)
+        with self._queue.mutex:
+            victims = [r for r in self._queue.queue
+                       if (timeout_on and r.deadline and now > r.deadline)
+                       or (qwait_s > 0 and now - r.t_submit > qwait_s)]
+            for r in victims:
+                self._queue.queue.remove(r)
+        for r in victims:
+            if timeout_on and r.deadline and now > r.deadline:
+                r.out.put(self._timeout_event(r))
+                r.out.put(None)
+            else:
+                self._shed(r, f"queued longer than max_queue_wait_ms "
+                              f"({self.ecfg.max_queue_wait_ms} ms)")
+        if not timeout_on:
+            return
+        for s in self.slots:
+            if s is not None and s.req.deadline and now > s.req.deadline \
+                    and s.req.request_id not in self._cancelled:
+                # decoding for a dead client: error event now, then the
+                # cancel path releases the slot and closes the stream
+                s.req.out.put(self._timeout_event(s.req))
+                self.cancel(s.req.request_id)
+
+    def _check_parked_stall(self):
+        """Stall detection for the idle branch of the loop: the oldest
+        dispatched-but-unready FIFO item is the one the sync worker
+        should be finishing right now; if nothing has gone ready within
+        the stall budget of its dispatch, it is wedged."""
+        stall_s = self.ecfg.dispatch_stall_ms / 1e3
+        if stall_s <= 0 or not self._fifo:
+            return
+        head = self._fifo[0]
+        if head.ready.is_set():
+            return
+        t_dispatch = getattr(head, "t_dispatch", 0.0) or getattr(
+            head, "t0", 0.0)
+        if time.monotonic() - max(t_dispatch, self._t_last_ready) > stall_s:
+            raise _DispatchStall(head)
+
+    def _wait_ready(self, item, t_dispatch: float):
+        """Block until the sync worker marks ``item`` ready — with the
+        stall watchdog armed (dispatch_stall_ms > 0), never forever.
+
+        The reference point is max(this item's dispatch, the LAST ready
+        transition of any item): a deep pipeline where the head is slow
+        but the worker is visibly progressing is load, not a stall. jax
+        compilation happens inside the dispatch call on this thread, so
+        compile time never eats the stall budget."""
+        stall_s = self.ecfg.dispatch_stall_ms / 1e3
+        if stall_s <= 0:
+            item.ready.wait()
+            return
+        step = min(stall_s / 2, 0.5)
+        while not item.ready.wait(timeout=step):
+            ref = max(t_dispatch, self._t_last_ready)
+            if time.monotonic() - ref > stall_s:
+                raise _DispatchStall(item)
+
+    def _handle_stall(self, item):
+        """Abort ONLY the stalled item's requests: structured error events,
+        span-ring dump to disk (the PR-6 post-mortem follow-up), slots and
+        FIFO entry released. Device state is kept — slots outside the
+        wedged item keep serving; if the device is truly dead, their own
+        dispatches will stall and be reaped the same way."""
+        import json as _json
+        import logging
+
+        log = logging.getLogger(__name__)
+        pairs = item.slots if isinstance(item, _Burst) else item.group
+        stalled = [(i, snap) for i, snap in pairs if self.slots[i] is snap]
+        with self._lc_lock:
+            self._lc["stalls"] += 1
+        dump_path = ""
+        try:
+            from localai_tpu.services.tracing import dump_ring
+
+            dump_path = dump_ring(self.tracer, self.ecfg.stall_dump_dir)
+            with self._lc_lock:
+                self._lc["stall_dumps"] += 1
+        except Exception:
+            log.exception("stall ring dump failed")
+        log.warning(_json.dumps({
+            "event": "dispatch_stall",
+            "dispatch_stall_ms": self.ecfg.dispatch_stall_ms,
+            "item": type(item).__name__,
+            "requests": [snap.req.request_id for _, snap in stalled],
+            "slots": [i for i, _ in stalled],
+            "ring_dump": dump_path,
+        }))
+        try:
+            self._fifo.remove(item)
+        except ValueError:
+            pass
+        for i, snap in stalled:
+            snap.req.out.put(StreamEvent(
+                token_id=-1, text="", logprob=0.0, finish_reason="stop",
+                error=(f"device dispatch stalled > "
+                       f"{self.ecfg.dispatch_stall_ms} ms; request aborted"),
+                error_kind="stall"))
+            snap.req.out.put(None)
+            self._release_slot(i)
+            self._process_fork_waiters(i)
 
     def _start_request(self, req: GenRequest):
         """Admit a request: install sampling state and queue its prompt for
@@ -3277,7 +3511,7 @@ class Engine:
         state from the host mirrors without a chain rebuild."""
         if not item.ready.is_set():
             tr = time.monotonic()
-            item.ready.wait()
+            self._wait_ready(item, item.t0)
             self._tmark("finalize_sync", tr)
         if item.err is not None:
             raise item.err
@@ -3638,7 +3872,7 @@ class Engine:
             return
         t0 = time.monotonic()
         if not b.ready.is_set():
-            b.ready.wait()                  # worker-side sync in flight
+            self._wait_ready(b, b.t_dispatch)   # worker-side sync in flight
         if b.err is not None:
             raise b.err
         packed = b.pack_np                  # [2K+1(+2), S] f32

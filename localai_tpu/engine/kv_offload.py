@@ -40,8 +40,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import zlib
 
 import numpy as np
+
+from localai_tpu.services.faults import FAULTS
 
 log = logging.getLogger(__name__)
 
@@ -71,8 +74,22 @@ def _leaf_bytes(rows) -> int:
     return int(rows.nbytes)
 
 
+def _page_crc(k, v) -> int:
+    """CRC32 over both pages' leaf bytes, in a stable leaf order.
+    Host RAM holding gigabytes of KV state for hours is exactly where a
+    flipped bit silently corrupts generations — a restore must be
+    byte-exact or not happen at all (re-prefill is always correct)."""
+    crc = 0
+    for rows in (k, v):
+        leaves = rows.values() if isinstance(rows, dict) else (rows,)
+        for a in leaves:
+            crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return crc
+
+
 class _HostEntry:
-    __slots__ = ("key", "parent", "depth", "tick", "k", "v", "nbytes")
+    __slots__ = ("key", "parent", "depth", "tick", "k", "v", "nbytes",
+                 "crc")
 
     def __init__(self, key: bytes, parent: bytes, depth: int, tick: int,
                  k, v):
@@ -85,6 +102,7 @@ class _HostEntry:
         self.k = k
         self.v = v
         self.nbytes = _leaf_bytes(k) + _leaf_bytes(v)
+        self.crc = _page_crc(k, v)
 
 
 class HostPageStore:
@@ -107,6 +125,7 @@ class HostPageStore:
         self.hits = 0            # = restores (exported under _hits_total)
         self.misses = 0          # tier consulted, chain not present
         self.evicted_pages = 0   # host -> gone (budget eviction)
+        self.corrupt_dropped = 0  # CRC mismatch at get(): tree dropped
 
     # ---------- introspection ----------
 
@@ -137,6 +156,7 @@ class HostPageStore:
                 "hits": self.hits,
                 "misses": self.misses,
                 "evicted_pages": self.evicted_pages,
+                "corrupt_dropped": self.corrupt_dropped,
             }
 
     # ---------- store operations ----------
@@ -164,12 +184,33 @@ class HostPageStore:
 
     def get(self, key: bytes):
         """Entry for a chain key (LRU-touched), or None — the host half
-        of the two-tier chain walk."""
+        of the two-tier chain walk. The page CRC is verified on EVERY
+        read: a corrupted entry (and its now-untrusted subtree) is
+        dropped and reported as a miss, so the caller re-prefills and
+        the generation stays byte-exact."""
         with self._lock:
             e = self._entries.get(key)
-            if e is not None:
-                self._tick += 1
-                e.tick = self._tick
+            if e is None:
+                return None
+            if FAULTS.active and FAULTS.take("host_store_corrupt") is not None:
+                leaf = next(iter(e.k.values())) if isinstance(e.k, dict) \
+                    else e.k
+                flat = np.ascontiguousarray(leaf).view(np.uint8).reshape(-1)
+                flat[0] ^= 0xFF
+                if isinstance(e.k, dict):
+                    e.k[next(iter(e.k))] = flat.view(leaf.dtype).reshape(
+                        leaf.shape)
+                else:
+                    e.k = flat.view(leaf.dtype).reshape(leaf.shape)
+            if _page_crc(e.k, e.v) != e.crc:
+                log.warning("kv host store: CRC mismatch on page depth=%d"
+                            " — dropping subtree, forcing re-prefill",
+                            e.depth)
+                self._remove_tree_locked(key)
+                self.corrupt_dropped += 1
+                return None
+            self._tick += 1
+            e.tick = self._tick
             return e
 
     def contains(self, key: bytes) -> bool:

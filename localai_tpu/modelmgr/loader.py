@@ -15,6 +15,7 @@ binary serves any TPU/CPU host because XLA owns code generation.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Callable, Optional
@@ -22,8 +23,70 @@ from typing import Callable, Optional
 from localai_tpu.backend import contract_pb2 as pb
 from localai_tpu.backend.service import BackendClient, BackendServicer, make_server
 from localai_tpu.modelmgr.process import BackendProcess, free_port, spawn_python_backend
+from localai_tpu.services.errors import CircuitOpenError
 
 log = logging.getLogger("localai_tpu.modelmgr.loader")
+
+
+class CircuitBreaker:
+    """Per-model load circuit breaker (ISSUE 7 crash recovery): after
+    ``threshold`` CONSECUTIVE spawn/LoadModel failures the breaker opens
+    and load attempts fail fast with CircuitOpenError (HTTP 503 with the
+    breaker state in the body) for ``cooldown_s`` — a crash-looping
+    checkpoint must not burn a spawn + multi-second weight load per
+    request. After the cooldown one probe attempt is let through
+    (half-open); its outcome closes or re-opens the breaker."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.failures = 0
+        self.state = "closed"       # closed | open | half-open
+        self.opened_t = 0.0
+        self._lock = threading.Lock()
+
+    def check(self, model_id: str):
+        """Raise CircuitOpenError if open; transition to half-open when
+        the cooldown has elapsed (that caller becomes the probe)."""
+        with self._lock:
+            if self.state != "open":
+                return
+            remaining = self.cooldown_s - (time.monotonic() - self.opened_t)
+            if remaining <= 0:
+                self.state = "half-open"
+                return
+            # breaker-state dict built inline: snapshot() takes this same
+            # non-reentrant lock
+            raise CircuitOpenError(
+                f"circuit open for model {model_id}: {self.failures} "
+                f"consecutive load failures; retry in {remaining:.1f}s",
+                retry_after_s=max(1.0, remaining),
+                detail={"breaker": {
+                    "state": "open", "failures": self.failures,
+                    "cooldown_s": self.cooldown_s,
+                    "retry_after_s": round(remaining, 1)}})
+
+    def record_failure(self):
+        with self._lock:
+            self.failures += 1
+            if self.state == "half-open" or self.failures >= self.threshold:
+                self.state = "open"
+                self.opened_t = time.monotonic()
+
+    def record_success(self):
+        with self._lock:
+            self.failures = 0
+            self.state = "closed"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            remaining = 0.0
+            if self.state == "open":
+                remaining = max(0.0, self.cooldown_s
+                                - (time.monotonic() - self.opened_t))
+            return {"state": self.state, "failures": self.failures,
+                    "cooldown_s": self.cooldown_s,
+                    "retry_after_s": round(remaining, 1)}
 
 # ordered by priority, mirroring the reference's autoload order
 # (initializers.go:33-57): the main engine first, specialized after.
@@ -56,6 +119,10 @@ class LoadedModel:
         self.health_fails = 0     # consecutive failed idle health probes
         self.first_fail_t = 0.0   # when the current failure streak began
         self.watchdog = None  # set by ModelLoader when a watchdog is attached
+        # set before close() so the supervisor thread can tell an
+        # operator-requested shutdown from a crash it must respawn
+        self.intentional_stop = False
+        self.supervisor: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
     def mark_busy(self):
@@ -76,6 +143,7 @@ class LoadedModel:
             self.watchdog.mark(self.model_id, False)
 
     def close(self):
+        self.intentional_stop = True
         try:
             self.client.close()
         except Exception:
@@ -88,7 +156,11 @@ class LoadedModel:
 
 class ModelLoader:
     def __init__(self, health_attempts: int = 600, health_interval_s: float = 0.5,
-                 single_active: bool = False):
+                 single_active: bool = False,
+                 breaker_threshold: int = 3, breaker_cooldown_s: float = 30.0,
+                 respawn_backoff_base_s: float = 0.5,
+                 respawn_backoff_cap_s: float = 15.0,
+                 respawn_max_attempts: int = 5):
         self.models: dict[str, LoadedModel] = {}
         self._lock = threading.Lock()           # guards the dicts only
         self._load_locks: dict[str, threading.Lock] = {}  # serialize per-model loads
@@ -98,6 +170,16 @@ class ModelLoader:
         self.external_backends: dict[str, str] = {}   # name -> module or host:port
         self.embedded: dict[str, Callable[[], BackendServicer]] = {}
         self.watchdog = None
+        # crash recovery (ISSUE 7): per-model circuit breakers, supervisor
+        # respawn backoff, and respawn telemetry for /metrics
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.respawn_backoff_base_s = respawn_backoff_base_s
+        self.respawn_backoff_cap_s = respawn_backoff_cap_s
+        self.respawn_max_attempts = respawn_max_attempts
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self.respawns: dict[str, int] = {}
+        self._closed = False
 
     # ---- registration ----
 
@@ -158,18 +240,112 @@ class ModelLoader:
                 log.warning("model %s backend %s; respawning", model_id,
                             "process died" if dead else
                             "unhealthy repeatedly")
-                with self._lock:
-                    self._drop(model_id)
+                self._drop(model_id)
             if self.single_active:
+                # pop victims under the lock, close OUTSIDE it: close()
+                # can block up to 10 s in the process-stop grace, and
+                # holding the global lock through it stalls every other
+                # loader operation (ISSUE 7 satellite)
                 with self._lock:
-                    idle_others = [m for m, o in self.models.items()
-                                   if m != model_id and o.busy == 0]
-                    for other_id in idle_others:
-                        self._drop(other_id)
-            lm = self._spawn_and_load(backend_name, model_id, model_opts)
+                    victims = [self._pop_locked(m)
+                               for m, o in list(self.models.items())
+                               if m != model_id and o.busy == 0]
+                for v in victims:
+                    self._close_lm(v)
+            # circuit breaker: a crash-looping model fails fast here with
+            # the breaker state instead of burning another spawn + load
+            breaker = self._breaker(model_id)
+            breaker.check(model_id)
+            try:
+                lm = self._spawn_and_load(backend_name, model_id, model_opts)
+            except Exception:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
             with self._lock:
                 self.models[model_id] = lm
+            self._start_supervisor(lm, backend_name, model_opts)
             return lm
+
+    def _breaker(self, model_id: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(model_id)
+            if b is None:
+                b = self._breakers[model_id] = CircuitBreaker(
+                    self.breaker_threshold, self.breaker_cooldown_s)
+            return b
+
+    # ---- crash recovery (ISSUE 7) ----
+
+    def _start_supervisor(self, lm: LoadedModel, backend_name: str,
+                          model_opts: pb.ModelOptions):
+        """Waiter thread on the backend process: detects death the moment
+        the kernel reaps it (no polling interval) and respawns with
+        exponential backoff + jitter. In-flight streams fail immediately
+        at the gRPC layer (UNAVAILABLE -> structured retryable error via
+        services/errors.py); this thread restores capacity for the NEXT
+        request."""
+        if lm.process is None:
+            return
+        t = threading.Thread(
+            target=self._supervise, args=(lm, backend_name, model_opts),
+            name=f"supervise-{lm.model_id}", daemon=True)
+        lm.supervisor = t
+        t.start()
+
+    def _supervise(self, lm: LoadedModel, backend_name: str,
+                   model_opts: pb.ModelOptions):
+        rc = lm.process.proc.wait()
+        if lm.intentional_stop or self._closed:
+            return
+        with self._lock:
+            if self.models.get(lm.model_id) is not lm:
+                return  # already replaced/dropped by another path
+            self.respawns[lm.model_id] = self.respawns.get(lm.model_id, 0) + 1
+        log.warning(
+            "backend for model %s died unexpectedly (exit %s); "
+            "respawning with backoff", lm.model_id, rc)
+        base = self.respawn_backoff_base_s
+        for attempt in range(self.respawn_max_attempts):
+            # full jitter: crash-looping fleets must not thunder in sync
+            delay = min(self.respawn_backoff_cap_s,
+                        base * (2 ** attempt)) * (0.5 + random.random())
+            time.sleep(delay)
+            if self._closed or lm.intentional_stop:
+                return
+            try:
+                # backend_loader sees the dead process and replaces it;
+                # the breaker counts consecutive failures for us
+                self.backend_loader(backend_name, lm.model_id, model_opts)
+                return
+            except CircuitOpenError:
+                return  # breaker open: stop burning spawns; loads re-probe
+            except Exception as e:
+                log.warning("respawn attempt %d/%d for model %s failed: %s",
+                            attempt + 1, self.respawn_max_attempts,
+                            lm.model_id, e)
+        log.error("model %s: giving up after %d respawn attempts",
+                  lm.model_id, self.respawn_max_attempts)
+
+    def stats(self) -> dict:
+        """Per-model recovery telemetry for /readyz and /metrics:
+        {model: {respawns, breaker, circuit_state}} with circuit_state
+        encoded 0=closed 1=open 2=half-open (Prometheus gauge)."""
+        with self._lock:
+            names = set(self.models) | set(self._breakers) | set(self.respawns)
+            breakers = dict(self._breakers)
+            respawns = dict(self.respawns)
+        out = {}
+        code = {"closed": 0, "open": 1, "half-open": 2}
+        for name in names:
+            b = breakers.get(name)
+            snap = b.snapshot() if b is not None else {
+                "state": "closed", "failures": 0,
+                "cooldown_s": self.breaker_cooldown_s, "retry_after_s": 0.0}
+            out[name] = {"respawns": respawns.get(name, 0),
+                         "breaker": snap,
+                         "circuit_state": code.get(snap["state"], 0)}
+        return out
 
     def greedy_loader(self, model_id: str, model_opts: pb.ModelOptions,
                       order: Optional[list] = None) -> LoadedModel:
@@ -179,6 +355,11 @@ class ModelLoader:
         for name in order or GREEDY_ORDER:
             try:
                 return self.backend_loader(name, model_id, model_opts)
+            except CircuitOpenError:
+                # breaker open is per-MODEL, not per-backend: trying the
+                # next backend would re-raise from the same breaker; the
+                # whole point is a fast 503 with the breaker state
+                raise
             except Exception as e:
                 errors.append(f"{name}: {e}")
         raise RuntimeError("could not load model with any backend: " + "; ".join(errors))
@@ -257,22 +438,47 @@ class ModelLoader:
                 if lm is None:
                     return
                 if lm.busy == 0 or force or time.monotonic() > deadline:
-                    self._drop(model_id)
-                    return
+                    lm = self._pop_locked(model_id)
+                else:
+                    lm = None
+            if lm is not None:
+                # close OUTSIDE the lock: process.stop can block up to
+                # its 10 s grace, and holding the global lock through it
+                # stalls every other loader operation (ISSUE 7 satellite)
+                self._close_lm(lm)
+                return
             time.sleep(min(wait, 5.0))
             wait *= 1.5
 
-    def _drop(self, model_id: str):
+    def _pop_locked(self, model_id: str) -> Optional[LoadedModel]:
+        """Unregister a model; caller holds self._lock. The (possibly
+        slow) close is the caller's job, outside the lock."""
         lm = self.models.pop(model_id, None)
-        if lm is not None:
-            if self.watchdog is not None:
-                self.watchdog.remove(model_id)
+        if lm is not None and self.watchdog is not None:
+            self.watchdog.remove(model_id)
+        return lm
+
+    @staticmethod
+    def _close_lm(lm: Optional[LoadedModel]):
+        if lm is None:
+            return
+        lm.intentional_stop = True   # before close: park the supervisor
+        try:
             lm.close()
+        except Exception:
+            log.exception("backend close failed for model %s", lm.model_id)
+
+    def _drop(self, model_id: str):
+        with self._lock:
+            lm = self._pop_locked(model_id)
+        self._close_lm(lm)
 
     def stop_all(self):
+        self._closed = True
         with self._lock:
-            for model_id in list(self.models):
-                self._drop(model_id)
+            victims = [self._pop_locked(m) for m in list(self.models)]
+        for lm in victims:
+            self._close_lm(lm)
 
 
 def _looks_like_addr(target: str) -> bool:

@@ -38,6 +38,11 @@ class BackendProcess:
         self.proc: Optional[subprocess.Popen] = None
         self._env = env
         self._tail_threads: list = []
+        # readiness/failure markers observed in the log tail: the spawn
+        # retry uses bind_failed to detect losing the free_port() -> bind
+        # race ("address already in use", raised by make_server)
+        self.started = threading.Event()
+        self.bind_failed = threading.Event()
 
     def start(self):
         env = dict(os.environ)
@@ -61,7 +66,12 @@ class BackendProcess:
     def _tail(self, stream, level):
         try:
             for line in iter(stream.readline, b""):
-                log.log(level, "[%s] %s", self.name, line.decode(errors="replace").rstrip())
+                text = line.decode(errors="replace").rstrip()
+                if "gRPC Server listening at" in text:
+                    self.started.set()
+                elif "address already in use" in text.lower():
+                    self.bind_failed.set()
+                log.log(level, "[%s] %s", self.name, text)
         except ValueError:
             pass  # stream closed
 
@@ -84,6 +94,12 @@ class BackendProcess:
                     os.killpg(self.proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
+        # drain the tails before closing the pipes (ISSUE 7 satellite):
+        # the readers see EOF once the process is dead, so this is
+        # bounded — closing first silently dropped the final log lines
+        for t in self._tail_threads:
+            t.join(timeout=5.0)
+        self._tail_threads = []
         for s in (self.proc.stdout, self.proc.stderr):
             try:
                 s.close()
@@ -92,11 +108,40 @@ class BackendProcess:
 
 
 def spawn_python_backend(module: str, extra_args: Optional[list] = None,
-                         env: Optional[dict] = None, name: str = "") -> BackendProcess:
-    """Spawn `python -m <module> --addr 127.0.0.1:<freeport>`."""
-    port = free_port()
-    addr = f"127.0.0.1:{port}"
-    cmd = [sys.executable, "-m", module, "--addr", addr] + (extra_args or [])
-    bp = BackendProcess(cmd, addr, env=env, name=name or module)
-    bp.start()
-    return bp
+                         env: Optional[dict] = None, name: str = "",
+                         bind_race_wait_s: float = 2.0) -> BackendProcess:
+    """Spawn `python -m <module> --addr 127.0.0.1:<freeport>`.
+
+    free_port() closes its probe socket before the backend binds, so
+    another process can steal the port in between (ISSUE 7 satellite):
+    if the child dies with "address already in use" in its tail, retry
+    ONCE with a fresh port. Deliberately one retry — a second loss in a
+    row means something is systematically wrong with the port space.
+    """
+    for attempt in (0, 1):
+        port = free_port()
+        addr = f"127.0.0.1:{port}"
+        cmd = [sys.executable, "-m", module, "--addr", addr] + (extra_args or [])
+        bp = BackendProcess(cmd, addr, env=env, name=name or module)
+        bp.start()
+        if attempt == 1:
+            return bp
+        # watch briefly for the bind race losing; a slow import simply
+        # exhausts the window and proceeds to the caller's health poll
+        deadline = time.monotonic() + bind_race_wait_s
+        while time.monotonic() < deadline:
+            if bp.started.is_set() or bp.bind_failed.is_set() \
+                    or not bp.alive():
+                break
+            time.sleep(0.02)
+        if not bp.alive():
+            # the tail may stamp bind_failed slightly after poll() flips:
+            # give the reader threads a moment to drain the death message
+            for t in bp._tail_threads:
+                t.join(timeout=1.0)
+        if not bp.bind_failed.is_set():
+            return bp
+        log.warning("backend %s lost the %s bind race; retrying on a "
+                    "fresh port", bp.name, addr)
+        bp.stop(grace_s=0.0)
+    return bp  # unreachable; satisfies the type checker

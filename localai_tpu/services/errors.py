@@ -1,0 +1,107 @@
+"""Structured serving errors: one failure vocabulary for every layer.
+
+Raised by the loader (circuit breaker), produced from gRPC status codes
+at the capabilities boundary (a backend abort becomes a typed error,
+never a raw RpcError traceback in a client response), and rendered by
+the HTTP layer as OpenAI-style envelopes with the right status code and
+a ``Retry-After`` header (api/app.py error_response).
+
+The engine communicates the error KIND over the wire as a gRPC status
+code (backend/runner.py maps StreamEvent.error_kind) plus the crude
+retry-after hint as trailing metadata — the hand-rolled stubs cannot
+grow proto fields.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# trailing-metadata key carrying the engine's retry-after hint (seconds)
+META_RETRY_AFTER = "localai-retry-after"
+
+
+class ServingError(RuntimeError):
+    """Base: a request-level failure with an HTTP mapping."""
+
+    status = 500
+    etype = "server_error"
+    retryable = False
+
+    def __init__(self, message: str, retry_after_s: float = 0.0,
+                 detail: Optional[dict] = None):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s or 0.0)
+        self.detail = detail or {}
+
+    def body_extra(self) -> dict:
+        """Extra keys merged into the HTTP error object (breaker state,
+        retryability) so clients can react without parsing messages."""
+        out: dict = {}
+        if self.retryable:
+            out["retryable"] = True
+        if self.retry_after_s:
+            out["retry_after"] = round(self.retry_after_s, 1)
+        out.update(self.detail)
+        return out
+
+
+class OverloadedError(ServingError):
+    """Admission control shed the request (bounded queue / queue-wait)."""
+
+    status = 429
+    etype = "overloaded"
+    retryable = True
+
+
+class BackendUnavailableError(ServingError):
+    """The backend died, is respawning, or aborted the stream."""
+
+    status = 503
+    etype = "backend_unavailable"
+    retryable = True
+
+
+class DeadlineExceededError(ServingError):
+    """request_timeout_ms (or the RPC deadline) expired."""
+
+    status = 504
+    etype = "deadline_exceeded"
+    retryable = False
+
+
+class CircuitOpenError(BackendUnavailableError):
+    """Fast-fail: consecutive spawn/LoadModel failures opened the
+    breaker. ``detail["breaker"]`` carries the breaker state and ends up
+    verbatim in the 503 body."""
+
+    etype = "circuit_open"
+
+
+def wrap_backend_error(e: BaseException, model: str = "") -> BaseException:
+    """gRPC RpcError -> typed ServingError, RETURNED (for
+    ``raise wrap_backend_error(e, name) from e``). Anything already
+    structured — or not a gRPC error — passes through unchanged."""
+    import grpc
+
+    if isinstance(e, ServingError) or not isinstance(e, grpc.RpcError):
+        return e
+    code = e.code() if callable(getattr(e, "code", None)) else None
+    details = e.details() if callable(getattr(e, "details", None)) else str(e)
+    msg = f"model {model}: {details}" if model else str(details)
+    ra = 0.0
+    try:
+        for k, v in (e.trailing_metadata() or ()):
+            if k == META_RETRY_AFTER:
+                ra = float(v)
+    except Exception:
+        pass
+    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+        return OverloadedError(msg, retry_after_s=ra or 1.0)
+    if code == grpc.StatusCode.UNAVAILABLE:
+        return BackendUnavailableError(msg, retry_after_s=ra or 2.0)
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        return DeadlineExceededError(msg)
+    if code == grpc.StatusCode.ABORTED:
+        # engine stall abort: this request died but the backend survives
+        return BackendUnavailableError(msg, retry_after_s=ra or 1.0)
+    return ServingError(msg)

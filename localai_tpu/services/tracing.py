@@ -21,6 +21,9 @@ observed at sync-worker completion.
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
 import threading
 import time
 
@@ -191,3 +194,21 @@ def chrome_trace(tracer: RingTracer, pid: int = 1,
             "args": args,
         })
     return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def dump_ring(tracer: RingTracer, out_dir: str = "", tag: str = "stall") -> str:
+    """Write the span ring to disk as perfetto-loadable JSON; return the path.
+
+    The post-mortem half of the stall watchdog (ROADMAP PR-6 follow-up
+    "stream the ring to disk for post-mortem of wedged runs"): when the
+    engine aborts a wedged dispatch it calls this so the trace of the
+    run-up to the stall survives the process.
+    """
+    out_dir = out_dir or tempfile.gettempdir()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(
+        out_dir,
+        f"localai-{tag}-{os.getpid()}-{int(time.time() * 1e3)}.trace.json")
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer), f)
+    return path

@@ -5,8 +5,9 @@
 #   scripts/ci.sh            # or: make ci
 #
 # Fails (rc != 0) if either stage fails. Environment knobs:
-#   TIER1_BUDGET_S          tier-1 wall clock (default 870, run_tier1.sh)
-#   LOCALAI_BENCH_BUDGET_S  bench smoke wall clock (default 300 here)
+#   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
+#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 300 here)
+#   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #
 # Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
@@ -45,5 +46,33 @@ print(f"HOST_LOOP_MS={d.get('host_loop')} "
       f"FINISH_DETECT_MS={d.get('finish_detect')}")
 PY
 rm -f "$smoke_out"
+
+# Fault-lifecycle SLO (ISSUE 7): saturation shed must stay structured
+# and < 50 ms, an injected stall must abort only its own request and
+# dump the span ring, and the next request must reproduce the pre-fault
+# greedy baseline byte-for-byte. rc != 0 if any of that regresses.
+echo "== ci: bench chaos =="
+chaos_out=$(mktemp)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=128 \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_CHAOS_BUDGET_S:-180}" \
+    python bench.py --chaos | tee "$chaos_out"
+
+python - "$chaos_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{"):
+        line = json.loads(ln)
+print(f"CHAOS_RECOVERED={line.get('recovered')} "
+      f"CHAOS_SHED={line.get('shed')} "
+      f"shed_p95_ms={line.get('shed_p95_ms')} "
+      f"stall_dump={line.get('stall_dump')} "
+      f"survivors_identical={line.get('survivors_identical')}")
+sys.exit(0 if line.get("value") == 1 else 1)
+PY
+rm -f "$chaos_out"
 
 echo "== ci: OK =="

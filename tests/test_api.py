@@ -288,7 +288,11 @@ def test_unknown_model_404s_cleanly(server):
         "model": "definitely-not-a-model",
         "messages": [{"role": "user", "content": "x"}],
     }, timeout=120)
-    assert r.status_code == 500
+    # the backend aborts the load UNAVAILABLE (model fetch failed), which
+    # the lifecycle error taxonomy renders as a retryable 503 envelope;
+    # a backend without that mapping still 500s — either way a clean
+    # JSON error, never a raw traceback
+    assert r.status_code in (500, 503)
     assert "error" in r.json()
 
 
