@@ -335,6 +335,54 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
 
 
 
+def _cold_bucket_probe(engine, ecfg) -> dict:
+    """Force one compile AFTER warmup and verify the sysobs pipeline
+    catches it: a packed-prefill program at a pack size precompile()'s
+    ladder never contains (budget + 7), invoked with the all-pads
+    warmup arguments so it writes nothing. Expected: exactly one
+    compiles_after_warmup increment + one compile_storm event in the
+    process event ring."""
+    from localai_tpu.engine import sampling
+    from localai_tpu.services import sysobs
+    from localai_tpu.services.eventlog import EVENTS
+
+    out = {"detected": False, "compiles_after_warmup_delta": 0,
+           "storm_event": False}
+    if not getattr(engine, "_packed", False):
+        out["error"] = "packed prefill off"
+        return out
+    before = engine._cobs.snapshot()
+    try:
+        S_, C_ = ecfg.num_slots, ecfg.max_context
+        bucket = engine._pack_budget + 7
+        sent = np.full((S_,), S_, np.int32)
+        zs = np.zeros((S_,), np.int32)
+        pack_args = (np.zeros((bucket,), np.int32),
+                     np.full((bucket,), C_, np.int32),
+                     np.full((bucket,), S_, np.int32),
+                     sent, zs, zs, zs, np.zeros((S_,), np.bool_))
+        spp = sampling.pack_slot_params(engine.slot_params)
+        with sysobs.activated(engine._cobs):
+            fn = engine._get_packed_fn(bucket, False)
+            _, _, engine.ck, engine.cv, engine.rng_keys, _ = fn(
+                engine.params, *pack_args, engine.ck, engine.cv,
+                engine.ring, engine.ring_pos, engine.bias,
+                engine.rng_keys, spp, engine.mu)
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:200]
+        return out
+    after = engine._cobs.snapshot()
+    delta = (after["compiles_after_warmup"]
+             - before["compiles_after_warmup"])
+    out["compiles_after_warmup_delta"] = delta
+    out["storm_event"] = any(
+        ev.get("event") == "compile_storm"
+        and "prefill_pack" in str(ev.get("program", ""))
+        for ev in EVENTS.events())
+    out["detected"] = delta >= 1 and out["storm_event"]
+    return out
+
+
 def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     """Closed-loop serving measurement: keep the engine saturated with S
     in-flight requests (fresh one submitted as each completes), run until
@@ -492,6 +540,13 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     final_metrics = engine.metrics()
     kv_layout = final_metrics.get("kv_layout", "")
     engine.shutdown()
+    # cold-bucket probe (ISSUE 8 acceptance): a novel pack size — one
+    # precompile() never visits — must be DETECTED as a compile storm:
+    # counted in compiles_after_warmup and emitted as a structured
+    # compile_storm event. Driven through the real fn-getter seam with
+    # the all-pads warmup idiom (writes no KV rows); runs after
+    # shutdown so the donated-buffer reassignment can't race the loop.
+    cold_bucket = _cold_bucket_probe(engine, ecfg)
     if errors:
         raise RuntimeError(errors[0])
     p50 = float(np.percentile(ttfts, 50) * 1e3)
@@ -509,6 +564,20 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         "completion_tokens": completed,
         "wall_s": wall,
     }
+    # system observability (ISSUE 8): compile hygiene of the measured
+    # run (must be 0 — precompile covers every serving-path variant),
+    # pool high-water mark, goodput/MFU (MFU is honest-0 on CPU unless
+    # LOCALAI_PEAK_TFLOPS / peak_tflops says otherwise), plus the
+    # intentionally-cold-bucket detection probe
+    so = final_metrics.get("sysobs") or {}
+    out["compiles_after_warmup"] = (so.get("compiles")
+                                    or {}).get("compiles_after_warmup")
+    out["peak_pool_pages"] = (so.get("watermarks")
+                              or {}).get("peak_pool_pages_in_use")
+    gp = so.get("goodput") or {}
+    out["mfu"] = gp.get("mfu")
+    out["goodput_tokens"] = gp.get("goodput_tokens_total")
+    out["cold_bucket"] = cold_bucket
     if decomp:
         d = np.asarray(decomp)
         out["ttft_decomp_p50_ms"] = {
@@ -1367,6 +1436,11 @@ def _engine_direct_decomp(deadline: float, partial: dict) -> dict:
                         "span_breakdown_ms": r.get("span_breakdown_ms"),
                         "ttft_decomp_p50_ms": r.get("ttft_decomp_p50_ms"),
                         "tok_s": r.get("value"),
+                        "compiles_after_warmup": r.get(
+                            "compiles_after_warmup"),
+                        "peak_pool_pages": r.get("peak_pool_pages"),
+                        "mfu": r.get("mfu"),
+                        "cold_bucket": r.get("cold_bucket"),
                     }
         if not out:
             out = {"error": (f"rc={res.returncode} "
@@ -1518,6 +1592,12 @@ def main():
                if "host_device_decomp_ms" in r else {}),
             **({"span_breakdown_ms": r["span_breakdown_ms"]}
                if "span_breakdown_ms" in r else {}),
+            # sysobs (ISSUE 8): compile hygiene + pool peak + MFU +
+            # the cold-bucket detection probe
+            "compiles_after_warmup": r.get("compiles_after_warmup"),
+            "peak_pool_pages": r.get("peak_pool_pages"),
+            "mfu": r.get("mfu"),
+            "cold_bucket": r.get("cold_bucket"),
         }))
         return
 
@@ -1554,6 +1634,15 @@ def main():
             # measured host-loop vs device-time split from the span
             # tracer (scripts/ci.sh HOST_LOOP_MS/... tracked line)
             "host_device_decomp": decomp,
+            # sysobs tracked numbers (ISSUE 8, scripts/ci.sh
+            # COMPILES_AFTER_WARMUP/PEAK_POOL_PAGES/MFU line): compile
+            # hygiene of the repeated-wave serving phase must be 0, and
+            # the intentionally cold bucket must be detected
+            "compiles_after_warmup": decomp.get("compiles_after_warmup"),
+            "peak_pool_pages": decomp.get("peak_pool_pages"),
+            "mfu": decomp.get("mfu"),
+            "cold_bucket_detected": (decomp.get("cold_bucket")
+                                     or {}).get("detected"),
         }))
         sys.exit(0 if ok else 1)
 

@@ -18,7 +18,8 @@ from aiohttp import web
 from localai_tpu import __version__
 from localai_tpu.api.app import api_error, get_state
 from localai_tpu.backend import contract_pb2 as pb
-from localai_tpu.services.metrics import METRICS
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.metrics import CONTENT_TYPE, METRICS, label_str
 
 
 def register(app: web.Application):
@@ -53,6 +54,10 @@ def register(app: web.Application):
     r.add_get("/v1/tokenMetrics", token_metrics)
     r.add_get("/debug/trace", debug_trace)
     r.add_get("/debug/profile", debug_profile)
+    # system observability (ISSUE 8): live engine-state snapshot +
+    # merged structured event log
+    r.add_get("/debug/state", debug_state)
+    r.add_get("/debug/events", debug_events)
     # gallery (reference: routes/localai.go:14-44)
     r.add_post("/models/apply", models_apply)
     r.add_post("/models/delete/{name}", models_delete)
@@ -70,26 +75,52 @@ async def healthz(request):
     return web.Response(text="OK")
 
 
+def _readyz_load(state) -> dict:
+    """Per-model queue depth + slots-in-flight off the (cheap, native)
+    GetMetrics fields, short-timeout and failure-tolerant: readiness
+    must answer even when a backend is wedged."""
+    out = {}
+    for name in state.caps.loader.list_loaded():
+        lm = state.caps.loader.get(name)
+        if lm is None:
+            continue
+        try:
+            m = lm.client.get_metrics(timeout=1.0)
+            out[name] = {"queue_depth": int(m.queued),
+                         "slots_in_flight": int(m.slots_active),
+                         "slots_total": int(m.slots_total)}
+        except Exception:
+            out[name] = {"queue_depth": None, "slots_in_flight": None}
+    return out
+
+
 async def readyz(request):
     """Readiness distinct from liveness: 503 (with Retry-After) while any
     model's load circuit breaker is open — the process is alive, but a
-    load balancer should prefer other replicas until the breaker cools."""
+    load balancer should prefer other replicas until the breaker cools.
+    The body carries the full breaker map plus per-model queue depth and
+    slots-in-flight (ISSUE 8 satellite, closes the PR-7 follow-up) so an
+    external LB can weight replicas, not just drop them."""
     state = get_state(request)
     try:
         stats = state.caps.loader.stats()
     except Exception:
         stats = {}
-    open_breakers = {name: s["breaker"] for name, s in stats.items()
-                     if s["breaker"]["state"] == "open"}
+    breakers = {name: s["breaker"] for name, s in stats.items()}
+    open_breakers = {name: b for name, b in breakers.items()
+                     if b["state"] == "open"}
+    load = await state.run_blocking(_readyz_load, state)
     if open_breakers:
         retry_after = max(1, int(max(
             b.get("retry_after_s", 0.0) for b in open_breakers.values())))
         return web.json_response(
-            {"status": "unready", "circuit_open": open_breakers},
+            {"status": "unready", "circuit_open": open_breakers,
+             "breakers": breakers, "load": load},
             status=503, headers={"Retry-After": str(retry_after)})
     return web.json_response(
         {"status": "ready",
-         "models_loaded": len(state.caps.loader.list_loaded())})
+         "models_loaded": len(state.caps.loader.list_loaded()),
+         "breakers": breakers, "load": load})
 
 
 async def run_audio_capability(request, call) -> web.Response:
@@ -143,6 +174,19 @@ _LIFECYCLE_COUNTERS = (("requests_shed", "requests_shed_total"),
                        ("requests_timed_out", "requests_timed_out_total"),
                        ("stalls", "engine_stalls_total"),
                        ("stall_dumps", "stall_dumps_total"))
+# system observability (ISSUE 8): XLA compile tracking + memory
+# watermarks + goodput/MFU, from engine metrics()["sysobs"]
+_SYSOBS_COUNTERS = ("xla_compiles_total", "xla_compiles_after_warmup_total",
+                    "goodput_tokens_total")
+_SYSOBS_GAUGES = ("xla_compile_seconds", "mfu", "goodput_tok_s",
+                  "mem_weight_bytes", "mem_pool_frag_holes",
+                  "mem_pool_frag_ratio")
+# watermark keys are prefixed mem_ on export; the known set is cleared
+# explicitly so unloads don't leave stale per-model peaks behind
+_SYSOBS_WATERMARKS = ("peak_queued", "peak_slots_active",
+                      "peak_tokens_total", "peak_pool_active_pages",
+                      "peak_pool_retained_pages", "peak_pool_pages_in_use",
+                      "peak_host_offloaded_pages", "peak_host_bytes")
 
 
 def _refresh_engine_metrics(state):
@@ -163,6 +207,8 @@ def _refresh_engine_metrics(state):
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
               *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS),
               *(m for _k, m in _LIFECYCLE_COUNTERS),
+              *_SYSOBS_COUNTERS, *_SYSOBS_GAUGES,
+              *(f"mem_{k}" for k in _SYSOBS_WATERMARKS),
               "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
     # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
@@ -171,9 +217,9 @@ def _refresh_engine_metrics(state):
     try:
         for name, s in state.caps.loader.stats().items():
             METRICS.set_counter("backend_respawns_total", s["respawns"],
-                                f'model="{name}"')
+                                label_str(model=name))
             METRICS.set_gauge("circuit_state", s["circuit_state"],
-                              f'model="{name}"')
+                              label_str(model=name))
     except Exception:
         pass
     for name in state.caps.loader.list_loaded():
@@ -191,29 +237,74 @@ def _refresh_engine_metrics(state):
         if td:
             for skey, mkey in _TTFT_GAUGES:
                 METRICS.set_gauge(f"ttft_{mkey}_p50_ms",
-                                  td.get(skey, 0.0), f'model="{name}"')
+                                  td.get(skey, 0.0), label_str(model=name))
             METRICS.set_gauge("ttft_samples", td.get("n", 0),
-                              f'model="{name}"')
+                              label_str(model=name))
         # scheduler load gauges + latency histograms (any layout)
         METRICS.set_gauge("queue_depth", stats.get("queued", 0),
-                          f'model="{name}"')
+                          label_str(model=name))
         METRICS.set_gauge("slots_in_flight", stats.get("slots_active", 0),
-                          f'model="{name}"')
+                          label_str(model=name))
         for hname, h in (stats.get("histograms") or {}).items():
             if hname in _LATENCY_HISTOGRAMS:
-                METRICS.set_histogram(hname, f'model="{name}"',
+                METRICS.set_histogram(hname, label_str(model=name),
                                       h.get("le", ()), h.get("counts", ()),
                                       h.get("sum", 0.0), h.get("count", 0))
         pp = stats.get("packed_prefill")
         if pp and stats.get("prefill_packed"):
             for key in _PACKED_COUNTERS:
                 METRICS.set_counter(f"prefill_packed_{key}_total",
-                                    pp.get(key, 0), f'model="{name}"')
+                                    pp.get(key, 0), label_str(model=name))
         lc = stats.get("lifecycle")
         if lc:
             for skey, mkey in _LIFECYCLE_COUNTERS:
                 METRICS.set_counter(mkey, lc.get(skey, 0),
-                                    f'model="{name}"')
+                                    label_str(model=name))
+        # system observability (ISSUE 8): compile counters, memory
+        # watermarks, goodput/MFU
+        so = stats.get("sysobs")
+        if so:
+            comp = so.get("compiles") or {}
+            METRICS.set_counter("xla_compiles_total",
+                                comp.get("compiles_total", 0),
+                                label_str(model=name))
+            METRICS.set_counter("xla_compiles_after_warmup_total",
+                                comp.get("compiles_after_warmup", 0),
+                                label_str(model=name))
+            # float seconds: exposed as a gauge (set_counter truncates)
+            METRICS.set_gauge("xla_compile_seconds",
+                              comp.get("compile_seconds_total", 0.0),
+                              label_str(model=name))
+            gp = so.get("goodput") or {}
+            METRICS.set_counter("goodput_tokens_total",
+                                gp.get("goodput_tokens_total", 0),
+                                label_str(model=name))
+            METRICS.set_gauge("goodput_tok_s", gp.get("goodput_tok_s", 0.0),
+                              label_str(model=name))
+            METRICS.set_gauge("mfu", gp.get("mfu", 0.0),
+                              label_str(model=name))
+            for k, v in (so.get("watermarks") or {}).items():
+                METRICS.set_gauge(f"mem_{k}", v, label_str(model=name))
+            METRICS.set_gauge("mem_weight_bytes",
+                              so.get("weight_bytes", 0),
+                              label_str(model=name))
+            frag = so.get("fragmentation")
+            if frag:
+                METRICS.set_gauge("mem_pool_frag_holes",
+                                  frag.get("hole_pages", 0),
+                                  label_str(model=name))
+                METRICS.set_gauge("mem_pool_frag_ratio",
+                                  frag.get("ratio", 0.0),
+                                  label_str(model=name))
+        # per-span exemplars (ISSUE 8 satellite, closes the PR-6
+        # follow-up): worst-since-last-pull observation per histogram,
+        # tagged with its request correlation id
+        for hname, ex in (stats.get("hist_exemplars") or {}).items():
+            if hname in _LATENCY_HISTOGRAMS:
+                METRICS.set_exemplar(hname, label_str(model=name),
+                                     ex.get("value", 0.0),
+                                     ex.get("trace_id", ""),
+                                     ex.get("ts", 0.0))
         if stats.get("kv_layout") != "paged":
             continue
         for key in _POOL_GAUGES:
@@ -222,25 +313,25 @@ def _refresh_engine_metrics(state):
                 METRICS.set_gauge(
                     "kv_pool_pages",
                     stats[key],
-                    f'model="{name}",state="{state_name}"')
+                    label_str(model=name, state=state_name))
         if "kv_pool_oversubscription" in stats:
             METRICS.set_gauge("kv_pool_oversubscription",
                               stats["kv_pool_oversubscription"],
-                              f'model="{name}"')
+                              label_str(model=name))
         pc = stats.get("prefix_cache")
         if pc:
             METRICS.set_gauge("prefix_cache_entries", pc.get("entries", 0),
-                              f'model="{name}"')
+                              label_str(model=name))
             for key in _PCACHE_COUNTERS:
                 METRICS.set_counter(f"prefix_cache_{key}_total",
-                                    pc.get(key, 0), f'model="{name}"')
+                                    pc.get(key, 0), label_str(model=name))
         off = stats.get("kv_offload")
         if off:
             METRICS.set_gauge("kv_offload_host_bytes", off.get("bytes", 0),
-                              f'model="{name}"')
+                              label_str(model=name))
             for skey, mkey in _OFFLOAD_COUNTERS:
                 METRICS.set_counter(f"kv_offload_{mkey}_total",
-                                    off.get(skey, 0), f'model="{name}"')
+                                    off.get(skey, 0), label_str(model=name))
 
 
 async def metrics(request):
@@ -248,7 +339,11 @@ async def metrics(request):
     if state.config.disable_metrics_endpoint:
         return api_error("metrics disabled", 404)
     await state.run_blocking(_refresh_engine_metrics, state)
-    return web.Response(text=METRICS.render(), content_type="text/plain")
+    # full Content-Type set via headers: aiohttp's content_type= kwarg
+    # rejects parameters (";"), and the exposition version IS part of
+    # the Prometheus scrape contract (ISSUE 8 satellite)
+    return web.Response(text=METRICS.render(),
+                        headers={"Content-Type": CONTENT_TYPE})
 
 
 def _collect_traces(state) -> dict:
@@ -285,6 +380,79 @@ async def debug_trace(request):
     state = get_state(request)
     trace = await state.run_blocking(_collect_traces, state)
     return web.json_response(trace)
+
+
+def _backend_state_payloads(state) -> dict:
+    """Pull each loaded backend's GetState JSON (engine snapshot + event
+    ring). Backends without GetState (tts, diffusion, old fakes) answer
+    UNIMPLEMENTED and are skipped — debug surfaces never 500 because one
+    backend can't answer."""
+    import json as _json
+
+    out = {}
+    for name in state.caps.loader.list_loaded():
+        lm = state.caps.loader.get(name)
+        if lm is None:
+            continue
+        try:
+            r = lm.client.get_state(timeout=5.0)
+            out[name] = _json.loads(bytes(r.message).decode("utf-8"))
+        except Exception:
+            continue
+    return out
+
+
+def _collect_state(state) -> dict:
+    """One live-JSON snapshot of the whole serving system (ISSUE 8):
+    core uptime + loader recovery stats + per-engine slots/queues/pool
+    map/compile history, plus the core process's own event-log ring."""
+    try:
+        loader_stats = state.caps.loader.stats()
+    except Exception:
+        loader_stats = {}
+    payloads = _backend_state_payloads(state)
+    return {
+        "uptime_s": round(time.time() - state.started_at, 1),
+        "version": __version__,
+        "loader": loader_stats,
+        "models": {name: p.get("state") for name, p in payloads.items()},
+        "eventlog": EVENTS.snapshot(),
+    }
+
+
+async def debug_state(request):
+    """Live JSON of engine internals: slots in flight, queue depths, kv
+    pool map, breaker state, last N compiles (ISSUE 8 tentpole)."""
+    state = get_state(request)
+    snap = await state.run_blocking(_collect_state, state)
+    return web.json_response(snap)
+
+
+def _collect_events(state, last: int = 0) -> list:
+    """Merge the core process's event ring with every backend's (pulled
+    over GetState), tag each record's origin, and return them in time
+    order — one correlation-id'd stream across process boundaries."""
+    merged = [dict(ev, proc="core") for ev in EVENTS.events()]
+    for name, p in _backend_state_payloads(state).items():
+        for ev in p.get("events") or []:
+            merged.append(dict(ev, proc=f"backend:{name}", model=name))
+    merged.sort(key=lambda ev: ev.get("ts", 0.0))
+    if last > 0:
+        merged = merged[-last:]
+    return merged
+
+
+async def debug_events(request):
+    """Merged structured event log (admissions, sheds, timeouts,
+    respawns, circuit transitions, compile storms, pool pressure) from
+    the core and every backend: GET /debug/events[?last=N]."""
+    state = get_state(request)
+    try:
+        last = int(request.query.get("last", 0))
+    except ValueError:
+        return api_error("last must be an integer", 400)
+    events = await state.run_blocking(_collect_events, state, last)
+    return web.json_response({"events": events, "count": len(events)})
 
 
 async def debug_profile(request):

@@ -129,6 +129,27 @@ class FakeServicer(BackendServicer):
     def GetMetrics(self, request, context):
         return pb.MetricsResponse(slots_total=1, slots_active=0)
 
+    def GetState(self, request, context):
+        # minimal engine-state + event-ring snapshot (the /debug/state
+        # and /debug/events merge paths need a backend that answers;
+        # shape mirrors backend/runner.py GetState)
+        import json
+        import time
+
+        return pb.Reply(message=json.dumps({
+            "state": {"slots": [None], "slots_active": 0, "queued": 0,
+                      "warm": True,
+                      "compiles": {"compiles_total": 0,
+                                   "compile_seconds_total": 0.0,
+                                   "compiles_after_warmup": 0,
+                                   "warm": True},
+                      "last_compiles": [], "watermarks": {},
+                      "goodput": {"goodput_tokens_total": 0, "mfu": 0.0},
+                      "weight_bytes": 0},
+            "events": [{"ts": time.time(), "event": "admit", "seq": 1,
+                        "rid": "fake0000"}],
+        }).encode("utf-8"))
+
     def GetTrace(self, request, context):
         # minimal valid Chrome trace (the /debug/trace merge path needs
         # a backend that answers; shape mirrors services/tracing.py)

@@ -359,6 +359,12 @@ class EngineServicer(BackendServicer):
                else {}),
             **({"stall_dump_dir": sdd} if (sdd := str(
                 extra.get("stall_dump_dir", "") or "")) else {}),
+            # system observability (ISSUE 8): structured event-log sink
+            # (path|stderr|off) + peak device TFLOP/s for MFU accounting
+            **({"event_log": evl} if (evl := str(
+                extra.get("event_log", "") or "")) else {}),
+            **({"peak_tflops": ptf} if (ptf := float(
+                extra.get("peak_tflops", 0) or 0)) > 0 else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -614,6 +620,23 @@ class EngineServicer(BackendServicer):
         except Exception as e:
             context.abort(grpc.StatusCode.INTERNAL,
                           f"trace export failed: {type(e).__name__}: {e}")
+        return pb.Reply(message=payload.encode("utf-8"))
+
+    def GetState(self, request, context) -> pb.Reply:
+        """Live engine-state snapshot + this backend process's event-log
+        ring as JSON (ISSUE 8). The core's /debug/state and /debug/events
+        endpoints merge one of these per loaded model."""
+        self._require_ready(context)
+        from localai_tpu.services.eventlog import EVENTS
+
+        try:
+            payload = json.dumps({
+                "state": self.engine.state_snapshot(),
+                "events": EVENTS.events(),
+            }, default=str)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"state export failed: {type(e).__name__}: {e}")
         return pb.Reply(message=payload.encode("utf-8"))
 
     def Profile(self, request, context) -> pb.Result:

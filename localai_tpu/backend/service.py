@@ -47,7 +47,10 @@ METHODS = {
     #   GetTrace: Reply.message carries Chrome trace-event JSON (UTF-8)
     #   Profile:  PredictOptions.prompt carries a JSON {"seconds": N};
     #             Result.message is the capture directory
+    #   GetState: Reply.message carries a JSON {"state": engine state
+    #             snapshot, "events": event-log ring} (ISSUE 8)
     "GetTrace": (pb.MetricsRequest, pb.Reply, False),
+    "GetState": (pb.MetricsRequest, pb.Reply, False),
     "Profile": (pb.PredictOptions, pb.Result, False),
     "StoresSet": (pb.StoresSetOptions, pb.Result, False),
     "StoresDelete": (pb.StoresDeleteOptions, pb.Result, False),
@@ -275,6 +278,11 @@ class BackendClient:
         """Chrome trace-event JSON of the engine's span ring (UTF-8 in
         Reply.message)."""
         return self._stubs["GetTrace"](pb.MetricsRequest(), timeout=timeout)
+
+    def get_state(self, timeout: float = 10.0) -> pb.Reply:
+        """Live engine-state + event-log ring snapshot (JSON in
+        Reply.message, ISSUE 8). Read-only — safe to retry."""
+        return self._retry_unary("GetState", pb.MetricsRequest(), timeout)
 
     def profile(self, seconds: float, timeout: float = 120.0) -> pb.Result:
         """Capture a jax.profiler trace for `seconds`; Result.message is

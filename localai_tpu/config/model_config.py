@@ -143,9 +143,13 @@ class ModelConfig:
     # observability knobs trace=0|1 (request-lifecycle span tracer,
     # default on), trace_ring_size=N (retained spans, default 4096) and
     # slow_request_ms=N (log a span decomposition when TTFT or e2e
-    # exceeds N ms; 0 = off). The known
-    # knobs are value-validated in validate() so a typo fails at config
-    # scan instead of silently running the default.
+    # exceeds N ms; 0 = off), or the system-observability knobs (ISSUE 8)
+    # event_log=path|stderr|off (structured JSON-lines event sink for the
+    # backend process; the ring at /debug/events works regardless) and
+    # peak_tflops=N (override the device peak used for MFU — needed on
+    # CPU/unknown device kinds where the built-in table reports 0).
+    # The known knobs are value-validated in validate() so a typo fails
+    # at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
     mesh: dict = dataclasses.field(default_factory=dict)  # {dp: 1, tp: 8, ...}
     prefill_buckets: list = dataclasses.field(default_factory=list)
@@ -251,6 +255,14 @@ class ModelConfig:
             elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):
                 problems.append(
                     f"prefill_packed_fuse must be auto|0|1, got {v!r}")
+            elif k == "peak_tflops":
+                try:
+                    if float(v) < 0:
+                        problems.append(
+                            f"peak_tflops must be >= 0, got {v!r}")
+                except ValueError:
+                    problems.append(
+                        f"peak_tflops must be a number, got {v!r}")
         return problems
 
     def usecases(self) -> Usecase:

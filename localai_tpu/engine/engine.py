@@ -55,6 +55,8 @@ import numpy as np
 
 from localai_tpu.engine import sampling
 from localai_tpu.engine.detok import IncrementalDetokenizer
+from localai_tpu.services import sysobs
+from localai_tpu.services.eventlog import EVENTS
 from localai_tpu.services.faults import FAULTS
 from localai_tpu.models import llama
 from localai_tpu.ops import kvcache
@@ -213,6 +215,14 @@ class EngineConfig:
     dispatch_stall_ms: int = 30000
     # where stall ring dumps land; "" = the system temp dir.
     stall_dump_dir: str = ""
+    # --- system observability (ISSUE 8) ---
+    # structured event-log sink: a file path, "stderr", or "off"/"" for
+    # ring-only (events are ALWAYS retained in the bounded in-memory
+    # ring surfaced at /debug/events; this knob adds write-through).
+    event_log: str = ""
+    # peak device TFLOP/s for MFU accounting; 0 = auto (TPU device-kind
+    # table / LOCALAI_PEAK_TFLOPS env; unknown hardware reports MFU 0).
+    peak_tflops: float = 0.0
 
 
 @dataclasses.dataclass
@@ -707,6 +717,37 @@ class Engine:
         self._fork_fns: dict = {}
         # grammar slots whose mask row changed since the last device flush
         self._gbias_flush: set = set()
+        # --- system observability (ISSUE 8, services/sysobs.py) ---
+        # structured event-log sink (per-process singleton; the engine's
+        # knob arms it for this backend process)
+        if self.ecfg.event_log:
+            EVENTS.configure(self.ecfg.event_log)
+        # XLA compile tracking: the jax.monitoring listener dispatches to
+        # this tracker from whichever thread registered it (the engine
+        # loop registers at startup; precompile() wraps itself)
+        self._cobs = sysobs.CompileTracker(
+            model=self._fam_name,
+            on_storm=lambda rec: EVENTS.emit("compile_storm", **rec))
+        # memory watermarks: peaks folded from engine-loop tick samples
+        self._wm = sysobs.Watermarks()
+        try:
+            self._weight_bytes = int(sum(
+                a.size * a.dtype.itemsize for a in jax.tree.leaves(params)
+                if hasattr(a, "size") and hasattr(a, "dtype")))
+        except Exception:
+            self._weight_bytes = 0
+        # goodput/MFU: completed-request tokens only (sheds and timeouts
+        # burn FLOPs but never reach the clean-finish accounting)
+        peak = (self.ecfg.peak_tflops * 1e12 if self.ecfg.peak_tflops > 0
+                else sysobs.peak_device_flops())
+        fpt = (sysobs.flops_per_token(self.cfg, ctx=C // 2)
+               if self._fam_llama else 0.0)
+        self._goodput = sysobs.GoodputMeter(flops_per_tok=fpt,
+                                            peak_flops=peak)
+        # exemplar tracking: worst observation per histogram since the
+        # last metrics() pull, with its request correlation id
+        self._hist_worst: dict = {}
+        self._pool_pressure = False   # hysteresis for pool_pressure events
 
     def _sync_worker(self):
         """ALL device->host syncs run here, one at a time, in dispatch
@@ -766,7 +807,7 @@ class Engine:
             s[0] += t - t0
             s[1] += 1
 
-    def _hobserve(self, name: str, seconds: float):
+    def _hobserve(self, name: str, seconds: float, rid: str = ""):
         h = self._hists[name]
         for i, b in enumerate(_HIST_BUCKETS[name]):
             if seconds <= b:
@@ -776,6 +817,14 @@ class Engine:
             h[0][-1] += 1
         h[1] += seconds
         h[2] += 1
+        # per-span exemplar (ISSUE 8 satellite): remember the WORST
+        # observation since the last metrics() pull with its correlation
+        # id, so /metrics can attach an OpenMetrics exemplar pointing at
+        # the span a latency investigation should start from
+        if rid:
+            worst = self._hist_worst.get(name)
+            if worst is None or seconds > worst[0]:
+                self._hist_worst[name] = (seconds, rid, time.time())
 
     def _annot(self, name: str):
         """jax.profiler annotation around a dispatch, so device traces
@@ -934,6 +983,7 @@ class Engine:
     def _get_page_clone_fn(self):
         fn = self._fork_fns.get("page_clone")
         if fn is None:
+            self._cobs.note_program("page_clone")
             fn = jax.jit(
                 lambda ck, cv, src, dst: (kvcache.clone_page(ck, src, dst),
                                           kvcache.clone_page(cv, src, dst)),
@@ -963,6 +1013,7 @@ class Engine:
         key = ("offload_gather", batch)
         fn = self._fork_fns.get(key)
         if fn is None:
+            self._cobs.note_program("offload_gather", batch)
             fn = jax.jit(lambda ck, cv, idx: (kvcache.gather_pages(ck, idx),
                                               kvcache.gather_pages(cv, idx)))
             self._fork_fns[key] = fn
@@ -972,6 +1023,7 @@ class Engine:
         key = ("restore_scatter", batch)
         fn = self._fork_fns.get(key)
         if fn is None:
+            self._cobs.note_program("restore_scatter", batch)
             fn = jax.jit(
                 lambda ck, cv, idx, kr, vr: (
                     kvcache.scatter_pages(ck, idx, kr),
@@ -1353,6 +1405,7 @@ class Engine:
         key = ("fused", bucket, batch)
         fn = self._burst_fns.get(key)
         if fn is None:
+            self._cobs.note_program("prefill_fused", (bucket, batch))
             fn = jax.jit(
                 lambda *a: self._fused_body(*a, n_steps=self.ecfg.decode_burst),
                 donate_argnums=(2, 3, 8))
@@ -1426,6 +1479,7 @@ class Engine:
         key = ("packed", bucket, continued)
         fn = self._final_fns.get(key)
         if fn is None:
+            self._cobs.note_program("prefill_pack", (bucket, continued))
             fn = jax.jit(
                 lambda *a: self._packed_prefill_body(*a,
                                                      continued=continued),
@@ -1509,6 +1563,7 @@ class Engine:
         key = ("fused_packed", bucket, continued)
         fn = self._burst_fns.get(key)
         if fn is None:
+            self._cobs.note_program("prefill_pack_fused", (bucket, continued))
             fn = jax.jit(
                 lambda *a: self._fused_packed_body(
                     *a, n_steps=self.ecfg.decode_burst,
@@ -1521,6 +1576,7 @@ class Engine:
         key = (n_steps, flags)
         fn = self._burst_fns.get(key)
         if fn is None:
+            self._cobs.note_program("decode_burst", key)
             # donate the cache + keys; chain inputs stay undonated (they are
             # tiny, and mirror-fed dispatches pass host numpy for them)
             fn = jax.jit(
@@ -1533,6 +1589,7 @@ class Engine:
     def _get_chunk_fn(self, bucket: int):
         fn = self._chunk_fns.get(bucket)
         if fn is None:
+            self._cobs.note_program("prefill_chunk", bucket)
             fn = jax.jit(self._prefill_chunk_body, donate_argnums=(3, 4))
             self._chunk_fns[bucket] = fn
         return fn
@@ -1555,6 +1612,7 @@ class Engine:
         key = (bucket, batch, continued)
         fn = self._final_fns.get(key)
         if fn is None:
+            self._cobs.note_program("prefill_final", key)
             fn = jax.jit(
                 lambda *a: self._prefill_final_body(*a, continued=continued),
                 donate_argnums=(3, 4, 10))
@@ -1633,7 +1691,19 @@ class Engine:
         admission reseeds all per-slot state, so this is invisible to
         traffic. Mirrors the reference's LoadToMemory warmup
         (core/startup/startup.go:148-176); pairs with the persistent
-        compilation cache (utils/jaxtools.py) so restarts compile fast."""
+        compilation cache (utils/jaxtools.py) so restarts compile fast.
+
+        ISSUE 8: the body runs with this engine's CompileTracker bound
+        to the calling thread (precompile runs on the loader/caller
+        thread, not the engine loop), and the END of precompile marks
+        the warm boundary — incidental warmup compiles (helper fills,
+        first-touch jnp ops) land before the mark, and any compile
+        observed after it is a compile storm."""
+        with sysobs.activated(self._cobs):
+            self._precompile_impl()
+        self._cobs.mark_warm()
+
+    def _precompile_impl(self):
         k = 1
         ks = []
         while k <= self.ecfg.decode_burst:
@@ -1736,6 +1806,13 @@ class Engine:
                 self.ck, self.cv = self._get_restore_scatter_fn(B)(
                     self.ck, self.cv, idx_s, zeros, zeros)
                 B *= 2
+        # admission-path op-level helpers: seed_slot_key builds a PRNGKey
+        # (broadcast + squeeze) and scatters it into the key matrix —
+        # three tiny implicit jits that would otherwise land on the FIRST
+        # real admission and read as false compile storms (ISSUE 8)
+        self.rng_keys = sampling.seed_slot_key(
+            self.rng_keys, 0, sampling.SamplingParamsHost(),
+            fallback_seed=0)
         jax.block_until_ready(self.ck)
 
     def start(self, precompile: bool = False):
@@ -1869,6 +1946,8 @@ class Engine:
     def _shed(self, req: GenRequest, reason: str, kind: str = "shed"):
         with self._lc_lock:
             self._lc["requests_shed"] += 1
+        EVENTS.emit("shed", rid=req.request_id, reason=reason,
+                    queued=self._queue.qsize())
         req.out.put(StreamEvent(
             token_id=-1, text="", logprob=0.0, finish_reason="stop",
             error=reason, error_kind=kind,
@@ -1878,6 +1957,8 @@ class Engine:
     def _timeout_event(self, req: GenRequest) -> StreamEvent:
         with self._lc_lock:
             self._lc["requests_timed_out"] += 1
+        EVENTS.emit("timeout", rid=req.request_id,
+                    timeout_ms=self.ecfg.request_timeout_ms)
         return StreamEvent(
             token_id=-1, text="", logprob=0.0, finish_reason="stop",
             error=(f"request deadline exceeded "
@@ -1983,6 +2064,93 @@ class Engine:
         lc["request_timeout_ms"] = self.ecfg.request_timeout_ms
         lc["dispatch_stall_ms"] = self.ecfg.dispatch_stall_ms
         out["lifecycle"] = lc
+        # system observability (ISSUE 8): compile tracking + memory
+        # watermarks + goodput/MFU, re-exposed per model on /metrics
+        self._sample_watermarks()
+        sys_obs = {"compiles": self._cobs.snapshot(),
+                   "watermarks": self._wm.snapshot(),
+                   "goodput": self._goodput.snapshot(),
+                   "weight_bytes": self._weight_bytes}
+        if self._paged:
+            sys_obs["fragmentation"] = self._pool.fragmentation()
+        out["sysobs"] = sys_obs
+        # per-histogram exemplars: worst observation since the last pull
+        # (consumed — each scrape sees that interval's worst span)
+        worst, self._hist_worst = self._hist_worst, {}
+        if worst:
+            out["hist_exemplars"] = {
+                name: {"value": round(v, 6), "trace_id": rid, "ts": ts}
+                for name, (v, rid, ts) in worst.items()}
+        return out
+
+    def _sample_watermarks(self):
+        """Fold current gauges into the high-water marks (engine-loop
+        tick + every metrics() pull) and fire a pool_pressure event on
+        the free-fraction threshold crossing (hysteresis: one event per
+        excursion, cleared when the pool recovers past 2x)."""
+        wm = {"queued": self._queue.qsize(), "slots_active": self.num_active,
+              "tokens_total": self._total_tokens}
+        if self._paged:
+            wm["pool_active_pages"] = self._pool.active_pages
+            wm["pool_retained_pages"] = self._pool.retained_pages
+            wm["pool_pages_in_use"] = self._pool.pages_in_use
+            if self._hstore is not None:
+                wm["host_offloaded_pages"] = self._hstore.pages
+                wm["host_bytes"] = self._hstore.bytes_used
+            free_frac = self._pool.free_pages / max(1, self._pool.num_pages)
+            if not self._pool_pressure and free_frac < 0.05:
+                self._pool_pressure = True
+                EVENTS.emit("pool_pressure",
+                            free_pages=self._pool.free_pages,
+                            total_pages=self._pool.num_pages,
+                            retained=self._pool.retained_pages,
+                            active=self._pool.active_pages)
+            elif self._pool_pressure and free_frac > 0.10:
+                self._pool_pressure = False
+        self._wm.sample(**wm)
+
+    def state_snapshot(self) -> dict:
+        """Live engine-state JSON for /debug/state (ISSUE 8): slots,
+        queues, pool map summary, warmth, last N compiles — the
+        at-a-glance answer to "what is this engine doing right now"."""
+        slots = []
+        for i, s in enumerate(self.slots):
+            if s is None:
+                slots.append(None)
+                continue
+            slots.append({
+                "rid": s.req.request_id,
+                "prompt_tokens": len(s.req.prompt_ids),
+                "committed": int(s.committed),
+                "n_decoded": int(s.n_decoded),
+                "age_s": round(time.monotonic() - s.t_start, 3)})
+        out = {
+            "slots": slots,
+            "slots_active": self.num_active,
+            "queued": self._queue.qsize(),
+            "warm": self._cobs.snapshot()["warm"],
+            "compiles": self._cobs.snapshot(),
+            "last_compiles": self._cobs.last_compiles(),
+            "watermarks": self._wm.snapshot(),
+            "goodput": self._goodput.snapshot(),
+            "weight_bytes": self._weight_bytes,
+        }
+        with self._lc_lock:
+            out["lifecycle"] = dict(self._lc)
+        if self._paged:
+            out["pool"] = {
+                "pages_total": self._pool.num_pages,
+                "page_size": self._pool.page_size,
+                "free": self._pool.free_pages,
+                "active": self._pool.active_pages,
+                "retained": self._pool.retained_pages,
+                "shared": int((self._pool.refs > 1).sum()),
+                "oversubscription": round(self._pool.oversubscription, 4),
+                "fragmentation": self._pool.fragmentation(),
+                "pages_per_slot": [int(n) for n in self._pool.owned],
+            }
+            if self._hstore is not None:
+                out["host_store"] = self._hstore.stats()
         return out
 
     def trace_events(self) -> dict:
@@ -2152,10 +2320,20 @@ class Engine:
         import logging
 
         log = logging.getLogger(__name__)
+        # bind this engine's compile tracker to the loop thread: every
+        # jit dispatch (and therefore every XLA compile) the serving
+        # path triggers happens right here (ISSUE 8)
+        sysobs.register_thread(self._cobs)
+        t_wm = 0.0
         while not self._stop:
             try:
                 t0 = time.monotonic()
                 t_tick = t0
+                if t0 - t_wm > 0.5:
+                    # watermark fold (ISSUE 8): cheap max() samples so
+                    # pool peaks between /metrics scrapes are not lost
+                    t_wm = t0
+                    self._sample_watermarks()
                 admitted = self._admit()
                 self._tmark("admit", t0)
                 t0 = time.monotonic()
@@ -2380,6 +2558,10 @@ class Engine:
             "slots": [i for i, _ in stalled],
             "ring_dump": dump_path,
         }))
+        EVENTS.emit("stall_dump",
+                    dispatch_stall_ms=self.ecfg.dispatch_stall_ms,
+                    requests=[snap.req.request_id for _, snap in stalled],
+                    ring_dump=dump_path)
         try:
             self._fifo.remove(item)
         except ValueError:
@@ -2402,6 +2584,9 @@ class Engine:
                 "multimodal injection is not supported in multi-host "
                 "lockstep mode")
         t_adm = time.monotonic()
+        EVENTS.emit("admit", rid=req.request_id,
+                    prompt_tokens=len(req.prompt_ids),
+                    queued=self._queue.qsize())
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
         # truncate the prompt head, keeping the tail (reference semantics:
@@ -2543,6 +2728,9 @@ class Engine:
         self._cache_tokens[slot] = [] if mm_pos is not None else list(ids)
         self.slots[slot] = s
         self._prefill_queue.append(slot)
+        # fold a watermark sample at admission: a request shorter than the
+        # loop's sampling throttle must still leave a high-water mark
+        self._sample_watermarks()
         tr = self.tracer
         if tr.enabled:
             t1 = time.monotonic()
@@ -3554,7 +3742,8 @@ class Engine:
             if gs.t_first_token == 0.0:
                 gs.t_first_token = t1
                 if gs.req.t_submit:
-                    self._hobserve("ttft_seconds", t1 - gs.req.t_submit)
+                    self._hobserve("ttft_seconds", t1 - gs.req.t_submit,
+                                   rid=gs.req.request_id)
                 if trc.enabled:
                     trc.record("prefill", f"slot{gslot}", t0, t1,
                                rid=gs.req.request_id,
@@ -3953,7 +4142,8 @@ class Engine:
                     snap.t_first_token = t1
                     if snap.req.t_submit:
                         self._hobserve("ttft_seconds",
-                                       t1 - snap.req.t_submit)
+                                       t1 - snap.req.t_submit,
+                                       rid=snap.req.request_id)
                     if tr.enabled:
                         tr.record("prefill", f"slot{i}", b.t_dispatch, t1,
                                   rid=snap.req.request_id,
@@ -4105,6 +4295,13 @@ class Engine:
                                           if isinstance(v, float) else v)
                                       for k, v in ev.timings.items()},
                         }, sort_keys=True))
+            # goodput (ISSUE 8): ONLY clean finishes count — sheds,
+            # timeouts and stall aborts never reach this branch
+            self._goodput.add(s.n_decoded)
+            EVENTS.emit("complete", rid=s.req.request_id, finish=finish,
+                        completion_tokens=s.n_decoded,
+                        e2e_ms=round((t_done - s.req.t_submit) * 1e3, 1)
+                        if s.req.t_submit else None)
             self._save_prompt_cache(slot, s)
             self._release_slot(slot)
             if buf is not None:

@@ -114,6 +114,29 @@ class PagePool:
         admission relies on reclaim/eviction under full load."""
         return self.num_slots * self.max_pages / float(self.num_pages)
 
+    def fragmentation(self) -> dict:
+        """Free-list shape for the memory-watermark telemetry (ISSUE 8):
+        how many free pages sit in HOLES below the allocated region vs
+        in the contiguous free TAIL of page-id space. Pages are
+        interchangeable (the table indirects every access) so holes cost
+        nothing for correctness — but a hole-heavy free list means the
+        pool has churned through its whole id space, which is the signal
+        that retained-page eviction (not fresh allocation) is serving
+        admissions."""
+        free = len(self._free)
+        if free == 0:
+            return {"free_pages": 0, "tail_pages": 0, "hole_pages": 0,
+                    "ratio": 0.0}
+        free_ids = set(self._free)
+        tail = 0
+        for p in range(self.num_pages - 1, -1, -1):
+            if p not in free_ids:
+                break
+            tail += 1
+        holes = free - tail
+        return {"free_pages": free, "tail_pages": tail, "hole_pages": holes,
+                "ratio": round(holes / float(free), 4)}
+
     def slot_rows_capacity(self, slot: int) -> int:
         return int(self.owned[slot]) * self.page_size
 

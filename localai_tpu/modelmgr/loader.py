@@ -24,6 +24,7 @@ from localai_tpu.backend import contract_pb2 as pb
 from localai_tpu.backend.service import BackendClient, BackendServicer, make_server
 from localai_tpu.modelmgr.process import BackendProcess, free_port, spawn_python_backend
 from localai_tpu.services.errors import CircuitOpenError
+from localai_tpu.services.eventlog import EVENTS
 
 log = logging.getLogger("localai_tpu.modelmgr.loader")
 
@@ -37,9 +38,11 @@ class CircuitBreaker:
     request. After the cooldown one probe attempt is let through
     (half-open); its outcome closes or re-opens the breaker."""
 
-    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 name: str = ""):
         self.threshold = threshold
         self.cooldown_s = cooldown_s
+        self.name = name            # model id, for event-log records
         self.failures = 0
         self.state = "closed"       # closed | open | half-open
         self.opened_t = 0.0
@@ -54,6 +57,7 @@ class CircuitBreaker:
             remaining = self.cooldown_s - (time.monotonic() - self.opened_t)
             if remaining <= 0:
                 self.state = "half-open"
+                EVENTS.emit("circuit_half_open", model=self.name or model_id)
                 return
             # breaker-state dict built inline: snapshot() takes this same
             # non-reentrant lock
@@ -67,16 +71,25 @@ class CircuitBreaker:
                     "retry_after_s": round(remaining, 1)}})
 
     def record_failure(self):
+        opened = False
         with self._lock:
             self.failures += 1
             if self.state == "half-open" or self.failures >= self.threshold:
+                opened = self.state != "open"
                 self.state = "open"
                 self.opened_t = time.monotonic()
+            n = self.failures
+        if opened:
+            EVENTS.emit("circuit_open", model=self.name, failures=n,
+                        cooldown_s=self.cooldown_s)
 
     def record_success(self):
         with self._lock:
+            closed = self.state != "closed"
             self.failures = 0
             self.state = "closed"
+        if closed:
+            EVENTS.emit("circuit_close", model=self.name)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -272,7 +285,8 @@ class ModelLoader:
             b = self._breakers.get(model_id)
             if b is None:
                 b = self._breakers[model_id] = CircuitBreaker(
-                    self.breaker_threshold, self.breaker_cooldown_s)
+                    self.breaker_threshold, self.breaker_cooldown_s,
+                    name=model_id)
             return b
 
     # ---- crash recovery (ISSUE 7) ----
@@ -302,9 +316,12 @@ class ModelLoader:
             if self.models.get(lm.model_id) is not lm:
                 return  # already replaced/dropped by another path
             self.respawns[lm.model_id] = self.respawns.get(lm.model_id, 0) + 1
+            n_respawns = self.respawns[lm.model_id]
         log.warning(
             "backend for model %s died unexpectedly (exit %s); "
             "respawning with backoff", lm.model_id, rc)
+        EVENTS.emit("respawn", model=lm.model_id, exit_code=rc,
+                    respawns=n_respawns)
         base = self.respawn_backoff_base_s
         for attempt in range(self.respawn_max_attempts):
             # full jitter: crash-looping fleets must not thunder in sync

@@ -10,6 +10,8 @@ import logging
 import threading
 import time
 
+from localai_tpu.services.eventlog import EVENTS
+
 log = logging.getLogger("localai_tpu.modelmgr.watchdog")
 
 
@@ -61,12 +63,17 @@ class WatchDog:
                                  if now - t > self.busy_timeout_s]
                     for m in stuck:
                         log.warning("watchdog: %s busy > %.0fs, killing", m, self.busy_timeout_s)
+                        EVENTS.emit("watchdog_kill", model=m, reason="busy",
+                                    timeout_s=self.busy_timeout_s)
                         self.loader.shutdown_model(m, force=True)
                 if self.check_idle:
                     for m in self.loader.list_loaded():
                         lm = self.loader.get(m)
                         if lm and lm.busy == 0 and now - lm.last_used > self.idle_timeout_s:
                             log.info("watchdog: %s idle > %.0fs, releasing", m, self.idle_timeout_s)
+                            EVENTS.emit("watchdog_kill", model=m,
+                                        reason="idle",
+                                        timeout_s=self.idle_timeout_s)
                             self.loader.shutdown_model(m, force=True)
             except Exception:
                 log.exception("watchdog sweep failed")

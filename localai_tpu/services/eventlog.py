@@ -1,0 +1,119 @@
+"""Structured event log (ISSUE 8): JSON-lines lifecycle events.
+
+Complementary to the span tracer (services/tracing.py): spans answer
+"where did this request's wall-time go", events answer "what state
+transitions did the SYSTEM go through" — admissions, sheds, timeouts,
+completions, backend respawns, circuit transitions, stall dumps,
+compile-after-warmup storms, pool pressure. Every event is one JSON
+object per line with a wall-clock timestamp, a monotonically increasing
+per-process sequence number, and (where applicable) the request
+correlation id (`rid`) that also keys the tracer spans — so an operator
+can pivot from an event line to the matching span breakdown.
+
+Sink knob (`event_log=path|stderr|off`, also `LOCALAI_EVENT_LOG` env for
+the core API process, which has no `options:` wire of its own):
+
+* ``off`` (default) — ring only, nothing written through
+* ``stderr``        — write-through to stderr (survives crashes)
+* any other value   — append to that file path (line-buffered)
+
+Regardless of sink, the last `ring_size` events are retained in a
+bounded in-memory ring surfaced at `/debug/events`. One EventLog per
+PROCESS: the core API process and each backend subprocess hold their
+own; backend rings ride the GetState RPC JSON and `/debug/events`
+merges them (each event tagged with its origin process).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+log = logging.getLogger("localai_tpu.eventlog")
+
+RING_SIZE_DEFAULT = 512
+
+
+class EventLog:
+    def __init__(self, sink: str = "", ring_size: int = RING_SIZE_DEFAULT):
+        self._ring: deque = deque(maxlen=max(1, int(ring_size)))
+        self._lock = threading.Lock()
+        self._fh = None
+        self._seq = 0
+        self.sink = "off"
+        self.configure(sink or os.environ.get("LOCALAI_EVENT_LOG", ""))
+
+    def configure(self, sink: str):
+        """(Re)arm the write-through sink: path | stderr | off/empty."""
+        sink = (sink or "").strip()
+        with self._lock:
+            if self._fh is not None and self._fh is not sys.stderr:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+            self._fh = None
+            if not sink or sink == "off":
+                self.sink = "off"
+            elif sink == "stderr":
+                self.sink = "stderr"
+                self._fh = sys.stderr
+            else:
+                self.sink = sink
+                try:
+                    self._fh = open(sink, "a", buffering=1)
+                except OSError as e:
+                    log.warning("event_log sink %s unwritable (%s); "
+                                "ring-only", sink, e)
+                    self.sink = "off"
+
+    def emit(self, event: str, rid: str = "", model: str = "", **fields):
+        """Record one event. Never raises — telemetry must not take the
+        serving path down with it."""
+        rec = {"ts": round(time.time(), 6), "event": event}
+        if rid:
+            rec["rid"] = rid
+        if model:
+            rec["model"] = model
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            fh = self._fh
+        if fh is not None:
+            try:
+                fh.write(json.dumps(rec, default=str) + "\n")
+            except Exception:
+                pass
+
+    def events(self, last: int = 0) -> list:
+        """Snapshot of the ring, oldest first; `last` > 0 trims to the
+        most recent N."""
+        with self._lock:
+            evs = list(self._ring)
+        if last > 0:
+            evs = evs[-last:]
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"sink": self.sink, "seq": self._seq,
+                    "ring": len(self._ring),
+                    "ring_size": self._ring.maxlen}
+
+
+# Per-process singleton. The engine's `event_log=` option and the core
+# process's LOCALAI_EVENT_LOG env both land here via configure().
+EVENTS = EventLog()

@@ -20,6 +20,24 @@ from collections import defaultdict
 _BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
             30.0, 60.0, 120.0, 300.0)
 
+# Prometheus text exposition content type (version is part of the
+# contract: scrapers negotiate the parser off it)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def escape_label_value(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, newline,
+    double-quote (in that order — escaping the escape char first)."""
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def label_str(**kv) -> str:
+    """Build a label string with properly escaped values, sorted for a
+    stable exposition ordering."""
+    return ",".join(f'{k}="{escape_label_value(v)}"'
+                    for k, v in sorted(kv.items()))
+
 
 class Metrics:
     def __init__(self):
@@ -39,6 +57,11 @@ class Metrics:
         # pull-updated from backend snapshots (set_histogram). Keyed
         # (name, labels) -> [buckets(tuple), counts(+Inf last), sum, n]
         self._named_hists: dict = {}
+        # per-histogram exemplars (ISSUE 8 satellite): the worst recent
+        # observation's correlation id, attached to the bucket line the
+        # observation falls in (OpenMetrics `# {trace_id="..."}` syntax).
+        # Keyed (name, labels) -> (value, trace_id, unix_ts)
+        self._exemplars: dict = {}
 
     def observe_api_call(self, method: str, path: str, seconds: float):
         with self._lock:
@@ -94,11 +117,20 @@ class Metrics:
                 tuple(buckets), [int(c) for c in counts],
                 float(hsum), int(count)]
 
+    def set_exemplar(self, name: str, labels: str, value: float,
+                     trace_id: str, ts: float = 0.0):
+        """Attach an exemplar (worst recent observation + its trace id)
+        to a named histogram — rendered on the matching bucket line."""
+        with self._lock:
+            self._exemplars[(name, labels)] = (float(value),
+                                               str(trace_id), float(ts))
+
     def clear_instrument(self, name: str):
         """Drop every series of a pull-updated instrument (a model was
         unloaded; stale per-model series must not linger)."""
         with self._lock:
-            for d in (self._gauges, self._abs_counters, self._named_hists):
+            for d in (self._gauges, self._abs_counters, self._named_hists,
+                      self._exemplars):
                 for k in [k for k in d if k[0] == name]:
                     del d[k]
 
@@ -109,7 +141,8 @@ class Metrics:
         ]
         with self._lock:
             for (method, path), (buckets, total, count) in sorted(self._hist.items()):
-                labels = f'method="{method}",path="{path}"'
+                labels = (f'method="{escape_label_value(method)}",'
+                          f'path="{escape_label_value(path)}"')
                 cum = 0
                 for i, b in enumerate(_BUCKETS):
                     cum += buckets[i]
@@ -126,15 +159,31 @@ class Metrics:
                     hseen.add(name)
                     lines.append(f"# TYPE localai_{name} histogram")
                 sep = "," if labels else ""
+                # exemplar: rendered on the bucket line whose range the
+                # worst recent observation falls in
+                ex = self._exemplars.get((name, labels))
+                ex_i = None
+                if ex is not None:
+                    ex_i = len(buckets)   # +Inf by default
+                    for i, b in enumerate(buckets):
+                        if ex[0] <= b:
+                            ex_i = i
+                            break
                 cum = 0
                 for i, b in enumerate(buckets):
                     cum += counts[i]
-                    lines.append(
-                        f'localai_{name}_bucket{{{labels}{sep}le="{b}"}} '
-                        f'{cum}')
+                    line = (f'localai_{name}_bucket{{{labels}{sep}le="{b}"}} '
+                            f'{cum}')
+                    if ex_i == i:
+                        line += (f' # {{trace_id="{ex[1]}"}} {ex[0]:g}'
+                                 + (f' {ex[2]:.3f}' if ex[2] else ""))
+                    lines.append(line)
                 cum += counts[-1]
-                lines.append(
-                    f'localai_{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+                line = f'localai_{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}'
+                if ex_i == len(buckets):
+                    line += (f' # {{trace_id="{ex[1]}"}} {ex[0]:g}'
+                             + (f' {ex[2]:.3f}' if ex[2] else ""))
+                lines.append(line)
                 label_part = f"{{{labels}}}" if labels else ""
                 lines.append(f'localai_{name}_sum{label_part} {hsum:.6f}')
                 lines.append(f'localai_{name}_count{label_part} {count}')

@@ -1,0 +1,298 @@
+"""System observability (ISSUE 8): XLA compile tracking, memory
+watermarks, goodput/MFU accounting.
+
+Complementary to services/tracing.py (per-request spans): this module
+watches the SYSTEM — what the compiler and the memory pools are doing
+underneath the request stream.
+
+**Compile tracking.** jax emits a
+``/jax/core/compile/backend_compile_duration`` monitoring event once
+per real XLA compilation, synchronously on the compiling thread (cached
+executions emit only cheap trace events). We register ONE module-level
+listener and dispatch to the engine whose thread is compiling via a
+thread-local registration: the engine loop thread registers its
+CompileTracker at startup, and ``precompile()`` (which runs on the
+loader/caller thread) wraps itself in :func:`activated`. Program
+attribution rides the same thread-local — the engine's fn-getters call
+``note_program(kind, key)`` on a jit-cache miss immediately before the
+compiling call, so the listener can name the program that compiled.
+
+The warm boundary is marked at the END of ``precompile()``: everything
+before it (including incidental helper fills like ``jnp.ones``) is
+warmup; any compile after it is a "compile storm" — a structured
+WARNING + ``compile_storm`` event, because a post-warmup recompile is a
+latency cliff the bucket tables were supposed to prevent.
+
+**Watermarks.** High-water marks over gauge samples (peak active /
+retained / offloaded pages, host bytes, …) — cheap max() folds sampled
+from the engine loop so peaks between /metrics scrapes are not lost.
+
+**Goodput / MFU.** Analytic FLOPs-per-token from the model config
+(matmul params ×2 + attention term) and achieved tokens/s over a
+rolling window → model FLOPs utilization against the device's peak
+(``LOCALAI_PEAK_TFLOPS`` env or per-kind table; 0 ⇒ unknown ⇒ MFU
+reported as 0.0, the honest answer on CPU rigs). Goodput counts ONLY
+completed-request tokens — sheds, timeouts, stalls and errors produce
+no goodput even though they burned FLOPs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+log = logging.getLogger("localai_tpu.sysobs")
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_LAST_COMPILES = 32     # ring of recent compiles kept per tracker
+
+_tl = threading.local()
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_event_duration(name: str, secs: float, **kw):
+    if name != _COMPILE_EVENT:
+        return
+    tracker = getattr(_tl, "tracker", None)
+    if tracker is not None:
+        tracker.on_compile(secs)
+
+
+def install_listener():
+    """Register the module-level jax.monitoring listener (idempotent).
+    Gated on import success so non-jax processes can still import the
+    watermark/goodput halves of this module."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(_on_event_duration)
+            _listener_installed = True
+        except Exception as e:  # pragma: no cover - jax always present in CI
+            log.warning("compile-event listener unavailable: %s", e)
+
+
+def register_thread(tracker: "CompileTracker"):
+    """Bind `tracker` to THIS thread for compile attribution (engine
+    loop threads call this once at startup)."""
+    _tl.tracker = tracker
+
+
+class activated:
+    """Context manager binding a tracker to the current thread for the
+    duration of a block — used by precompile(), which runs on the
+    loader/caller thread, not the engine loop."""
+
+    def __init__(self, tracker: "CompileTracker"):
+        self.tracker = tracker
+
+    def __enter__(self):
+        self.prev = getattr(_tl, "tracker", None)
+        _tl.tracker = self.tracker
+        return self.tracker
+
+    def __exit__(self, *exc):
+        _tl.tracker = self.prev
+        return False
+
+
+class CompileTracker:
+    """Per-engine XLA compilation counters + compile-storm detection."""
+
+    def __init__(self, model: str = "", on_storm=None):
+        self.model = model
+        self.on_storm = on_storm    # callable(rec) — eventlog write-through
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.compiles_after_warmup = 0
+        self.warm = False
+        self._last: deque = deque(maxlen=_LAST_COMPILES)
+        self._lock = threading.Lock()
+        install_listener()
+
+    def note_program(self, kind: str, key=None):
+        """Name the program about to compile on THIS thread (called by
+        the engine's fn-getters on a jit-cache miss)."""
+        _tl.program = f"{kind}:{key}" if key is not None else kind
+
+    def mark_warm(self):
+        """precompile() finished: every compile from now on is a storm."""
+        with self._lock:
+            self.warm = True
+
+    def on_compile(self, secs: float):
+        program = getattr(_tl, "program", None) or "?"
+        _tl.program = None   # consume: one note names one compile
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += secs
+            storm = self.warm
+            rec = {"t": round(time.time(), 3), "seconds": round(secs, 4),
+                   "program": program, "after_warmup": storm}
+            self._last.append(rec)
+            if storm:
+                self.compiles_after_warmup += 1
+        if storm:
+            # a recompile after warmup is a latency cliff: make it loud
+            # (structured WARNING) and durable (eventlog write-through)
+            log.warning(json.dumps({
+                "event": "compile_after_warmup", "model": self.model,
+                "program": program, "seconds": round(secs, 4),
+                "compiles_after_warmup": self.compiles_after_warmup}))
+            if self.on_storm is not None:
+                try:
+                    self.on_storm(rec)
+                except Exception:
+                    pass
+
+    def last_compiles(self) -> list:
+        with self._lock:
+            return list(self._last)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"compiles_total": self.compiles,
+                    "compile_seconds_total": round(self.compile_seconds, 4),
+                    "compiles_after_warmup": self.compiles_after_warmup,
+                    "warm": self.warm}
+
+
+class Watermarks:
+    """High-water (and a few low-water) marks over sampled gauges."""
+
+    def __init__(self):
+        self._peak: dict = {}
+        self._lock = threading.Lock()
+
+    def sample(self, **gauges):
+        with self._lock:
+            for name, val in gauges.items():
+                if val is None:
+                    continue
+                cur = self._peak.get(name)
+                if cur is None or val > cur:
+                    self._peak[name] = val
+
+    def peak(self, name: str, default=0):
+        with self._lock:
+            return self._peak.get(name, default)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f"peak_{k}": v for k, v in sorted(self._peak.items())}
+
+
+def flops_per_token(cfg, ctx: int = 0) -> float:
+    """Analytic forward-pass FLOPs per generated token for a llama-family
+    config: 2 FLOPs per matmul weight parameter, plus the attention
+    score/value term (~4*h FLOPs per layer per context row) at context
+    depth `ctx`. Embedding lookup is free; the LM head counts (it is a
+    matmul), tied or not."""
+    h = cfg.hidden_size
+    kv = cfg.num_kv_heads * cfg.head_dim_
+    q = cfg.num_heads * cfg.head_dim_
+    per_layer = (h * q          # q proj
+                 + 2 * h * kv   # k,v proj
+                 + q * h        # o proj
+                 + 3 * h * cfg.intermediate_size)  # gate/up/down
+    matmul_params = cfg.num_layers * per_layer + h * cfg.vocab_size
+    attn = 4.0 * cfg.num_layers * ctx * h if ctx > 0 else 0.0
+    return 2.0 * matmul_params + attn
+
+
+# peak dense (bf16) FLOP/s per chip by device-kind substring. CPU rigs
+# fall through to 0.0: "unknown" — README documents that MFU reads 0
+# there rather than inventing a laptop-core number.
+_PEAK_FLOPS_TABLE = (
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_device_flops() -> float:
+    """Peak FLOP/s of one local device: LOCALAI_PEAK_TFLOPS env wins,
+    else a TPU device-kind table, else 0.0 (unknown — e.g. CPU)."""
+    env = os.environ.get("LOCALAI_PEAK_TFLOPS", "")
+    if env:
+        try:
+            return float(env) * 1e12
+        except ValueError:
+            log.warning("bad LOCALAI_PEAK_TFLOPS=%r; ignoring", env)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 0.0
+    for sub, flops in _PEAK_FLOPS_TABLE:
+        if sub in kind:
+            return flops
+    return 0.0
+
+
+class GoodputMeter:
+    """Completed-request token accounting → goodput tok/s and MFU.
+
+    `add(n)` is called ONLY from the clean-finish branch of the engine's
+    emit path — sheds/timeouts/stalls never reach it, so `tokens_total`
+    is useful-work throughput by construction."""
+
+    def __init__(self, flops_per_tok: float = 0.0, peak_flops: float = 0.0,
+                 window_s: float = 60.0):
+        self.flops_per_tok = float(flops_per_tok)
+        self.peak_flops = float(peak_flops)
+        self.window_s = float(window_s)
+        self.tokens_total = 0
+        self.requests_total = 0
+        self._window: deque = deque()   # (t_monotonic, n_tokens)
+        self._lock = threading.Lock()
+
+    def add(self, n_tokens: int):
+        now = time.monotonic()
+        with self._lock:
+            self.tokens_total += int(n_tokens)
+            self.requests_total += 1
+            self._window.append((now, int(n_tokens)))
+            self._trim(now)
+
+    def _trim(self, now: float):
+        horizon = now - self.window_s
+        w = self._window
+        while w and w[0][0] < horizon:
+            w.popleft()
+
+    def tok_s(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            if not self._window:
+                return 0.0
+            toks = sum(n for _, n in self._window)
+            span = max(now - self._window[0][0], 1e-3)
+        return toks / span
+
+    def mfu(self, tok_s: float = None) -> float:
+        if self.peak_flops <= 0 or self.flops_per_tok <= 0:
+            return 0.0
+        rate = self.tok_s() if tok_s is None else tok_s
+        return rate * self.flops_per_tok / self.peak_flops
+
+    def snapshot(self) -> dict:
+        rate = self.tok_s()
+        return {"goodput_tokens_total": self.tokens_total,
+                "goodput_requests_total": self.requests_total,
+                "goodput_tok_s": round(rate, 3),
+                "mfu": round(self.mfu(rate), 6),
+                "flops_per_token": self.flops_per_tok,
+                "peak_flops": self.peak_flops}

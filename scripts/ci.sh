@@ -44,6 +44,14 @@ d = (line.get("host_device_decomp") or {}).get("host_device_decomp_ms") or {}
 print(f"HOST_LOOP_MS={d.get('host_loop')} "
       f"DEVICE_MS={d.get('device')} "
       f"FINISH_DETECT_MS={d.get('finish_detect')}")
+# system observability (ISSUE 8): compile hygiene of the repeated-wave
+# serving phase (must stay 0 — precompile covers every serving-path
+# variant), the kv-pool high-water mark, MFU (honest 0 on CPU), and
+# whether the intentionally cold bucket was detected as a compile storm
+print(f"COMPILES_AFTER_WARMUP={line.get('compiles_after_warmup')} "
+      f"PEAK_POOL_PAGES={line.get('peak_pool_pages')} "
+      f"MFU={line.get('mfu')} "
+      f"cold_bucket_detected={line.get('cold_bucket_detected')}")
 PY
 rm -f "$smoke_out"
 
