@@ -175,8 +175,14 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
     port = free_port()
     app_config = AppConfig(models_path=models, address=f"127.0.0.1:{port}")
     # model load = spawn + weight gen + precompile: can take many minutes
-    # for fresh 8B int8 executables (persistent cache makes reruns fast)
-    loader = ModelLoader(health_attempts=1200, health_interval_s=0.5)
+    # for fresh 8B int8 executables (persistent cache makes reruns fast) —
+    # but never longer than the bench's remaining budget (BENCH_r05 wedge
+    # fix: the loader health loop used to out-wait the parent watchdog)
+    attempts = 1200
+    remaining = _GLOBAL_DEADLINE - time.monotonic()
+    if remaining != float("inf"):
+        attempts = max(20, min(1200, int(remaining / 0.5) - 20))
+    loader = ModelLoader(health_attempts=attempts, health_interval_s=0.5)
     configs = scan_models_dir(models)
     caps = Capabilities(app_config, loader, configs)
     app = build_app(caps, app_config)
@@ -418,7 +424,11 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
                                 "LOCALAI_BENCH_PACKED", "") == "0" else {}),
                             **({"prefill_token_budget": pb} if (pb := int(
                                 os.environ.get("LOCALAI_BENCH_PREFILL_BUDGET",
-                                               "0") or 0)) > 0 else {}))
+                                               "0") or 0)) > 0 else {}),
+                            # dedicated emission worker on/off (ISSUE 9;
+                            # LOCALAI_BENCH_EMITTER=0 restores in-loop)
+                            **({"emitter": False} if os.environ.get(
+                                "LOCALAI_BENCH_EMITTER", "") == "0" else {}))
     engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                         eos_token_ids={cfg.vocab_size - 1})
     engine.start(precompile=True)
@@ -510,6 +520,10 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
         for o in outs:
             while o.get() is not None:
                 pass
+
+    # measure steady state only: warmup's in-serving compiles otherwise
+    # dominate the finish-detect / host-loop decomposition totals
+    engine.tracer.reset()
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=consume, args=(i,), daemon=True)
@@ -1389,14 +1403,18 @@ def _engine_direct_offload(deadline: float, partial: dict) -> dict:
     return out
 
 
-def _engine_direct_decomp(deadline: float, partial: dict) -> dict:
+def _engine_direct_decomp(deadline: float, partial: dict,
+                          emitter: bool = True) -> dict:
     """Host-vs-device walltime decomposition as a bench phase: a short
     engine-direct serving run (subprocess, trace ring on) whose output
     carries the span tracer's measured split — host loop (dispatch +
-    detok + flush), device compute, finish-detection lag — plus the
-    per-request TTFT span breakdown. This is the measured answer to the
-    r5 serving-vs-kernel gap question (scripts/ci.sh prints it as the
-    HOST_LOOP_MS/DEVICE_MS/FINISH_DETECT_MS tracked line)."""
+    detok + flush), device compute, emitter-thread time, finish-
+    detection lag — plus the per-request TTFT span breakdown. This is
+    the measured answer to the r5 serving-vs-kernel gap question
+    (scripts/ci.sh prints it as the HOST_LOOP_MS/DEVICE_MS/
+    FINISH_DETECT_MS tracked line, for BOTH emitter settings).
+    ``emitter=False`` reruns with the in-loop emission path (ISSUE 9
+    before/after comparison)."""
     import subprocess
 
     remaining = deadline - time.monotonic()
@@ -1414,6 +1432,7 @@ def _engine_direct_decomp(deadline: float, partial: dict) -> dict:
         "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
         "LOCALAI_BENCH_DEADLINE_S": "0",
         "LOCALAI_JAX_PLATFORM": "",
+        "LOCALAI_BENCH_EMITTER": "" if emitter else "0",
     })
     platform = _subprocess_jax_platform(deadline)
     if platform:
@@ -1447,8 +1466,9 @@ def _engine_direct_decomp(deadline: float, partial: dict) -> dict:
                              f"stderr={res.stderr[-200:]}")}
     except Exception as e:
         out = {"error": f"{type(e).__name__}: {e}"[:200]}
-    partial.update({f"decomp_{k}": v for k, v in out.items()})
-    _emit_phase("host_device_decomp", out)
+    tag = "" if emitter else "_off"
+    partial.update({f"decomp{tag}_{k}": v for k, v in out.items()})
+    _emit_phase(f"host_device_decomp{tag}", out)
     return out
 
 
@@ -1616,11 +1636,16 @@ def main():
         multiturn = _engine_direct_multiturn(deadline, partial)
         offload = _engine_direct_offload(deadline, partial)
         decomp = _engine_direct_decomp(deadline, partial)
+        # in-loop emission rerun (ISSUE 9): the before/after pair
+        # scripts/ci.sh gates on — finish_detect(emitter on) must beat
+        # the polled in-loop path
+        decomp_off = _engine_direct_decomp(deadline, partial, emitter=False)
         ok = ("paged_tok_s" in layout_cmp
               and packed.get("greedy_match") is True
               and multiturn.get("greedy_match") is True
               and offload.get("greedy_match") is True
-              and "host_device_decomp_ms" in decomp)
+              and "host_device_decomp_ms" in decomp
+              and "host_device_decomp_ms" in decomp_off)
         print(json.dumps({
             "metric": "bench_smoke", "value": 1 if ok else 0, "unit": "ok",
             "kv_layout_compare": layout_cmp,
@@ -1632,8 +1657,10 @@ def main():
             "multiturn_prefix_cache": multiturn,
             "kv_offload_pressure": offload,
             # measured host-loop vs device-time split from the span
-            # tracer (scripts/ci.sh HOST_LOOP_MS/... tracked line)
+            # tracer (scripts/ci.sh HOST_LOOP_MS/... tracked line),
+            # with the emitter on (default) and off (in-loop emission)
             "host_device_decomp": decomp,
+            "host_device_decomp_off": decomp_off,
             # sysobs tracked numbers (ISSUE 8, scripts/ci.sh
             # COMPILES_AFTER_WARMUP/PEAK_POOL_PAGES/MFU line): compile
             # hygiene of the repeated-wave serving phase must be 0, and
@@ -1721,6 +1748,11 @@ def main():
             "LOCALAI_BENCH_QUANT": HTTP_PRESETS[primary]["quant"],
             "LOCALAI_BENCH_KV": eff_kv,
             "LOCALAI_JAX_PLATFORM": "",
+            # the PARENT watchdog + subprocess timeout govern the child
+            # (BENCH_r05 wedge fix: a child re-arming the full budget
+            # outlived the parent's deadline and timed the bench out)
+            "LOCALAI_BENCH_BUDGET_S": "0",
+            "LOCALAI_BENCH_DEADLINE_S": "0",
         })
         # forward the burst only when one is actually specified, so an
         # unset knob means "engine default" in BOTH phases (no third
@@ -1737,12 +1769,20 @@ def main():
         # we raced it — wait and retry
         for attempt in range(3):
             engine_direct_err = None
+            # deadline-aware timeout (BENCH_r05 wedge fix): the default
+            # flow must respect the shrinking remaining budget end to
+            # end, not park up to an hour past the parent's deadline
+            remaining = deadline - time.monotonic()
+            if remaining < 60:
+                engine_direct_err = "skipped: bench budget exhausted"
+                break
             try:
                 if attempt:
                     time.sleep(15)
                 out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), "--engine"],
-                    env=env, capture_output=True, text=True, timeout=3600)
+                    env=env, capture_output=True, text=True,
+                    timeout=max(60, min(remaining - 10, 3600)))
                 for ln in out.stdout.splitlines():
                     ln = ln.strip()
                     if ln.startswith("{"):

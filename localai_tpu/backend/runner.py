@@ -365,6 +365,15 @@ class EngineServicer(BackendServicer):
                 extra.get("event_log", "") or "")) else {}),
             **({"peak_tflops": ptf} if (ptf := float(
                 extra.get("peak_tflops", 0) or 0)) > 0 else {}),
+            # event-driven hot path (ISSUE 9): emitter=0 restores in-loop
+            # emission; event_log_max_mb bounds the file sink (0 disables
+            # rotation, so isdigit passes the explicit 0 through)
+            **({"emitter": False} if str(
+                extra.get("emitter", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"event_log_max_mb": int(v)} if (v := str(
+                extra.get("event_log_max_mb", "")).strip()).isdigit()
+               else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,

@@ -244,12 +244,17 @@ class ModelConfig:
                        "max_queued_requests",
                        "max_queue_wait_ms",
                        "request_timeout_ms",
-                       "dispatch_stall_ms") and not v.isdigit():
+                       "dispatch_stall_ms",
+                       # event-log rotation bound (ISSUE 9); 0 disables
+                       "event_log_max_mb") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
             elif k in ("kv_prefix_cache", "kv_offload",
-                       "prefill_packed", "trace") and v.lower() not in bool_vals:
+                       "prefill_packed", "trace",
+                       # dedicated emission worker (ISSUE 9); 0 restores
+                       # the in-loop path
+                       "emitter") and v.lower() not in bool_vals:
                 problems.append(
                     f"{k} must be one of {bool_vals}, got {v!r}")
             elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):

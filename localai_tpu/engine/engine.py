@@ -223,6 +223,16 @@ class EngineConfig:
     # peak device TFLOP/s for MFU accounting; 0 = auto (TPU device-kind
     # table / LOCALAI_PEAK_TFLOPS env; unknown hardware reports MFU 0).
     peak_tflops: float = 0.0
+    # --- event-driven hot path (ISSUE 9) ---
+    # dedicated emitter worker: detok, stop-sequence scanning and stream
+    # queue puts run on a background thread instead of the engine loop;
+    # the loop hands over immutable token batches and keeps all id-level
+    # control (EOS/grammar/length/context-shift). False restores the
+    # in-loop emission path bit-for-bit.
+    emitter: bool = True
+    # event-log file-sink rotation bound (MB): at this size the file
+    # rotates to <path>.1, one generation kept. 0 disables rotation.
+    event_log_max_mb: int = 64
 
 
 @dataclasses.dataclass
@@ -502,6 +512,7 @@ class Engine:
         self._pool = None
         self._pcache = None
         self._hstore = None
+        self._rstager = None
         self._pool_pages = 0     # resolved physical pool size (0 = full)
         pg = 0
         if self._paged:
@@ -536,10 +547,15 @@ class Engine:
                 if self.ecfg.kv_offload:
                     # the host-RAM tier under the pool (the scope doubles
                     # as the persisted file's model/geometry check)
-                    from localai_tpu.engine.kv_offload import HostPageStore
+                    from localai_tpu.engine.kv_offload import (
+                        HostPageStore, RestoreStager)
 
                     self._hstore = HostPageStore(
                         self._pcache.scope, pg, self.ecfg.kv_host_pool_mb)
+                    # double-buffered restore staging (ISSUE 9 satellite):
+                    # consecutive restore uploads alternate buffer sets so
+                    # an in-flight scatter never aliases a refill
+                    self._rstager = RestoreStager()
                     if self.ecfg.kv_host_store_path:
                         n = self._hstore.load(self.ecfg.kv_host_store_path)
                         if n:
@@ -721,7 +737,8 @@ class Engine:
         # structured event-log sink (per-process singleton; the engine's
         # knob arms it for this backend process)
         if self.ecfg.event_log:
-            EVENTS.configure(self.ecfg.event_log)
+            EVENTS.configure(self.ecfg.event_log,
+                             max_mb=self.ecfg.event_log_max_mb)
         # XLA compile tracking: the jax.monitoring listener dispatches to
         # this tracker from whichever thread registered it (the engine
         # loop registers at startup; precompile() wraps itself)
@@ -748,6 +765,31 @@ class Engine:
         # last metrics() pull, with its request correlation id
         self._hist_worst: dict = {}
         self._pool_pressure = False   # hysteresis for pool_pressure events
+        # --- event-driven hot path (ISSUE 9) ---
+        # idle arm: with the sync worker waking the loop on every ready-set
+        # transition (_wake), the fixed 50 ms poll tick is dead weight —
+        # park until woken, bounded only by the watchdog cadence.
+        stall_s = self.ecfg.dispatch_stall_ms / 1e3
+        self._idle_wait_s = min(1.0, stall_s / 4) if stall_s > 0 else 1.0
+        # emitter handoff: per-tick token batch (slot -> entry, insertion
+        # ordered) flushed as ONE queue item per processed burst/prefill,
+        # plus the note channel for emitter-detected stop finishes.
+        self._em_batch: dict = {}
+        self._em_notes: list = []
+        self._em_lock = threading.Lock()
+        self._emitter = self._make_emitter() if self.ecfg.emitter else None
+        # hot-path dispatch: bound once so _process_burst/_process_prefill
+        # don't branch per token
+        self._emit = (self._emit_token_ev if self._emitter is not None
+                      else self._emit_token)
+        # reusable host-side staging for per-dispatch overrides and packed
+        # segment tables: round-robin pools deep enough that no buffer is
+        # rewritten while its async device transfer may still be reading
+        self._ov_pool = [np.empty((6 + sampling.RING_N, S), np.float32)
+                         for _ in range(max(6, self.ecfg.pipeline_depth + 4))]
+        self._ov_pool_idx = 0
+        self._seg_pools: dict = {}   # bucket -> round-robin list of arrays
+        self._seg_pool_idx: dict = {}
 
     def _sync_worker(self):
         """ALL device->host syncs run here, one at a time, in dispatch
@@ -899,16 +941,20 @@ class Engine:
         cache; a no-op unless the allocator dirtied the table."""
         if not self._paged or not self._pool.dirty:
             return
-        # two independent uploads: ck and cv are donated separately, and a
-        # shared leaf would be the same buffer donated twice
-        tabs = [jnp.asarray(self._pool.ptab) for _ in range(2)]
+        # ck and cv are donated separately, so they need DISTINCT table
+        # buffers — but one stacked host->device transfer plus two
+        # device-side slices beats two independent uploads (ISSUE 9:
+        # half the transfer dispatches on every allocator change)
+        stacked = np.stack((self._pool.ptab, self._pool.ptab))
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            sh = NamedSharding(self.mesh, P(None, None))
-            tabs = [jax.device_put(t, sh) for t in tabs]
-        self.ck = kvcache.with_page_table(self.ck, tabs[0])
-        self.cv = kvcache.with_page_table(self.cv, tabs[1])
+            sh = NamedSharding(self.mesh, P(None, None, None))
+            both = jax.device_put(stacked, sh)
+        else:
+            both = jnp.asarray(stacked)
+        self.ck = kvcache.with_page_table(self.ck, both[0])
+        self.cv = kvcache.with_page_table(self.cv, both[1])
         self._pool.dirty = False
 
     def _reclaim_pages(self, slot, need_free: int):
@@ -1085,28 +1131,17 @@ class Engine:
         idx = np.full((B,), pool.num_pages, np.int32)
         idx[:n] = pages[:n]
 
-        def stack(get):
-            first = get(host_hits[0])
-            if isinstance(first, dict):
-                def pad(leaf):
-                    a = np.stack([get(e)[leaf] for e in host_hits], axis=1)
-                    if B > n:
-                        a = np.concatenate(
-                            [a, np.zeros(a.shape[:1] + (B - n,)
-                                         + a.shape[2:], a.dtype)], axis=1)
-                    return a
-                return {"q": pad("q"), "s": pad("s")}
-            a = np.stack([get(e) for e in host_hits], axis=1)
-            if B > n:
-                a = np.concatenate(
-                    [a, np.zeros(a.shape[:1] + (B - n,) + a.shape[2:],
-                                 a.dtype)], axis=1)
-            return a
+        # double-buffered staging (PR-3 follow-up): the async scatter
+        # dispatched below may still be READING the previous parity's
+        # buffers while this batch fills the other set — reuse without
+        # aliasing, and no per-restore stack/concatenate allocations
+        par = self._rstager.begin()
+        ks = self._rstager.fill(par, "k", host_hits, lambda e: e.k, B)
+        vs = self._rstager.fill(par, "v", host_hits, lambda e: e.v, B)
 
         with self._annot("kv_restore_scatter"):
             self.ck, self.cv = self._get_restore_scatter_fn(B)(
-                self.ck, self.cv, idx, stack(lambda e: e.k),
-                stack(lambda e: e.v))
+                self.ck, self.cv, idx, ks, vs)
         for e, p in zip(host_hits, pages[:n]):
             pool.adopt(slot, p)
             # restored pages re-enter the device tier immediately: the
@@ -1872,9 +1907,16 @@ class Engine:
         for i, s in enumerate(self.slots):
             if s is not None:
                 self.slots[i] = None
-                s.req.out.put(StreamEvent(token_id=-1, text="", logprob=0.0,
-                                          finish_reason="stop", error="engine shut down"))
-                s.req.out.put(None)
+                ev = StreamEvent(token_id=-1, text="", logprob=0.0,
+                                 finish_reason="stop", error="engine shut down")
+                if self._emitter is not None:
+                    # lands after any still-queued tokens for the stream
+                    self._emitter.push_final(i, s, [ev, None])
+                else:
+                    s.req.out.put(ev)
+                    s.req.out.put(None)
+        if self._emitter is not None:
+            self._emitter.stop(timeout=5.0)
 
     def _reset_device_state(self):
         if self._bus is not None:
@@ -2064,6 +2106,14 @@ class Engine:
         lc["request_timeout_ms"] = self.ecfg.request_timeout_ms
         lc["dispatch_stall_ms"] = self.ecfg.dispatch_stall_ms
         out["lifecycle"] = lc
+        # event-driven emission (ISSUE 9)
+        if self._emitter is not None:
+            out["emitter"] = {"enabled": True,
+                              "alive": self._emitter.alive,
+                              "queued": self._emitter.qsize(),
+                              "emitted": self._emitter.emitted}
+        else:
+            out["emitter"] = {"enabled": False}
         # system observability (ISSUE 8): compile tracking + memory
         # watermarks + goodput/MFU, re-exposed per model on /metrics
         self._sample_watermarks()
@@ -2334,13 +2384,33 @@ class Engine:
                     # pool peaks between /metrics scrapes are not lost
                     t_wm = t0
                     self._sample_watermarks()
+                # emitter-detected stop finishes land as notes (ISSUE 9);
+                # apply before admission so the freed slots are admittable
+                # this very tick
+                self._apply_emitter_notes()
+                # pick up whatever completed while the previous tick was
+                # packing/dispatching BEFORE spending this tick's host
+                # time — ready bursts otherwise pay a full tick of
+                # finish-detect each (ISSUE 9); never blocks. Only with
+                # the emitter on: in-loop emission makes burst pickup
+                # expensive enough that extra drain points would starve
+                # dispatch, so emitter=0 keeps the seed cadence.
+                ev_mode = self._emitter is not None
+                drained0 = self._drain_fifo(block=False) if ev_mode \
+                    else False
                 admitted = self._admit()
                 self._tmark("admit", t0)
                 t0 = time.monotonic()
                 prefilled = self._prefill_step()
                 self._tmark("prefill", t0)
+                # prompt packing is the longest host stretch of the tick;
+                # collect anything that completed under it (no-op when
+                # nothing is ready)
+                if ev_mode:
+                    drained0 |= self._drain_fifo(block=False)
                 dispatched = self._dispatch_decode()
-                drained = self._drain_fifo(can_feed=dispatched or prefilled)
+                drained = self._drain_fifo(
+                    can_feed=dispatched or prefilled) or drained0
                 if self.tracer.enabled and (admitted or prefilled
                                             or dispatched or drained):
                     self.tracer.record(
@@ -2355,7 +2425,13 @@ class Engine:
                     # FIFO while the loop idles here — the watchdog must
                     # cover that wedge too, not just _wait_ready callers
                     self._check_parked_stall()
-                    self._wake.wait(timeout=0.05)
+                    self._check_emitter_wedge()
+                    # event-driven idle (ISSUE 9): the sync worker and the
+                    # emitter note channel both set _wake, so the fixed
+                    # 50 ms poll tick is gone — park until woken, waking
+                    # on a watchdog-scaled timeout only to re-run the
+                    # stall/wedge checks above
+                    self._wake.wait(timeout=self._idle_wait_s)
                     self._wake.clear()
             except _DispatchStall as st:
                 # stall watchdog (ISSUE 7): a narrower failure than the
@@ -2367,11 +2443,16 @@ class Engine:
                 log.exception("engine step failed")
                 for i, s in enumerate(self.slots):
                     if s is not None:
-                        s.req.out.put(StreamEvent(
+                        ev = StreamEvent(
                             token_id=-1, text="", logprob=0.0,
                             finish_reason="stop", error=f"{type(e).__name__}: {e}",
-                        ))
-                        s.req.out.put(None)
+                        )
+                        if self._emitter is not None:
+                            # FIFO with any still-queued tokens (ISSUE 9)
+                            self._emitter.push_final(i, s, [ev, None])
+                        else:
+                            s.req.out.put(ev)
+                            s.req.out.put(None)
                         self._release_slot(i)
                 # a failure inside a donated jitted call leaves ck/cv/ring/
                 # keys pointing at deleted buffers — reinitialize device state
@@ -2452,7 +2533,11 @@ class Engine:
             if s is not None and s.req.request_id in self._cancelled:
                 self._cancelled.discard(s.req.request_id)
                 self._release_slot(i)
-                s.req.out.put(None)
+                if self._emitter is not None:
+                    # close the stream AFTER queued tokens drain (ISSUE 9)
+                    self._emitter.push_final(i, s, [None])
+                else:
+                    s.req.out.put(None)
                 # a cancelled LEADER must not strand fork-waiting siblings
                 self._process_fork_waiters(i)
 
@@ -2484,12 +2569,17 @@ class Engine:
                               f"({self.ecfg.max_queue_wait_ms} ms)")
         if not timeout_on:
             return
-        for s in self.slots:
+        for i, s in enumerate(self.slots):
             if s is not None and s.req.deadline and now > s.req.deadline \
                     and s.req.request_id not in self._cancelled:
                 # decoding for a dead client: error event now, then the
                 # cancel path releases the slot and closes the stream
-                s.req.out.put(self._timeout_event(s.req))
+                if self._emitter is not None:
+                    # no trailing None here — the cancel path routes the
+                    # stream close through the emitter queue itself
+                    self._emitter.push_final(i, s, [self._timeout_event(s.req)])
+                else:
+                    s.req.out.put(self._timeout_event(s.req))
                 self.cancel(s.req.request_id)
 
     def _check_parked_stall(self):
@@ -2567,12 +2657,18 @@ class Engine:
         except ValueError:
             pass
         for i, snap in stalled:
-            snap.req.out.put(StreamEvent(
+            ev = StreamEvent(
                 token_id=-1, text="", logprob=0.0, finish_reason="stop",
                 error=(f"device dispatch stalled > "
                        f"{self.ecfg.dispatch_stall_ms} ms; request aborted"),
-                error_kind="stall"))
-            snap.req.out.put(None)
+                error_kind="stall")
+            if self._emitter is not None:
+                # FIFO-ordered behind any tokens already handed over, so
+                # the abort reaches queued-but-unemitted tokens too
+                self._emitter.push_final(i, snap, [ev, None])
+            else:
+                snap.req.out.put(ev)
+                snap.req.out.put(None)
             self._release_slot(i)
             self._process_fork_waiters(i)
 
@@ -3401,14 +3497,8 @@ class Engine:
         self._commit_ptab()
 
         bucket = next(b for b in self._pack_buckets if total <= b)
-        tokens = np.zeros((bucket,), np.int32)
-        positions = np.full((bucket,), C, np.int32)   # pad: scatter drops
-        seg_of = np.full((bucket,), S, np.int32)      # pad: own segment id
-        seg_slots = np.full((S,), S, np.int32)        # pad: state writes drop
-        seg_start = np.zeros((S,), np.int32)
-        seg_off = np.zeros((S,), np.int32)
-        seg_len = np.zeros((S,), np.int32)
-        final_mask = np.zeros((S,), np.bool_)
+        (tokens, positions, seg_of, seg_slots, seg_start, seg_off,
+         seg_len, final_mask) = self._pack_arrays(bucket, C, S)
         off = 0
         for b, (slot, s, take, final) in enumerate(segs):
             tokens[off:off + take] = s.pending[:take]
@@ -3525,14 +3615,15 @@ class Engine:
                 self._prefill_queue.remove(slot)
             group_snaps.append((slot, s))
         # budget-mask other decoding slots exactly like _dispatch_decode
+        # (one FIFO pass for all slots' in-flight counts — ISSUE 9)
+        infl = self._inflight_vec()
         active = self.active_dev.copy()
         included = list(group_snaps)
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode" \
                     or any(g == i for g, _ in group_snaps):
                 continue
-            if (s.req.max_new_tokens - s.n_decoded
-                    - self._inflight_steps(i) <= 0):
+            if s.req.max_new_tokens - s.n_decoded - infl[i] <= 0:
                 active[i] = False
                 continue
             included.append((i, s))
@@ -3543,7 +3634,7 @@ class Engine:
             if any(g == i for g, _ in group_snaps):
                 continue
             self._ensure_pages(i, min(C, int(self.lengths[i])
-                                      + self._inflight_steps(i) + K + 2))
+                                      + infl[i] + K + 2))
         self._commit_ptab()
         ov_mask = np.zeros((S,), np.bool_)
         if self._chain is None:
@@ -3623,13 +3714,14 @@ class Engine:
                 self._prefill_queue.remove(gslot)
             group_snaps.append((gslot, gs))
         # budget-mask other decoding slots exactly like _dispatch_decode
+        # (one FIFO pass for all slots' in-flight counts — ISSUE 9)
+        infl = self._inflight_vec()
         active = self.active_dev.copy()
         included = list(group_snaps)
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode" or any(g == i for g, _ in group_snaps):
                 continue
-            if (s.req.max_new_tokens - s.n_decoded
-                    - self._inflight_steps(i) <= 0):
+            if s.req.max_new_tokens - s.n_decoded - infl[i] <= 0:
                 active[i] = False
                 continue
             included.append((i, s))
@@ -3641,7 +3733,7 @@ class Engine:
             if any(g == i for g, _ in group_snaps):
                 continue
             self._ensure_pages(i, min(C, int(self.lengths[i])
-                                      + self._inflight_steps(i) + K + 2))
+                                      + infl[i] + K + 2))
         self._commit_ptab()
         ov_mask = np.zeros((S,), np.bool_)
         if self._chain is None:
@@ -3748,18 +3840,22 @@ class Engine:
                     trc.record("prefill", f"slot{gslot}", t0, t1,
                                rid=gs.req.request_id,
                                args={"prompt_tokens": gs.prompt_len})
-            self._emit_token(gslot, first_id, float(lps_np[b]))
+            self._emit(gslot, first_id, float(lps_np[b]))
         # leaders just committed: fork their rows to any waiting siblings
         # (vanished leaders downgrade the siblings to full prefills)
         for gslot, _snap in group:
             self._process_fork_waiters(gslot)
         self._flush_grammar_bias()
+        self._flush_em_batch()
 
     def _pack_ov(self, ov_mask) -> "np.ndarray":
-        """Build the packed override upload (fresh array every call: the
-        in-flight dispatch must never alias live host mirrors)."""
-        S = self.ecfg.num_slots
-        p = np.empty((6 + sampling.RING_N, S), np.float32)
+        """Build the packed override upload. Round-robin buffer reuse
+        (ISSUE 9): a dispatch's async host->device copy must never read
+        a buffer a LATER dispatch is refilling, so the pool is deeper
+        than the pipeline can hold in flight — no per-dispatch
+        allocation, no aliasing of live host mirrors."""
+        p = self._ov_pool[self._ov_pool_idx]
+        self._ov_pool_idx = (self._ov_pool_idx + 1) % len(self._ov_pool)
         p[0] = ov_mask
         p[1] = self.cur_tokens
         p[2] = self.lengths
@@ -3769,30 +3865,77 @@ class Engine:
         p[6:] = self.ring.T
         return p
 
+    def _pack_arrays(self, bucket: int, C: int, S: int) -> tuple:
+        """Reusable (round-robin) host arrays for one packed-prefill
+        dispatch, reset to their pad values (ISSUE 9: eight fresh
+        allocations per packed dispatch, gone). Pool depth mirrors
+        _pack_ov: deeper than the pipeline can hold in flight, so an
+        async upload never reads a buffer being refilled."""
+        pool = self._seg_pools.get(bucket)
+        if pool is None:
+            depth = max(6, self.ecfg.pipeline_depth + 4)
+            pool = self._seg_pools[bucket] = [
+                (np.empty((bucket,), np.int32),    # tokens
+                 np.empty((bucket,), np.int32),    # positions
+                 np.empty((bucket,), np.int32),    # seg_of
+                 np.empty((S,), np.int32),         # seg_slots
+                 np.empty((S,), np.int32),         # seg_start
+                 np.empty((S,), np.int32),         # seg_off
+                 np.empty((S,), np.int32),         # seg_len
+                 np.empty((S,), np.bool_))         # final_mask
+                for _ in range(depth)]
+            self._seg_pool_idx[bucket] = 0
+        i = self._seg_pool_idx[bucket]
+        self._seg_pool_idx[bucket] = (i + 1) % len(pool)
+        (tokens, positions, seg_of, seg_slots, seg_start, seg_off,
+         seg_len, final_mask) = pool[i]
+        tokens.fill(0)
+        positions.fill(C)      # pad: scatter drops
+        seg_of.fill(S)         # pad: own segment id
+        seg_slots.fill(S)      # pad: state writes drop
+        seg_start.fill(0)
+        seg_off.fill(0)
+        seg_len.fill(0)
+        final_mask.fill(False)
+        return pool[i]
+
     def _n_inflight_bursts(self) -> int:
         return sum(1 for x in self._fifo if isinstance(x, _Burst))
 
-    def _inflight_steps(self, slot: int) -> int:
-        """Decode tokens already dispatched (unprocessed) for a slot."""
-        n = 0
+    def _inflight_vec(self) -> list:
+        """Decode tokens already dispatched (unprocessed) per slot, in
+        ONE pass over the FIFO (ISSUE 9): dispatch planners that used to
+        call the per-slot scan once per candidate slot — rescanning the
+        FIFO S times per dispatch — take this vector once instead."""
+        n = [0] * self.ecfg.num_slots
         for b in self._fifo:
-            if not isinstance(b, _Burst) or slot in b.skip_slots:
+            if not isinstance(b, _Burst):
                 continue
-            if any(i == slot for i, _ in b.slots):
-                n += b.n_steps
-                if any(i == slot for i, _ in b.group):
-                    n += 1   # the fused first token
+            gset = {i for i, _ in b.group}
+            for i, _ in b.slots:
+                if i not in b.skip_slots:
+                    n[i] += b.n_steps + (1 if i in gset else 0)
         return n
 
-    def _drain_fifo(self, can_feed: bool = False) -> bool:
+    def _inflight_steps(self, slot: int) -> int:
+        """Decode tokens already dispatched (unprocessed) for a slot."""
+        return self._inflight_vec()[slot]
+
+    def _drain_fifo(self, can_feed: bool = False,
+                    block: bool = True) -> bool:
         """Process dispatched work. Prefill groups activate as soon as the
         sync worker flags them ready (any position in the FIFO — safe:
         a prefill group's slots are disjoint from every in-flight burst's
         participants, since they were mid-prefill at those dispatches).
         The oldest burst is block-synced only when the pipeline is already
-        full or nothing more can be dispatched (``can_feed`` False) — and
-        at most one per call, so the loop refills the pipeline between
-        syncs and the device always has work queued."""
+        full or nothing more can be dispatched (``can_feed`` False) — at
+        most one BLOCKING sync per call, so the loop refills the pipeline
+        between syncs and the device always has work queued. Bursts that
+        are ALREADY ready are all processed (ISSUE 9: their device work
+        is done, so holding them to one per tick only inflated
+        finish-detect by a full tick per queued burst); ``block`` False
+        skips the blocking sync entirely (top-of-tick drain: pick up
+        whatever completed while the previous tick packed prompts)."""
         progressed = False
         for item in [x for x in self._fifo
                      if not isinstance(x, _Burst) and x.ready.is_set()]:
@@ -3801,18 +3944,30 @@ class Engine:
             self._process_prefill(item)
             self._tmark("finalize", t0)
             progressed = True
-        for idx, item in enumerate(self._fifo):
-            if not isinstance(item, _Burst):
-                continue   # a not-yet-ready prefill ahead; bursts may pass it
-            if not item.ready.is_set() and can_feed and \
-                    self._n_inflight_bursts() < self.ecfg.pipeline_depth:
+        synced = False
+        while True:
+            acted = False
+            for idx, item in enumerate(self._fifo):
+                if not isinstance(item, _Burst):
+                    continue   # a not-yet-ready prefill ahead; bursts may
+                    # pass it
+                if not item.ready.is_set():
+                    if not block or synced or (
+                            can_feed and self._n_inflight_bursts()
+                            < self.ecfg.pipeline_depth):
+                        break
+                    synced = True
+                del self._fifo[idx]
+                t0 = time.monotonic()
+                self._process_burst(item)
+                self._tmark("process_burst", t0)
+                progressed = True
+                acted = True
                 break
-            del self._fifo[idx]
-            t0 = time.monotonic()
-            self._process_burst(item)
-            self._tmark("process_burst", t0)
-            progressed = True
-            break
+            if not acted or self._emitter is None:
+                # emitter=0: at most one burst per call (seed cadence —
+                # in-loop emission is too expensive to batch up)
+                break
         return progressed
 
     def _drain_all(self):
@@ -3842,10 +3997,11 @@ class Engine:
         against the capacity clamp too."""
         cap = self.ecfg.decode_burst
         budget = 1
+        infl_vec = self._inflight_vec()
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode":
                 continue
-            infl = self._inflight_steps(i)
+            infl = infl_vec[i]
             used = s.cache_len + infl
             cap = min(cap, max(1, self.ecfg.max_context - 2 - used))
             budget = max(budget, s.req.max_new_tokens - s.n_decoded - infl)
@@ -3966,12 +4122,12 @@ class Engine:
         if exclude is not None:
             active &= ~exclude
         included = []
+        infl = self._inflight_vec()   # one FIFO pass for all slots (ISSUE 9)
         for i in decoding:
             if exclude is not None and exclude[i]:
                 continue
             s = self.slots[i]
-            if (s.req.max_new_tokens - s.n_decoded
-                    - self._inflight_steps(i) <= 0):
+            if s.req.max_new_tokens - s.n_decoded - infl[i] <= 0:
                 # in-flight steps already cover this slot's budget: mask it
                 # out so it doesn't ride the new burst as garbage compute
                 # (with depth-2 pipelining that waste measured ~30% of all
@@ -3989,8 +4145,7 @@ class Engine:
             C = self.ecfg.max_context
             for i in included:
                 self._ensure_pages(i, min(C, int(self.lengths[i])
-                                          + self._inflight_steps(i)
-                                          + n_steps + 2))
+                                          + infl[i] + n_steps + 2))
             self._commit_ptab()
         f = sampling.feature_flags(self.slot_params, self.active_dev)
         flags = (f["use_penalties"], f["use_typical"], f["use_mirostat"])
@@ -4122,7 +4277,9 @@ class Engine:
                         tr.record("decode", f"slot{i}", b.t_dispatch, t_rdy,
                                   rid=snap.req.request_id,
                                   args={"steps": b.n_steps})
-        self._sink_buf = {}
+        # emitter mode hands tokens over as one immutable batch instead
+        # of coalescing events in-loop (ISSUE 9)
+        self._sink_buf = {} if self._emitter is None else None
         rolled: set = set()   # grammar slots rolled back mid-burst
         try:
             # fused-admission slots: emit the in-fn sampled first token
@@ -4149,8 +4306,8 @@ class Engine:
                                   rid=snap.req.request_id,
                                   args={"prompt_tokens": snap.prompt_len,
                                         "fused": True})
-                if not self._emit_token(i, int(b.first_ids[i]),
-                                        float(b.first_lps[i])):
+                if not self._emit(i, int(b.first_ids[i]),
+                                  float(b.first_lps[i])):
                     rolled.add(i)
             for i, _snap in b.group:
                 self._process_fork_waiters(i)
@@ -4161,24 +4318,29 @@ class Engine:
                         continue  # finished/shifted/replaced/rolled-back
                     # the step just wrote this slot's previous token's KV row
                     snap.committed = min(snap.committed + 1, snap.cache_len)
-                    if not self._emit_token(i, int(b.ids_np[j, i]),
-                                            float(b.lps_np[j, i])):
+                    if not self._emit(i, int(b.ids_np[j, i]),
+                                      float(b.lps_np[j, i])):
                         rolled.add(i)
         finally:
             buf, self._sink_buf = self._sink_buf, None
             self._tmark("emit_loop", t0)
             self._flush_grammar_bias()
+            self._flush_em_batch()
             t0 = time.monotonic()
             if tr.enabled:
-                # emit = detok + stop-scan walltime; flush is separate
+                # emit = detok + stop-scan walltime; flush is separate.
+                # With the emitter on this shrinks to id-level control +
+                # one queue put — the text work records under emit_bg on
+                # the emitter thread instead.
                 tr.record("emit", "engine", t_proc, t0,
                           args={"steps": b.n_steps})
-            for (_slot, out), evs in buf.items():
-                out.put(evs[0] if len(evs) == 1 else _merge_events(evs))
+            if buf:
+                for (_slot, out), evs in buf.items():
+                    out.put(evs[0] if len(evs) == 1 else _merge_events(evs))
             self._tmark("emit_flush", t0)
             if tr.enabled:
                 tr.record("stream_flush", "engine", t0, time.monotonic(),
-                          args={"streams": len(buf)})
+                          args={"streams": len(buf) if buf else 0})
 
     def _emit_token(self, slot: int, token_id: int, logprob: float) -> bool:
         """Emit one token for a slot. Returns False when the token was a
@@ -4318,6 +4480,244 @@ class Engine:
         # in-flight tokens (stale positions) — skip them like a rollback,
         # but the token above was valid and HAS been emitted
         return not extended
+
+    # ---------- event-driven emission (ISSUE 9) ----------
+
+    def _emit_token_ev(self, slot: int, token_id: int, logprob: float) -> bool:
+        """Event-driven twin of _emit_token: identical id-level control
+        flow (EOS, grammar advance/rollback, length, context shift, KV
+        bookkeeping), but NO text work — the token joins the per-tick
+        batch handed to the emitter worker, which owns detok, stop-scan
+        and every ``req.out`` put. Stop sequences are text-level, so in
+        this mode they are detected by the EMITTER and fed back via
+        ``_apply_emitter_notes``."""
+        s = self.slots[slot]
+        s.generated.append(token_id)
+        s.n_decoded += 1
+        self._total_tokens += 1
+        finish = None
+        shifted = False
+
+        if token_id in self.eos_ids and not (s.req.ignore_eos and s.grammar is None):
+            if s.grammar is not None and s.cur_penalty is not None \
+                    and s.cur_penalty[token_id] != 0.0:
+                # speculative EOS sampled under a STALE mask while the
+                # grammar cannot terminate yet — discard and resume
+                return self._rollback_grammar(slot, s)
+            finish = "stop"
+        elif s.grammar is not None and not self._advance_grammar(slot, s, token_id):
+            # speculative token fell outside the grammar (stale mask mid-
+            # burst) — roll back instead of emitting invalid output
+            return self._rollback_grammar(slot, s)
+        elif s.n_decoded >= s.req.max_new_tokens:
+            finish = "length"
+        elif s.cache_len + 1 >= self.ecfg.max_context - 1:
+            if self.ecfg.context_shift:
+                # the emitter still stop-scans this token; a stop that
+                # completes here aborts the shifted slot via the note
+                # channel — the re-prefill is wasted work, the emitted
+                # OUTPUT is identical to the in-loop path
+                self._context_shift(slot, s, token_id)
+                shifted = True
+            else:
+                finish = "length"
+
+        extended = False
+        if finish is None and not shifted:
+            # this token's KV is written by the next decode step
+            self._cache_tokens[slot].append(token_id)
+            s.cache_len += 1
+            if self.ecfg.ga_n > 1 and s.mm_pos is None:
+                extended = self._maybe_self_extend(slot, s)
+
+        e = self._em_batch.get(slot)
+        if e is None or e["snap"] is not s:
+            e = self._em_batch[slot] = {
+                "slot": slot, "snap": s, "tokens": [],
+                "finish": None, "timings": None}
+        # n_decoded is captured per token: the snapshot keeps mutating
+        # while the batch rides the queue
+        e["tokens"].append((token_id, logprob, s.n_decoded))
+        if finish:
+            timings = self._finish_timings_ev(s, s.n_decoded,
+                                              time.monotonic())
+            e["finish"] = finish
+            e["timings"] = timings
+            self._finish_accounting_ev(slot, s, finish, s.n_decoded,
+                                       timings)
+        return not extended
+
+    def _flush_em_batch(self):
+        """Hand the tick's accumulated token batch to the emitter as ONE
+        queue item — per-slot FIFO order is the queue's FIFO order."""
+        if self._em_batch:
+            batch, self._em_batch = self._em_batch, {}
+            self._emitter.push_batch(list(batch.values()))
+
+    def _finish_timings_ev(self, s: "_Slot", ndec: int, t_done: float) -> dict:
+        """Final-event timings for an engine-detected finish (same fields
+        _emit_token computes inline; the emitter mirrors this for the
+        stops it detects itself)."""
+        dt = t_done - s.t_first_token
+        queue_wait_ms = max(0.0, (s.t_start - s.req.t_submit) * 1e3) \
+            if s.req.t_submit else 0.0
+        admit_to_first_ms = max(0.0, (s.t_first_token - s.t_start) * 1e3) \
+            if s.t_first_token else 0.0
+        return {
+            "prefill_ms": s.t_prefill_ms,
+            "queue_wait_ms": queue_wait_ms,
+            "admit_to_first_ms": admit_to_first_ms,
+            "reused_prompt_tokens": s.reused,
+            "decode_tokens_per_s":
+                (ndec - 1) / dt if dt > 0 and ndec > 1 else 0.0,
+        }
+
+    def _finish_accounting_ev(self, slot: int, s: "_Slot", finish: str,
+                              ndec: int, timings: dict):
+        """Everything _emit_token's finish branch does besides the stream
+        puts (those belong to the emitter): TTFT decomposition, request
+        span, slow-request log, goodput, completion event, prompt-cache
+        save, slot release."""
+        with self._decomp_lock:
+            self._ttft_decomp.append(
+                (timings["queue_wait_ms"], timings["admit_to_first_ms"],
+                 s.t_prefill_ms))
+        t_done = time.monotonic()
+        if self.tracer.enabled and s.req.t_submit:
+            self.tracer.record("request", f"slot{slot}",
+                               s.req.t_submit, t_done,
+                               rid=s.req.request_id,
+                               args={"completion_tokens": ndec,
+                                     "finish": finish})
+        if self._slow_ms > 0:
+            ttft_ms = timings["queue_wait_ms"] + timings["admit_to_first_ms"]
+            e2e_ms = (t_done - s.req.t_submit) * 1e3 \
+                if s.req.t_submit else 0.0
+            if ttft_ms > self._slow_ms or e2e_ms > self._slow_ms:
+                import json as _json
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "slow request %s: %s", s.req.request_id,
+                    _json.dumps({
+                        "threshold_ms": self._slow_ms,
+                        "e2e_ms": round(e2e_ms, 1),
+                        "ttft_ms": round(ttft_ms, 1),
+                        "completion_tokens": ndec,
+                        "spans": {k: (round(v, 1)
+                                      if isinstance(v, float) else v)
+                                  for k, v in timings.items()},
+                    }, sort_keys=True))
+        # goodput (ISSUE 8): ONLY clean finishes count — sheds, timeouts
+        # and stall aborts never reach this branch
+        self._goodput.add(ndec)
+        EVENTS.emit("complete", rid=s.req.request_id, finish=finish,
+                    completion_tokens=ndec,
+                    e2e_ms=round((t_done - s.req.t_submit) * 1e3, 1)
+                    if s.req.t_submit else None)
+        self._save_prompt_cache(slot, s)
+        self._release_slot(slot)
+
+    def _make_emitter(self):
+        from localai_tpu.engine.emitter import EmitterWorker
+
+        def note(slot, snap, ndec, timings):
+            with self._em_lock:
+                self._em_notes.append(("stop", slot, snap, ndec, timings))
+            self._wake.set()
+
+        def note_abort(slot, snap):
+            with self._em_lock:
+                self._em_notes.append(("abort", slot, snap, 0, None))
+            self._wake.set()
+
+        return EmitterWorker(tracer=self.tracer, stream_event=StreamEvent,
+                             merge_events=_merge_events, note_finish=note,
+                             note_abort=note_abort)
+
+    def _apply_emitter_notes(self):
+        """Apply emitter-side finishes. ``stop`` notes are detected
+        stop-sequence completions: the emitter has already truncated the
+        text and closed the stream; the engine side releases the slot,
+        pulls a racing context-shift re-prefill back out of the queue,
+        and accounts the completion. ``abort`` notes are emitter-side
+        item failures (e.g. a detokenizer exception) whose streams the
+        emitter already failed — release only, no completion accounting
+        (mirrors the in-loop generic handler). Tokens decoded past the
+        note are discarded with the slot (same rule as any other
+        in-flight invalidation)."""
+        if self._emitter is None or not self._em_notes:
+            return
+        with self._em_lock:
+            notes, self._em_notes = self._em_notes, []
+        for kind, slot, snap, ndec, timings in notes:
+            if self.slots[slot] is not snap:
+                continue   # engine finished/aborted the slot first
+            # a context shift may have queued this slot for re-prefill
+            # right after the note-carrying token; the request is over
+            try:
+                self._prefill_queue.remove(slot)
+            except ValueError:
+                pass
+            # in-flight bursts must not keep decoding for the dead slot
+            for b in self._fifo:
+                if isinstance(b, _Burst):
+                    b.skip_slots.add(slot)
+            if kind == "stop":
+                self._finish_accounting_ev(slot, snap, "stop", ndec,
+                                           timings)
+            else:
+                self._release_slot(slot)
+            self._process_fork_waiters(slot)
+
+    def _check_emitter_wedge(self):
+        """Watchdog coverage for a wedged EMITTER: if the worker has been
+        stuck on one item longer than the dispatch stall budget (or died
+        with work still queued), take over its queue, fail every affected
+        stream directly, and build a fresh worker."""
+        em = self._emitter
+        if em is None:
+            return
+        stall_s = self.ecfg.dispatch_stall_ms / 1e3
+        if stall_s <= 0:
+            return
+        t = em.t_item_start
+        wedged = (t > 0 and time.monotonic() - t > stall_s) \
+            or (not em.alive and em.qsize() > 0)
+        if not wedged:
+            return
+        import logging
+
+        logging.getLogger(__name__).error(
+            "emitter wedged (> %d ms on one item); replacing worker",
+            self.ecfg.dispatch_stall_ms)
+        with self._lc_lock:
+            self._lc["stalls"] += 1
+        EVENTS.emit("emitter_wedge",
+                    dispatch_stall_ms=self.ecfg.dispatch_stall_ms,
+                    queued=em.qsize())
+        # fail the streams of still-queued items (their tokens/finals
+        # are lost with the worker) plus every still-active slot
+        victims: dict = {}
+        for it in em.takeover():
+            if it[0] == "batch":
+                for e in it[1]:
+                    victims[id(e["snap"])] = e["snap"]
+            else:
+                victims[id(it[2])] = it[2]
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                victims[id(s)] = s
+                self._release_slot(i)
+                self._process_fork_waiters(i)
+        for s in victims.values():
+            s.req.out.put(StreamEvent(
+                token_id=-1, text="", logprob=0.0, finish_reason="stop",
+                error=(f"emitter wedged > {self.ecfg.dispatch_stall_ms} "
+                       f"ms; request aborted"),
+                error_kind="stall"))
+            s.req.out.put(None)
+        self._emitter = self._make_emitter()
 
     def _context_shift(self, slot: int, s: _Slot, token_id: int):
         """Cache full mid-generation: re-prefill the tail half of the logical

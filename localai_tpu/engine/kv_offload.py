@@ -105,6 +105,53 @@ class _HostEntry:
         self.crc = _page_crc(k, v)
 
 
+class RestoreStager:
+    """Double-buffered staging for restore uploads (ROADMAP PR-3
+    follow-up, ISSUE 9 satellite): two alternating host buffer sets, so
+    the batch an in-flight host->device scatter is still reading can
+    never be refilled by the NEXT restore — the upload overlaps the tail
+    prefill dispatch instead of re-allocating (or worse, clobbering) one
+    shared buffer. Buffers are keyed by (name, shape, dtype) and reused
+    across restores of the same batch shape, killing the per-restore
+    np.stack/np.concatenate allocation churn."""
+
+    def __init__(self):
+        self._bufs: list[dict] = [{}, {}]
+        self._flip = 0
+
+    def begin(self) -> int:
+        """Start a new restore batch; returns the parity to stage into
+        (the OTHER set from the previous — possibly in-flight — batch)."""
+        self._flip ^= 1
+        return self._flip
+
+    def stage(self, parity: int, name, shape, dtype) -> np.ndarray:
+        """A reusable staging buffer of the given shape/dtype."""
+        bufs = self._bufs[parity]
+        key = (name, tuple(shape), np.dtype(dtype).str)
+        a = bufs.get(key)
+        if a is None:
+            a = bufs[key] = np.empty(shape, dtype)
+        return a
+
+    def fill(self, parity: int, name, entries, get, batch: int):
+        """Stage ``[get(e) for e in entries]`` along axis 1, zero-padding
+        columns up to ``batch``; handles the {"q","s"} int8 page dicts."""
+        first = get(entries[0])
+        if isinstance(first, dict):
+            return {leaf: self.fill(parity, (name, leaf), entries,
+                                    lambda e, lf=leaf: get(e)[lf], batch)
+                    for leaf in first}
+        shape = first.shape[:1] + (batch,) + first.shape[1:]
+        a = self.stage(parity, name, shape, first.dtype)
+        a[:, 0] = first
+        for i, e in enumerate(entries[1:], start=1):
+            a[:, i] = get(e)
+        if batch > len(entries):
+            a[:, len(entries):] = 0
+        return a
+
+
 class HostPageStore:
     """Byte-budgeted host-RAM index of offloaded pages."""
 

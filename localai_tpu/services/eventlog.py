@@ -45,11 +45,18 @@ class EventLog:
         self._lock = threading.Lock()
         self._fh = None
         self._seq = 0
+        self._max_bytes = 0
+        self.rotations = 0
         self.sink = "off"
         self.configure(sink or os.environ.get("LOCALAI_EVENT_LOG", ""))
 
-    def configure(self, sink: str):
-        """(Re)arm the write-through sink: path | stderr | off/empty."""
+    def configure(self, sink: str, max_mb: int = 64):
+        """(Re)arm the write-through sink: path | stderr | off/empty.
+
+        ``max_mb`` bounds a FILE sink's size (ROADMAP PR-8 follow-up):
+        once the file reaches the bound it rotates to ``<path>.1``, one
+        generation kept — an always-on event log can never fill the
+        disk. 0 disables rotation; stderr/ring sinks are unaffected."""
         sink = (sink or "").strip()
         with self._lock:
             if self._fh is not None and self._fh is not sys.stderr:
@@ -58,6 +65,7 @@ class EventLog:
                 except Exception:
                     pass
             self._fh = None
+            self._max_bytes = max(0, int(max_mb)) * 1024 * 1024
             if not sink or sink == "off":
                 self.sink = "off"
             elif sink == "stderr":
@@ -71,6 +79,25 @@ class EventLog:
                     log.warning("event_log sink %s unwritable (%s); "
                                 "ring-only", sink, e)
                     self.sink = "off"
+
+    def _maybe_rotate(self, fh):
+        """Rotate the file sink once it crosses the size bound. Called
+        outside the lock with the fh the writer just used; re-checks
+        under the lock so concurrent writers rotate exactly once."""
+        with self._lock:
+            if fh is not self._fh or self._fh is sys.stderr:
+                return   # someone else already rotated / reconfigured
+            try:
+                if self._fh.tell() < self._max_bytes:
+                    return
+                self._fh.close()
+                os.replace(self.sink, self.sink + ".1")
+                self._fh = open(self.sink, "a", buffering=1)
+                self.rotations += 1
+            except Exception as e:
+                log.warning("event_log rotation of %s failed (%s); "
+                            "ring-only", self.sink, e)
+                self._fh = None
 
     def emit(self, event: str, rid: str = "", model: str = "", **fields):
         """Record one event. Never raises — telemetry must not take the
@@ -91,6 +118,9 @@ class EventLog:
         if fh is not None:
             try:
                 fh.write(json.dumps(rec, default=str) + "\n")
+                if self._max_bytes and fh is not sys.stderr \
+                        and fh.tell() >= self._max_bytes:
+                    self._maybe_rotate(fh)
             except Exception:
                 pass
 
@@ -111,7 +141,8 @@ class EventLog:
         with self._lock:
             return {"sink": self.sink, "seq": self._seq,
                     "ring": len(self._ring),
-                    "ring_size": self._ring.maxlen}
+                    "ring_size": self._ring.maxlen,
+                    "rotations": self.rotations}
 
 
 # Per-process singleton. The engine's `event_log=` option and the core

@@ -28,6 +28,8 @@ Injection points (grep for ``FAULTS.take``):
 ``page_alloc_fail``              engine ``_ensure_pages``: raise PoolExhausted
 ``host_store_corrupt``           engine/kv_offload.py ``get``: flip a byte in
                                  the stored page (the checksum must catch it)
+``emitter_wedge_ms=N``           engine/emitter.py worker loop: sleep N ms on
+                                 one item (wedged-emitter watchdog coverage)
 ==========================  =================================================
 """
 

@@ -50,6 +50,16 @@ DEVICE_SPANS = frozenset({
     "decode_burst_device",
 })
 
+# Span names recorded by the EMITTER worker thread (ISSUE 9): detok,
+# stop-scan and stream queue puts that used to run on the engine loop.
+# They get their own decomposition bucket — this walltime overlaps both
+# device compute and the host loop, so folding it into host_loop would
+# double-count time the engine thread never spent.
+EMITTER_SPANS = frozenset({
+    "emit_bg",
+    "stream_flush_bg",
+})
+
 # Sync-worker ready-set → engine loop picking the result up: the
 # finish-detection latency called out in the r5 verdict.
 FINISH_DETECT_SPAN = "finish_detect"
@@ -125,6 +135,8 @@ class RingTracer:
         }
         host = sum(t for name, (t, _) in agg.items() if name in HOST_SPANS)
         device = sum(t for name, (t, _) in agg.items() if name in DEVICE_SPANS)
+        emitter = sum(t for name, (t, _) in agg.items()
+                      if name in EMITTER_SPANS)
         fin = agg.get(FINISH_DETECT_SPAN, (0.0, 0))[0]
         return {
             "enabled": True,
@@ -135,6 +147,7 @@ class RingTracer:
             "decomp_ms": {
                 "host_loop": round(host * 1e3, 3),
                 "device": round(device * 1e3, 3),
+                "emitter": round(emitter * 1e3, 3),
                 "finish_detect": round(fin * 1e3, 3),
             },
         }

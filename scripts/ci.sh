@@ -39,11 +39,25 @@ print(f"TTFT_LOADED_UNLOADED_RATIO={line.get('ttft_loaded_unloaded_ratio')} "
       f"packed_vs_sequential_speedup={pp.get('ttft_speedup')} "
       f"greedy_match={pp.get('greedy_match')}")
 # host-loop vs device-time decomposition from the span tracer (this is
-# the 505-vs-809 tok/s gap, measured — track it across rounds)
+# the 505-vs-809 tok/s gap, measured — track it across rounds), for the
+# event-driven emitter path AND the in-loop emitter=0 path (ISSUE 9):
+# the gate below fails CI unless the emitter's finish-detect lag is
+# strictly below the polled in-loop run's
 d = (line.get("host_device_decomp") or {}).get("host_device_decomp_ms") or {}
+doff = (line.get("host_device_decomp_off") or {}).get(
+    "host_device_decomp_ms") or {}
 print(f"HOST_LOOP_MS={d.get('host_loop')} "
       f"DEVICE_MS={d.get('device')} "
+      f"EMITTER_MS={d.get('emitter')} "
       f"FINISH_DETECT_MS={d.get('finish_detect')}")
+print(f"HOST_LOOP_MS_OFF={doff.get('host_loop')} "
+      f"DEVICE_MS_OFF={doff.get('device')} "
+      f"FINISH_DETECT_MS_OFF={doff.get('finish_detect')}")
+fd, fd_off = d.get("finish_detect"), doff.get("finish_detect")
+if fd is None or fd_off is None or not fd < fd_off:
+    print(f"FAIL: finish_detect(emitter on)={fd} must be strictly below "
+          f"finish_detect(emitter off)={fd_off}")
+    sys.exit(1)
 # system observability (ISSUE 8): compile hygiene of the repeated-wave
 # serving phase (must stay 0 — precompile covers every serving-path
 # variant), the kv-pool high-water mark, MFU (honest 0 on CPU), and
