@@ -869,6 +869,166 @@ def bench_chaos(cfg, S, C, max_new=16, flood=12):
     return out
 
 
+def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
+    """Preemptive priority scheduler scenario (ISSUE 10), three phases:
+
+    1. preempt ON: a saturating ``low`` background (2*S long greedy
+       decodes) holds every slot, then a wave of ``high`` arrivals lands;
+       each high's TTFT is measured while the scheduler pauses low slots
+       to make room;
+    2. preempt OFF: the identical workload on a FIFO engine — the high
+       wave must wait for slots to drain, so the p50 TTFT ratio (off/on)
+       is the headline number (ISSUE 10 acceptance: >= 2x);
+    3. resume byte match: one controlled preempt/resume round with the
+       prefix cache off (resume = full re-prefill): the paused request's
+       pre-preemption prefix must match its solo greedy baseline and its
+       continuation must be bit-for-bit what a FRESH submission of
+       (prompt + emitted tokens) computes — the honest resume contract
+       (prefill-vs-decode kernel numerics make parity against an
+       uninterrupted run unguaranteeable; see engine._start_resume)."""
+    import threading
+
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.weights import random_params
+    from localai_tpu.services.eventlog import EVENTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(13)
+    plen = max(8, C // 8)
+    n_low = 2 * S
+    low_prompts = [rng.integers(0, 255, size=plen).tolist()
+                   for _ in range(n_low)]
+    high_prompts = [rng.integers(0, 255, size=plen).tolist()
+                    for _ in range(n_high)]
+
+    def make_req(ids, priority, max_new):
+        return eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=max_new, ignore_eos=True,
+            priority=priority,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+
+    def drain(o, first_ev=None):
+        ids, last = [], None
+        ev = first_ev
+        while True:
+            if ev is None:
+                ev = o.get()
+                if ev is None:
+                    break
+            last = ev
+            if ev.token_ids:
+                ids.extend(ev.token_ids)
+            elif ev.token_id >= 0:
+                ids.append(ev.token_id)
+            ev = None
+        return ids, last
+
+    def run_one(engine, ids, priority, max_new):
+        return drain(engine.submit(make_req(ids, priority, max_new)))
+
+    def wave(engine):
+        """Saturate with lows, then fire the high wave; returns the highs'
+        TTFTs and the lows' (ids, last-event) pairs."""
+        outs_low = [engine.submit(make_req(p, "low", low_new))
+                    for p in low_prompts]
+        t0 = time.monotonic()
+        while engine.num_active < S and time.monotonic() - t0 < 30:
+            time.sleep(0.005)
+        ttfts, lock = [], threading.Lock()
+
+        def one_high(i):
+            t1 = time.monotonic()
+            o = engine.submit(make_req(high_prompts[i], "high", high_new))
+            first = None
+            while True:
+                ev = o.get()
+                if ev is None:
+                    break
+                if first is None and (ev.token_ids or ev.token_id >= 0):
+                    first = time.monotonic() - t1
+            with lock:
+                ttfts.append(first if first is not None else float("inf"))
+
+        threads = [threading.Thread(target=one_high, args=(i,), daemon=True)
+                   for i in range(n_high)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        lows = [drain(o) for o in outs_low]
+        return ttfts, lows
+
+    out = {"n_low": n_low, "n_high": n_high,
+           "low_new": low_new, "high_new": high_new}
+    base_ecfg = dict(num_slots=S, max_context=C, prefill_buckets=(32, 128),
+                     cache_dtype=jnp.float32, max_queued_requests=64)
+
+    # ---- phase 1: preempt ON ----
+    engine = eng.Engine(cfg, params, _ByteTokenizer(),
+                        eng.EngineConfig(**base_ecfg),
+                        eos_token_ids={cfg.vocab_size - 1})
+    engine.start(precompile=True)
+    try:
+        ttft_on, lows_on = wave(engine)
+        sched = engine.metrics().get("scheduler") or {}
+    finally:
+        engine.shutdown()
+    out["p50_ttft_on_ms"] = round(float(np.percentile(ttft_on, 50)) * 1e3, 2)
+    out["preemptions"] = sched.get("preemptions", 0)
+    out["resumes"] = sched.get("resumes", 0)
+    out["low_complete"] = all(
+        len(ids) == low_new and (last is None or last.error is None)
+        for ids, last in lows_on)
+
+    # ---- phase 2: preempt OFF (FIFO) ----
+    engine = eng.Engine(cfg, params, _ByteTokenizer(),
+                        eng.EngineConfig(preempt=False, **base_ecfg),
+                        eos_token_ids={cfg.vocab_size - 1})
+    engine.start(precompile=True)
+    try:
+        ttft_off, _ = wave(engine)
+    finally:
+        engine.shutdown()
+    out["p50_ttft_off_ms"] = round(float(np.percentile(ttft_off, 50)) * 1e3, 2)
+    out["ttft_ratio"] = round(
+        out["p50_ttft_off_ms"] / max(1e-6, out["p50_ttft_on_ms"]), 2)
+
+    # ---- phase 3: resume ≡ fresh re-admission, bit for bit ----
+    ecfg_m = eng.EngineConfig(kv_prefix_cache=False, kv_offload=False,
+                              **{**base_ecfg, "num_slots": 1})
+    engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg_m,
+                        eos_token_ids={cfg.vocab_size - 1})
+    engine.start(precompile=True)
+    try:
+        mp = low_prompts[0]
+        base, _ = run_one(engine, mp, "low", low_new)
+        EVENTS.clear()
+        req_low = make_req(mp, "low", low_new)
+        o_low = engine.submit(req_low)
+        first = o_low.get()          # decode is under way
+        high_ids, high_last = run_one(engine, high_prompts[0], "high",
+                                      high_new)
+        low_ids, low_last = drain(o_low, first_ev=first)
+        pre = [ev for ev in EVENTS.events() if ev["event"] == "preempt"
+               and ev["rid"] == req_low.request_id]
+        out["match_preempted"] = bool(pre)
+        match = False
+        if pre and low_last is not None and low_last.error is None \
+                and high_last is not None and high_last.error is None:
+            k = int(pre[0]["n_decoded"])
+            ref, _ = run_one(engine, list(mp) + low_ids[:k], "low",
+                             low_new - k)
+            match = (0 < k < low_new and len(low_ids) == low_new
+                     and len(high_ids) == high_new
+                     and low_ids[:k] == base[:k] and low_ids[k:] == ref)
+        out["resume_byte_match"] = match
+    finally:
+        engine.shutdown()
+    return out
+
+
 def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
                     pressure=False):
     """Multi-turn shared-prefix scenario (PR 2 acceptance): N greedy
@@ -1296,6 +1456,61 @@ def _engine_direct_chaos(deadline: float, partial: dict) -> dict:
     return out
 
 
+def _engine_direct_priority(deadline: float, partial: dict) -> dict:
+    """The preemptive priority scheduler scenario (ISSUE 10) as a bench
+    phase: high-priority TTFT under a saturating low background, preempt
+    on vs off, plus the resume byte-match gate — engine-direct in a
+    subprocess on the CPU-safe smoke shape (LOCALAI_BENCH_PRIO_PRESET
+    to override)."""
+    import subprocess
+
+    pr_preset = os.environ.get("LOCALAI_BENCH_PRIO_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(pr_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": pr_preset,
+        "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_BENCH_DEADLINE_S": "0",
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--priority"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"ttft_ratio": r.get("ttft_ratio"),
+                       "p50_ttft_on_ms": r.get("p50_ttft_on_ms"),
+                       "p50_ttft_off_ms": r.get("p50_ttft_off_ms"),
+                       "preemptions": r.get("preemptions"),
+                       "resumes": r.get("resumes"),
+                       "low_complete": r.get("low_complete"),
+                       "resume_byte_match": r.get("resume_byte_match")}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"priority_{k}": v for k, v in out.items()})
+    _emit_phase("priority", out)
+    return out
+
+
 def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     """The PR-2 acceptance scenario as a default-bench phase: multi-turn
     conversations under slot churn, prefix cache on vs off, in one
@@ -1485,7 +1700,7 @@ def main():
 
     if ("--engine" in sys.argv or "--kernel" in sys.argv
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
-            or "--chaos" in sys.argv):
+            or "--chaos" in sys.argv or "--priority" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -1577,6 +1792,30 @@ def main():
             print(json.dumps({
                 "metric": f"chaos_{preset}", "value": 1 if ok else 0,
                 "unit": "ok", **r,
+            }))
+            return
+
+        if "--priority" in sys.argv:
+            # preemptive priority scheduler (ISSUE 10): f32 weights so
+            # the resume byte-match gate can compare the paused
+            # request's continuation against a fresh re-admission
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(96, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_priority(cfg, S, C)
+            ok = (r.get("ttft_ratio") is not None
+                  and r.get("ttft_ratio") >= 2.0
+                  and r.get("preemptions", 0) >= 1
+                  and r.get("low_complete") is True
+                  and r.get("resume_byte_match") is True)
+            print(json.dumps({
+                "metric": f"priority_{preset}",
+                "value": r.get("ttft_ratio"), "unit": "x high-prio TTFT",
+                "ok": 1 if ok else 0, **r,
             }))
             return
 
@@ -1692,6 +1931,7 @@ def main():
     multiturn = _engine_direct_multiturn(deadline, partial)
     offload_cmp = _engine_direct_offload(deadline, partial)
     chaos_cmp = _engine_direct_chaos(deadline, partial)
+    priority_cmp = _engine_direct_priority(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -1717,6 +1957,7 @@ def main():
                 "multiturn_prefix_cache": multiturn,
                 "kv_offload_pressure": offload_cmp,
                 "chaos": chaos_cmp,
+                "priority": priority_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -1829,6 +2070,7 @@ def main():
         "multiturn_prefix_cache": multiturn,
         "kv_offload_pressure": offload_cmp,
         "chaos": chaos_cmp,
+        "priority": priority_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

@@ -174,6 +174,13 @@ _LIFECYCLE_COUNTERS = (("requests_shed", "requests_shed_total"),
                        ("requests_timed_out", "requests_timed_out_total"),
                        ("stalls", "engine_stalls_total"),
                        ("stall_dumps", "stall_dumps_total"))
+# preemptive priority scheduler (ISSUE 10): preempt/resume totals +
+# per-class depth gauges, from engine metrics()["scheduler"]
+_SCHED_COUNTERS = (("preemptions", "preemptions_total"),
+                   ("resumes", "resume_restore_total"),
+                   ("resume_reprefills", "resume_restore_reprefills_total"),
+                   ("resume_restore_rows", "resume_restore_rows_total"),
+                   ("aged_promotions", "priority_aged_promotions_total"))
 # system observability (ISSUE 8): XLA compile tracking + memory
 # watermarks + goodput/MFU, from engine metrics()["sysobs"]
 _SYSOBS_COUNTERS = ("xla_compiles_total", "xla_compiles_after_warmup_total",
@@ -207,6 +214,8 @@ def _refresh_engine_metrics(state):
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
               *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS),
               *(m for _k, m in _LIFECYCLE_COUNTERS),
+              *(m for _k, m in _SCHED_COUNTERS),
+              "queue_depth_class", "resume_queue_depth",
               *_SYSOBS_COUNTERS, *_SYSOBS_GAUGES,
               *(f"mem_{k}" for k in _SYSOBS_WATERMARKS),
               "backend_respawns_total", "circuit_state"):
@@ -260,6 +269,19 @@ def _refresh_engine_metrics(state):
             for skey, mkey in _LIFECYCLE_COUNTERS:
                 METRICS.set_counter(mkey, lc.get(skey, 0),
                                     label_str(model=name))
+        # preemptive priority scheduler (ISSUE 10): preempt/resume
+        # totals + per-class queue depth (queued + parked-for-resume)
+        sch = stats.get("scheduler")
+        if sch and sch.get("preempt"):
+            for skey, mkey in _SCHED_COUNTERS:
+                METRICS.set_counter(mkey, sch.get(skey, 0),
+                                    label_str(model=name))
+            METRICS.set_gauge("resume_queue_depth",
+                              sch.get("resume_depth", 0),
+                              label_str(model=name))
+            for cls, n in (sch.get("queued_by_class") or {}).items():
+                METRICS.set_gauge("queue_depth_class", n,
+                                  label_str(model=name, priority=cls))
         # system observability (ISSUE 8): compile counters, memory
         # watermarks, goodput/MFU
         so = stats.get("sysobs")

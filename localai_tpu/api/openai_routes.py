@@ -62,7 +62,10 @@ def _overrides_from(body: dict) -> dict:
     o = {}
     for k in ("temperature", "top_k", "top_p", "min_p", "typical_p", "seed",
               "presence_penalty", "frequency_penalty", "repeat_penalty",
-              "logit_bias", "ignore_eos", "echo", "grammar"):
+              "logit_bias", "ignore_eos", "echo", "grammar",
+              # scheduling class (ISSUE 10): high|normal|low; unknown
+              # values degrade to the model default at the engine
+              "priority"):
         if k in body and body[k] is not None:
             o[k] = body[k]
     if body.get("max_tokens") or body.get("max_completion_tokens"):

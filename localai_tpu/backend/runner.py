@@ -374,6 +374,27 @@ class EngineServicer(BackendServicer):
             **({"event_log_max_mb": int(v)} if (v := str(
                 extra.get("event_log_max_mb", "")).strip()).isdigit()
                else {}),
+            # preemptive priority scheduler (ISSUE 10): preempt=0 restores
+            # strict-FIFO admission bit-for-bit; priority_weights is
+            # colon-separated (the options wire splits on commas);
+            # priority sets the model-wide default class
+            **({"preempt": False} if str(
+                extra.get("preempt", "")).strip().lower() in
+               ("0", "false", "off", "no") else {}),
+            **({"priority_weights": pw} if (pw := str(
+                extra.get("priority_weights", "") or "")) else {}),
+            **({"priority": pc} if (pc := str(
+                extra.get("priority", "") or "").strip().lower()) in
+               ("high", "normal", "low") else {}),
+            **({"max_preemptions": int(v)} if (v := str(
+                extra.get("max_preemptions", "")).strip()).isdigit()
+               else {}),
+            **({"resume_reserve_pages": int(v)} if (v := str(
+                extra.get("resume_reserve_pages", "")).strip()).isdigit()
+               else {}),
+            **({"priority_aging_ms": int(v)} if (v := str(
+                extra.get("priority_aging_ms", "")).strip()).isdigit()
+               else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -470,8 +491,20 @@ class EngineServicer(BackendServicer):
 
         return ids, positions, (np.stack(vectors) if vectors else None)
 
-    def _build_request(self, opts: pb.PredictOptions):
+    def _build_request(self, opts: pb.PredictOptions, context=None):
         from localai_tpu.engine.engine import GenRequest
+
+        # per-request priority class rides invocation metadata (the
+        # compiled descriptor cannot grow PredictOptions fields — same
+        # constraint as the localai-retry-after trailing metadata);
+        # empty -> the engine applies the model-default class. Guarded
+        # with getattr: in-process callers pass bare context fakes.
+        priority = ""
+        meta_fn = getattr(context, "invocation_metadata", None)
+        if meta_fn is not None:
+            for key, value in meta_fn() or ():
+                if key == "localai-priority":
+                    priority = str(value)
 
         # media parts the backend cannot consume are a loud error, never a
         # silent drop (VERDICT r4 #6): the HTTP layer 400s these first;
@@ -511,11 +544,12 @@ class EngineServicer(BackendServicer):
             prompt_cache_path=cache_path,
             prompt_cache_ro=opts.prompt_cache_ro,
             prompt_cache_all=opts.prompt_cache_all,
+            priority=priority,
         )
 
     def Predict(self, request: pb.PredictOptions, context) -> pb.Reply:
         self._require_ready(context)
-        req = self._build_request(request)
+        req = self._build_request(request, context)
         text, events = self.engine.generate_text(req)
         last = events[-1] if events else None
         if last is not None and last.error:
@@ -533,7 +567,7 @@ class EngineServicer(BackendServicer):
 
     def PredictStream(self, request: pb.PredictOptions, context):
         self._require_ready(context)
-        req = self._build_request(request)
+        req = self._build_request(request, context)
         out = self.engine.submit(req)
         while True:
             ev = out.get()

@@ -238,13 +238,20 @@ class BackendClient:
     def load_model(self, opts: pb.ModelOptions, timeout: float = 900.0) -> pb.Result:
         return self._stubs["LoadModel"](opts, timeout=timeout)
 
-    def predict(self, opts: pb.PredictOptions, timeout: float = 600.0) -> pb.Reply:
+    def predict(self, opts: pb.PredictOptions, timeout: float = 600.0,
+                metadata=None) -> pb.Reply:
+        # per-request scheduling hints (e.g. ("localai-priority", "high"))
+        # ride invocation metadata: the compiled descriptor cannot grow
+        # PredictOptions fields (ISSUE 10)
         with self._maybe_locked():
-            return self._stubs["Predict"](opts, timeout=timeout)
+            return self._stubs["Predict"](opts, timeout=timeout,
+                                          metadata=metadata)
 
-    def predict_stream(self, opts: pb.PredictOptions, timeout: float = 600.0) -> Iterator[pb.Reply]:
+    def predict_stream(self, opts: pb.PredictOptions, timeout: float = 600.0,
+                       metadata=None) -> Iterator[pb.Reply]:
         with self._maybe_locked():
-            yield from self._stubs["PredictStream"](opts, timeout=timeout)
+            yield from self._stubs["PredictStream"](opts, timeout=timeout,
+                                                    metadata=metadata)
 
     def embedding(self, opts: pb.PredictOptions, timeout: float = 120.0) -> pb.EmbeddingResult:
         return self._retry_unary("Embedding", opts, timeout)

@@ -95,6 +95,17 @@ def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict
     return opts
 
 
+def predict_metadata(overrides: Optional[dict]) -> Optional[tuple]:
+    """gRPC invocation metadata for per-request scheduling hints
+    (ISSUE 10): the compiled descriptor cannot grow PredictOptions
+    fields, so the priority class rides ``localai-priority`` metadata
+    (same constraint as the retry-after trailing metadata)."""
+    pr = (overrides or {}).get("priority")
+    if pr:
+        return (("localai-priority", str(pr).strip().lower()),)
+    return None
+
+
 def finetune_response(mc: ModelConfig, prediction: str, prompt: str = "",
                       echo: bool = False) -> str:
     """Post-process model output (reference: Finetune, llm.go:179-227)."""
@@ -162,9 +173,10 @@ class Capabilities:
         """Streaming inference (reference: ModelInference llm.go:35-174)."""
         lm = self._load(mc)
         popts = build_predict_options(mc, prompt, overrides, correlation_id)
+        md = predict_metadata(overrides)
         lm.mark_busy()
         try:
-            for reply in lm.client.predict_stream(popts):
+            for reply in lm.client.predict_stream(popts, metadata=md):
                 yield TokenChunk(
                     text=reply.message.decode("utf-8", errors="replace"),
                     token_id=reply.token_id,
@@ -187,9 +199,10 @@ class Capabilities:
                   correlation_id: str = "") -> TokenChunk:
         lm = self._load(mc)
         popts = build_predict_options(mc, prompt, overrides, correlation_id)
+        md = predict_metadata(overrides)
         lm.mark_busy()
         try:
-            reply = lm.client.predict(popts)
+            reply = lm.client.predict(popts, metadata=md)
         except Exception as e:
             raise wrap_backend_error(e, mc.name) from e
         finally:

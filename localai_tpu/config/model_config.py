@@ -246,7 +246,12 @@ class ModelConfig:
                        "request_timeout_ms",
                        "dispatch_stall_ms",
                        # event-log rotation bound (ISSUE 9); 0 disables
-                       "event_log_max_mb") and not v.isdigit():
+                       "event_log_max_mb",
+                       # priority scheduler (ISSUE 10); 0 disables the
+                       # respective guard (aging / reserve / preemption cap)
+                       "max_preemptions",
+                       "resume_reserve_pages",
+                       "priority_aging_ms") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
@@ -254,9 +259,24 @@ class ModelConfig:
                        "prefill_packed", "trace",
                        # dedicated emission worker (ISSUE 9); 0 restores
                        # the in-loop path
-                       "emitter") and v.lower() not in bool_vals:
+                       "emitter",
+                       # preemptive scheduler (ISSUE 10); 0 restores
+                       # strict-FIFO admission bit-for-bit
+                       "preempt") and v.lower() not in bool_vals:
                 problems.append(
                     f"{k} must be one of {bool_vals}, got {v!r}")
+            elif k == "priority" and v.lower() not in ("high", "normal",
+                                                       "low"):
+                problems.append(
+                    f"priority must be high|normal|low, got {v!r}")
+            elif k == "priority_weights":
+                try:
+                    from localai_tpu.engine.scheduler import (
+                        parse_priority_weights)
+
+                    parse_priority_weights(v)
+                except ValueError as e:
+                    problems.append(str(e))
             elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):
                 problems.append(
                     f"prefill_packed_fuse must be auto|0|1, got {v!r}")

@@ -256,18 +256,39 @@ class EmitterWorker:
 
     def _process_batch(self, entries):
         t0 = time.monotonic()
-        flush_s = 0.0
+        # compute pass first: detok + stop-scan + event assembly, no
+        # queue traffic. Finished flags flip HERE, so later entries for
+        # an already-finished snap in the same batch still short-circuit
+        # exactly as the interleaved per-entry path did.
+        writes = []
+        notes = []
         for e in entries:
-            flush_s += self._emit_entry(e)
+            out, evs, note = self._build_entry(e)
+            if evs:
+                writes.append((out, evs))
+            if note is not None:
+                notes.append(note)
+        # then ONE writer pass per drained batch (ISSUE 10, closes the
+        # PR-9 follow-up): with preemption making multi-slot finals in
+        # one tick common, the puts go out back-to-back instead of
+        # interleaving with per-slot detok work
+        tput = time.monotonic()
+        for out, evs in writes:
+            for ev in evs:
+                out.put(ev)
+        # engine feedback after the streams are closed (same order the
+        # per-entry path produced: put, put None, then note_finish)
+        for slot, snap, ndec, timings in notes:
+            self._note_finish(slot, snap, ndec, timings)
         t1 = time.monotonic()
         tr = self._tracer
         if tr.enabled:
             # same emit-vs-flush split as the in-loop spans, recorded
             # under the _bg names so the decomposition keeps this thread's
             # walltime out of host_loop (it overlaps the engine loop)
-            tr.record("emit_bg", "emitter", t0, t1 - flush_s,
+            tr.record("emit_bg", "emitter", t0, tput,
                       args={"entries": len(entries)})
-            tr.record("stream_flush_bg", "emitter", t1 - flush_s, t1)
+            tr.record("stream_flush_bg", "emitter", tput, t1)
 
     def _timings(self, snap, ndec):
         """Final-event timings for an emitter-detected stop (the engine
@@ -288,15 +309,18 @@ class EmitterWorker:
                 (ndec - 1) / dt if dt > 0 and ndec > 1 else 0.0,
         }
 
-    def _emit_entry(self, e) -> float:
-        """Detok + stop-scan + put one entry's tokens; returns the
-        seconds spent inside queue puts (for the span split)."""
+    def _build_entry(self, e):
+        """Detok + stop-scan + event assembly for one entry, NO queue
+        traffic: returns ``(out_queue, events, note)``. ``events`` may
+        end in the None stream-close sentinel; ``note`` is the
+        ``(slot, snap, ndec, timings)`` engine feedback for an
+        emitter-DETECTED stop (the engine does not know yet — it must
+        release the slot and drop tokens decoded past the stop)."""
         snap = e["snap"]
         slot = e["slot"]
         st = self._state(slot, snap)
         if st[1]:
-            return 0.0
-        out = snap.req.out
+            return None, (), None
         toks = e["tokens"]
         finish = e["finish"]
         evs = []
@@ -330,19 +354,17 @@ class EmitterWorker:
             if fin is not None:
                 st[1] = True
                 ev.timings = e["timings"] if timings is None else timings
-                tput = time.monotonic()
+                final = []
                 if evs:
-                    out.put(evs[0] if len(evs) == 1 else self._merge(evs))
-                out.put(ev)
-                out.put(None)
-                if timings is not None:
-                    # emitter-detected stop: the engine does not know yet
-                    # — feed the finish back so it releases the slot and
-                    # drops any tokens decoded past the stop
-                    self._note_finish(slot, snap, ndec, timings)
-                return time.monotonic() - tput
+                    final.append(evs[0] if len(evs) == 1
+                                 else self._merge(evs))
+                final.append(ev)
+                final.append(None)
+                note = (slot, snap, ndec, timings) \
+                    if timings is not None else None
+                return snap.req.out, final, note
             evs.append(ev)
-        tput = time.monotonic()
         if evs:
-            out.put(evs[0] if len(evs) == 1 else self._merge(evs))
-        return time.monotonic() - tput
+            return snap.req.out, \
+                [evs[0] if len(evs) == 1 else self._merge(evs)], None
+        return None, (), None

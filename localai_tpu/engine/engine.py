@@ -55,6 +55,9 @@ import numpy as np
 
 from localai_tpu.engine import sampling
 from localai_tpu.engine.detok import IncrementalDetokenizer
+from localai_tpu.engine.scheduler import (
+    PRIORITY_CLASSES, PRIORITY_RANK, ResumeEntry, Scheduler,
+    normalize_priority, parse_priority_weights)
 from localai_tpu.services import sysobs
 from localai_tpu.services.eventlog import EVENTS
 from localai_tpu.services.faults import FAULTS
@@ -233,6 +236,34 @@ class EngineConfig:
     # event-log file-sink rotation bound (MB): at this size the file
     # rotates to <path>.1, one generation kept. 0 disables rotation.
     event_log_max_mb: int = 64
+    # --- preemptive priority scheduler (ISSUE 10, engine/scheduler.py) ---
+    # pause/offload/resume: a higher-priority request that cannot be
+    # admitted PREEMPTS the lowest-class active slot — the victim pauses
+    # at a burst boundary, its committed pages stay retained (offloading
+    # host-side under pool pressure through the normal reclaim path),
+    # and resume is plain re-admission through the prefix-splice /
+    # host-restore tiers (a killed host entry degrades to a
+    # byte-identical re-prefill). Also enables priority-ordered
+    # admission, DRR prefill shares and shed fairness. 0 restores
+    # strict-FIFO admission bit-for-bit.
+    preempt: bool = True
+    # deficit-round-robin weights for the high:normal:low classes'
+    # shares of the packed-prefill token budget (colon-separated —
+    # option values ride a comma-joined wire, so no commas)
+    priority_weights: str = "4:2:1"
+    # starvation guard: one request is never preempted more than this
+    # many times; after that it is immune and runs to completion
+    max_preemptions: int = 2
+    # free pages held back from FRESH admissions while preempted
+    # requests wait to resume (resumes themselves ignore the reserve,
+    # so a resume can always make progress). 0 disables.
+    resume_reserve_pages: int = 0
+    # model-default priority class for requests that don't carry one
+    # ("high" | "normal" | "low")
+    priority: str = "normal"
+    # starvation aging: queued/parked work older than this is treated
+    # one class higher when ordering admissions. 0 disables.
+    priority_aging_ms: int = 4000
 
 
 @dataclasses.dataclass
@@ -256,6 +287,10 @@ class GenRequest:
     mm_positions: list = dataclasses.field(default_factory=list)  # [P] ints
     mm_vectors: Any = None          # np [P, hidden] float32
     request_id: str = ""
+    # priority class ("high" | "normal" | "low"); "" = the model default
+    # (EngineConfig.priority). Normalized by Engine.submit — unknown
+    # values degrade to the default, never an error (ISSUE 10).
+    priority: str = ""
     # filled by engine:
     out: "queue.Queue" = None  # receives StreamEvent, then None sentinel
     t_submit: float = 0.0      # stamped by Engine.submit (TTFT decomposition)
@@ -418,7 +453,7 @@ class _Slot:
         "t_start", "t_first_token", "n_decoded", "t_prefill_ms",
         "grammar", "gstate", "bias_base", "cur_penalty",
         "phase", "pending", "written", "reused", "cache_len", "committed",
-        "mm_pos", "mm_vec", "spec_ok", "ga_blocks",
+        "mm_pos", "mm_vec", "spec_ok", "ga_blocks", "prio", "preempts",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -445,6 +480,10 @@ class _Slot:
         self.cache_len = 0      # rows occupied in the slot's KV cache
         self.committed = 0      # rows whose KV write has actually executed
         self.ga_blocks = 0      # self-extend: position blocks compressed
+        # priority scheduling (ISSUE 10): class rank (0 = high) and how
+        # many times this REQUEST has been preempted (survives resume)
+        self.prio = PRIORITY_RANK.get(req.priority, 1)
+        self.preempts = 0
 
 
 class Engine:
@@ -790,6 +829,18 @@ class Engine:
         self._ov_pool_idx = 0
         self._seg_pools: dict = {}   # bucket -> round-robin list of arrays
         self._seg_pool_idx: dict = {}
+        # --- preemptive priority scheduler (ISSUE 10) ---
+        # the scheduler owns the per-tick run decision: aged-rank
+        # admission ordering, DRR prefill-budget shares, preemption
+        # victim selection and the resume queue. preempt=0 leaves it
+        # unbuilt and every path below falls back to strict FIFO.
+        self._default_prio = normalize_priority(self.ecfg.priority)
+        self._sched = None
+        if self.ecfg.preempt:
+            self._sched = Scheduler(
+                parse_priority_weights(self.ecfg.priority_weights),
+                max_preemptions=self.ecfg.max_preemptions,
+                aging_ms=float(self.ecfg.priority_aging_ms))
 
     def _sync_worker(self):
         """ALL device->host syncs run here, one at a time, in dispatch
@@ -1009,6 +1060,24 @@ class Engine:
         except PoolExhausted:
             pass
         self._reclaim_pages(slot, self._pool.pages_for(rows))
+        if self._sched is not None:
+            try:
+                self._pool.ensure(slot, rows)
+                return
+            except PoolExhausted:
+                pass
+            # pool-pressure preemption (closes the PR-3 "offload ACTIVE
+            # slots under extreme pressure" follow-up): pause a
+            # strictly-lower-priority DECODE slot — decode-only because
+            # this runs mid-prefill-pack, where a prefill-phase victim
+            # could be a seg of the pack being built — then reclaim
+            # again so its now-retained pages evict/offload
+            me = self.slots[slot]
+            my_rank = me.prio if me is not None else PRIORITY_RANK["high"]
+            victim = self._pick_victim(my_rank, decode_only=True)
+            if victim is not None and victim != slot:
+                self._preempt_slot(victim, why="pool_pressure")
+                self._reclaim_pages(slot, self._pool.pages_for(rows))
         self._pool.ensure(slot, rows)   # raises PoolExhausted if truly full
 
     def _alloc_detached(self, slot=-1) -> int:
@@ -1964,14 +2033,40 @@ class Engine:
 
     def submit(self, req: GenRequest) -> "queue.Queue":
         req.t_submit = time.monotonic()
+        req.priority = normalize_priority(req.priority, self._default_prio)
         # admission control (ISSUE 7): shed at the door instead of queuing
         # unboundedly — the caller gets a structured "shed" event on the
         # normal output queue within microseconds, not a growing sojourn.
         maxq = self.ecfg.max_queued_requests
         if maxq > 0 and self._queue.qsize() >= maxq:
-            self._shed(req, f"server overloaded: {maxq} requests already "
-                            f"queued (max_queued_requests)")
-            return req.out
+            # queue-wait-aware shed fairness (ISSUE 10, closes the PR-7
+            # follow-up): a full queue sheds the longest-queued request
+            # of the lowest class STRICTLY below the newcomer's — a
+            # flood of equals still refuses the arrival (the PR-7
+            # contract), but background traffic can no longer crowd
+            # interactive work out of the queue. The victim gets the
+            # same structured shed event / 429 shape it always did.
+            victim = None
+            if self._sched is not None:
+                with self._queue.mutex:
+                    queued = [(r.priority, r.t_submit, r)
+                              for r in self._queue.queue]
+                victim = self._sched.pick_shed_victim(
+                    PRIORITY_RANK[req.priority], queued)
+                if victim is not None:
+                    with self._queue.mutex:
+                        try:
+                            self._queue.queue.remove(victim)
+                        except ValueError:
+                            victim = None   # raced with admission
+            if victim is None:
+                self._shed(req, f"server overloaded: {maxq} requests "
+                                f"already queued (max_queued_requests)")
+                return req.out
+            self._shed(victim,
+                       f"displaced by a {req.priority}-priority arrival "
+                       f"(queue full at {maxq}; longest-queued "
+                       f"{victim.priority} request shed)")
         if self.ecfg.request_timeout_ms > 0:
             req.deadline = req.t_submit + self.ecfg.request_timeout_ms / 1e3
         self._queue.put(req)
@@ -2124,6 +2219,30 @@ class Engine:
         if self._paged:
             sys_obs["fragmentation"] = self._pool.fragmentation()
         out["sysobs"] = sys_obs
+        # preemptive priority scheduler (ISSUE 10): DRR counters, resume
+        # queue depth, per-class queue/active gauges + effective knobs
+        if self._sched is not None:
+            sch = self._sched.stats()
+            sch["preempt"] = True
+            sch["max_preemptions"] = self.ecfg.max_preemptions
+            sch["resume_reserve_pages"] = self.ecfg.resume_reserve_pages
+            queued_by = {c: 0 for c in PRIORITY_CLASSES}
+            with self._queue.mutex:
+                for req in self._queue.queue:
+                    queued_by[normalize_priority(
+                        req.priority, self._default_prio)] += 1
+            active_by = {c: 0 for c in PRIORITY_CLASSES}
+            for s in active:
+                active_by[PRIORITY_CLASSES[s.prio]] += 1
+            resume_by = {c: 0 for c in PRIORITY_CLASSES}
+            for c in self._sched.resume_priorities():
+                resume_by[c] += 1
+            sch["queued_by_class"] = queued_by
+            sch["active_by_class"] = active_by
+            sch["resume_by_class"] = resume_by
+            out["scheduler"] = sch
+        else:
+            out["scheduler"] = {"preempt": False}
         # per-histogram exemplars: worst observation since the last pull
         # (consumed — each scrape sees that interval's worst span)
         worst, self._hist_worst = self._hist_worst, {}
@@ -2440,28 +2559,39 @@ class Engine:
                 # device state (survivors keep serving).
                 self._handle_stall(st.item)
             except Exception as e:  # never let the loop die: fail active requests
-                log.exception("engine step failed")
-                for i, s in enumerate(self.slots):
-                    if s is not None:
-                        ev = StreamEvent(
-                            token_id=-1, text="", logprob=0.0,
-                            finish_reason="stop", error=f"{type(e).__name__}: {e}",
-                        )
-                        if self._emitter is not None:
-                            # FIFO with any still-queued tokens (ISSUE 9)
-                            self._emitter.push_final(i, s, [ev, None])
-                        else:
-                            s.req.out.put(ev)
-                            s.req.out.put(None)
-                        self._release_slot(i)
-                # a failure inside a donated jitted call leaves ck/cv/ring/
-                # keys pointing at deleted buffers — reinitialize device state
-                # so the engine survives instead of erroring forever
-                try:
-                    self._reset_device_state()
-                except Exception:
-                    log.exception("device state reset failed; engine unusable")
-                    self._stop = True
+                self._recover_step_failure(e)
+
+    def _recover_step_failure(self, e: Exception):
+        """Generic step-failure recovery: fail every active request with a
+        structured error and reinitialize device state so the engine
+        survives instead of erroring forever. Factored out of _run so the
+        chaos suite can drive the exact production recovery path against
+        a manually-ticked engine."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        log.exception("engine step failed")
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                ev = StreamEvent(
+                    token_id=-1, text="", logprob=0.0,
+                    finish_reason="stop", error=f"{type(e).__name__}: {e}",
+                )
+                if self._emitter is not None:
+                    # FIFO with any still-queued tokens (ISSUE 9)
+                    self._emitter.push_final(i, s, [ev, None])
+                else:
+                    s.req.out.put(ev)
+                    s.req.out.put(None)
+                self._release_slot(i)
+        # a failure inside a donated jitted call leaves ck/cv/ring/
+        # keys pointing at deleted buffers — reinitialize device state
+        # so the engine survives instead of erroring forever
+        try:
+            self._reset_device_state()
+        except Exception:
+            log.exception("device state reset failed; engine unusable")
+            self._stop = True
 
     def _admission_ready(self) -> bool:
         """Admit the moment a slot is free: fused admission (prefill +
@@ -2474,6 +2604,8 @@ class Engine:
     def _admit(self) -> bool:
         self._reap_expired()
         self._reap_cancelled()
+        if self._sched is not None:
+            return self._admit_sched()
         if not self._admission_ready():
             return False
         admitted = False
@@ -2488,40 +2620,265 @@ class Engine:
         # (VERDICT r2 #5 — true shared-prefix for n>1)
         leaders: dict = {}
         for req in batch:
-            if req.request_id in self._cancelled:
-                self._cancelled.discard(req.request_id)
-                req.out.put(None)
-                continue
-            key = None
-            # fork-dedup shares KV rows verbatim; under self-extend those
-            # rows are position-compressed state the sibling's own ga
-            # bookkeeping would re-compress, and in lockstep mode the fork
-            # op is not in the descriptor set — mutually exclusive
-            if not req.grammar and req.mm_vectors is None \
-                    and self.ecfg.ga_n <= 1 and self._bus is None \
-                    and self._fam_llama:
-                # truncation depends on max_new_tokens; bucket it into the key
-                key = (tuple(req.prompt_ids),
-                       min(req.max_new_tokens, self.ecfg.max_context // 4))
-            try:
-                if key is not None and key in leaders:
-                    lslot, lsnap, lids = leaders[key]
-                    self._start_fork_sibling(req, lslot, lsnap, lids)
-                else:
-                    slot, ids, snap = self._start_request(req)
-                    if key is not None and snap.mm_pos is None:
-                        leaders[key] = (slot, snap, ids)
+            if self._admit_one(req, leaders):
                 admitted = True
-            except Exception as e:
-                import logging
-
-                logging.getLogger(__name__).exception("admission failed")
-                req.out.put(StreamEvent(
-                    token_id=-1, text="", logprob=0.0, finish_reason="stop",
-                    error=f"{type(e).__name__}: {e}",
-                ))
-                req.out.put(None)
         return admitted
+
+    def _admit_one(self, req: GenRequest, leaders: dict) -> bool:
+        """Admit one popped request (shared by the FIFO and scheduler
+        paths): cancellation check, fork-dedup leader/sibling logic, and
+        failure containment. Returns True when a slot was started."""
+        if req.request_id in self._cancelled:
+            self._cancelled.discard(req.request_id)
+            req.out.put(None)
+            return False
+        key = None
+        # fork-dedup shares KV rows verbatim; under self-extend those
+        # rows are position-compressed state the sibling's own ga
+        # bookkeeping would re-compress, and in lockstep mode the fork
+        # op is not in the descriptor set — mutually exclusive
+        if not req.grammar and req.mm_vectors is None \
+                and self.ecfg.ga_n <= 1 and self._bus is None \
+                and self._fam_llama:
+            # truncation depends on max_new_tokens; bucket it into the key
+            key = (tuple(req.prompt_ids),
+                   min(req.max_new_tokens, self.ecfg.max_context // 4))
+        try:
+            if key is not None and key in leaders:
+                lslot, lsnap, lids = leaders[key]
+                self._start_fork_sibling(req, lslot, lsnap, lids)
+            else:
+                slot, ids, snap = self._start_request(req)
+                if key is not None and snap.mm_pos is None:
+                    leaders[key] = (slot, snap, ids)
+            return True
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).exception("admission failed")
+            req.out.put(StreamEvent(
+                token_id=-1, text="", logprob=0.0, finish_reason="stop",
+                error=f"{type(e).__name__}: {e}",
+            ))
+            req.out.put(None)
+            return False
+
+    def _pop_queued(self, req: GenRequest) -> bool:
+        """Remove a specific request from the admission queue (scheduler
+        path: ordered pops instead of FIFO gets). False when a reaper or
+        shed-displacement raced us to it."""
+        with self._queue.mutex:
+            try:
+                self._queue.queue.remove(req)
+                return True
+            except ValueError:
+                return False
+
+    def _admit_sched(self) -> bool:
+        """Priority admission (ISSUE 10): pop queued work in aged-rank
+        order (stable FIFO within a class, so single-class traffic
+        admits exactly like the FIFO path), merge the resume queue in by
+        effective class, hold ``resume_reserve_pages`` back from fresh
+        admissions while preempted work waits, and — when the best
+        waiting request strictly outranks an active slot and no slot is
+        free — preempt the victim and admit into its slot."""
+        sched = self._sched
+        if self._queue.empty() and sched.resume_depth == 0:
+            return False
+        admitted = False
+        leaders: dict = {}
+        reserve = self.ecfg.resume_reserve_pages
+        # hard bound on the work loop: every iteration either admits,
+        # preempts (at most num_slots times), or breaks
+        guard = 2 * self.ecfg.num_slots + 8
+        while guard > 0:
+            guard -= 1
+            now = time.monotonic()
+            with self._queue.mutex:
+                entries = [(r.priority, r.t_submit, r)
+                           for r in self._queue.queue]
+            cand = sched.order_queued(entries) if entries else []
+            head = None
+            while cand:
+                r = cand[0]
+                if r.request_id not in self._cancelled:
+                    head = r
+                    break
+                # cancelled while queued: close the stream and move on
+                cand.pop(0)
+                if self._pop_queued(r):
+                    self._cancelled.discard(r.request_id)
+                    r.out.put(None)
+            res = sched.peek_resume()
+            if head is None and res is None:
+                break
+            head_rank = sched.effective_rank(
+                head.priority, now - head.t_submit) if head is not None \
+                else len(PRIORITY_CLASSES)
+            res_rank = sched.effective_rank(
+                res.priority, now - res.t_parked) if res is not None \
+                else len(PRIORITY_CLASSES)
+            # parked work already paid its queue wait once — on rank
+            # ties it resumes before a fresh admission
+            use_resume = res is not None and res_rank <= head_rank
+            rank = res_rank if use_resume else head_rank
+            if self._free_count() == 0:
+                victim = self._pick_victim(rank)
+                if victim is None:
+                    break
+                self._preempt_slot(victim, why="priority")
+                continue   # the freed slot admits on the next pass
+            if use_resume:
+                entry = sched.pop_resume()
+                try:
+                    self._start_resume(entry)
+                    admitted = True
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "resume admission failed; request re-parked")
+                    sched.requeue_front(entry)
+                    break
+            else:
+                if reserve > 0 and self._paged and sched.resume_depth > 0 \
+                        and self._pool.free_pages <= reserve:
+                    # fresh work would eat the pages a parked resume
+                    # needs — only resumes may pass until pressure lifts
+                    break
+                if not self._pop_queued(head):
+                    continue   # raced with a reaper / shed displacement
+                if self._admit_one(head, leaders):
+                    admitted = True
+        return admitted
+
+    def _preempt_eligible(self, slot: int, s: "_Slot") -> bool:
+        """Pausable slots only: pause/resume round-trips through token
+        re-admission, so anything whose slot state is NOT reconstructible
+        from tokens is excluded — grammar automata (mid-generation state),
+        multimodal rows (image embeddings, not tokens), draft-mirrored
+        spec slots (the draft cache has no restore path), prompt-cache
+        requests (their save path assumes one continuous tenancy), and
+        fork leaders with waiters still attached."""
+        return (s.grammar is None and s.mm_pos is None
+                and not s.spec_ok
+                and not s.req.prompt_cache_path
+                and s.phase in ("prefill", "decode")
+                and slot not in self._fork_waiters
+                and s.req.request_id not in self._cancelled)
+
+    def _pick_victim(self, incoming_rank: int,
+                     decode_only: bool = False) -> Optional[int]:
+        """Engine-side victim scan feeding Scheduler.pick_victim: only
+        paged layouts can pause (committed pages retain/offload; the
+        contiguous fallbacks would forfeit all progress), and only
+        eligible slots are offered. ``decode_only`` restricts to
+        decode-phase slots — required when called mid-prefill-pack, where
+        a prefill-phase victim could be part of the pack being built."""
+        if not self._paged or self._sched is None:
+            return None
+        cands = []
+        for i, s in enumerate(self.slots):
+            if s is None or not self._preempt_eligible(i, s):
+                continue
+            if decode_only and s.phase != "decode":
+                continue
+            cands.append((i, PRIORITY_CLASSES[s.prio], s.t_start,
+                          s.preempts))
+        return self._sched.pick_victim(incoming_rank, cands)
+
+    def _preempt_slot(self, slot: int, why: str = "priority") -> bool:
+        """Pause an active slot at a burst boundary and park its request
+        for resume (ISSUE 10). Committed pages are RETAINED through the
+        prefix cache exactly like a release/context-shift — under
+        continued pool pressure they offload host-side through the
+        normal reclaim path — so resume is plain re-admission: the
+        chained-hash splice (device or host tier) restores the KV, and a
+        killed host entry degrades to a full re-prefill of the identical
+        token history.
+        Invalidation mirrors _context_shift: tokens already emitted are
+        kept; tokens still in flight for the slot are dropped from their
+        bursts (the resume re-computes from the last kept token)."""
+        s = self.slots[slot]
+        if s is None:
+            return False
+        t0 = time.monotonic()
+        hist = list(self._cache_tokens[slot])   # prompt + emitted tokens
+        committed = min(s.committed, len(hist))
+        if self._paged:
+            # retention FIRST (slot references still pin the pages), then
+            # the whole table returns to the pool for the displacing
+            # request — the retained chain survives as cache holds
+            if self._pcache is not None:
+                self._pcache.insert(self._pool, slot, hist[:committed])
+            self._pool.release(slot, 0)
+        entry = ResumeEntry(
+            req=s.req, ids=hist, priority=s.req.priority,
+            generated=list(s.generated), n_decoded=s.n_decoded,
+            prompt_len=s.prompt_len, detok=s.detok,
+            held_text=s.held_text, t_start=s.t_start,
+            t_first_token=s.t_first_token or None,
+            t_prefill_ms=s.t_prefill_ms, mu=float(self.mu[slot]),
+            preempt_count=s.preempts + 1)
+        self._sched.park(entry)
+        self.slots[slot] = None
+        self.active_dev[slot] = False
+        self.lengths[slot] = 0
+        # the table is empty now — advertising the old prefix to
+        # _pick_slot would promise rows the pool no longer maps
+        self._cache_tokens[slot] = []
+        try:
+            self._prefill_queue.remove(slot)
+        except ValueError:
+            pass
+        # burst boundary: in-flight tokens for this slot are conditioned
+        # on state the next tenant overwrites — drop them (same rule as
+        # _context_shift / emitter-detected stops)
+        for b in self._fifo:
+            if isinstance(b, _Burst):
+                b.skip_slots.add(slot)
+        with self._lc_lock:
+            self._lc["preemptions"] = self._lc.get("preemptions", 0) + 1
+        EVENTS.emit("preempt", rid=s.req.request_id, slot=slot, why=why,
+                    priority=s.req.priority, n_decoded=s.n_decoded,
+                    retained_rows=committed)
+        if self.tracer.enabled:
+            self.tracer.record("preempt", f"slot{slot}", t0,
+                               time.monotonic(), rid=s.req.request_id,
+                               args={"why": why,
+                                     "retained_rows": committed})
+        return True
+
+    def _start_resume(self, entry: "ResumeEntry"):
+        """Re-admit a preempted request (ISSUE 10). Admission IS the
+        resume path: the full token history (prompt + emitted tokens)
+        goes back through _start_request, whose reuse tiers splice the
+        retained device chain, restore offloaded pages, or — when the
+        host entry was evicted or failed its CRC — fall back to a full
+        re-prefill. Either way the continuation is conditioned on the
+        identical token history, byte-for-byte what a fresh submission
+        of (prompt + emitted tokens) would compute; streaming state
+        (detokenizer, held text, counts, timings) carries over so the
+        client sees one uninterrupted stream."""
+        sched = self._sched
+        req = entry.req
+        req.prompt_ids = list(entry.ids)
+        t0 = time.monotonic()
+        slot, ids, s = self._start_request(req, resume=entry)
+        sched.resumes += 1
+        sched.resume_restore_rows += s.reused
+        if s.reused == 0:
+            sched.resume_reprefills += 1
+        EVENTS.emit("resume", rid=req.request_id, slot=slot,
+                    priority=req.priority, reused_rows=s.reused,
+                    reprefill_rows=len(ids) - s.reused,
+                    parked_ms=round((t0 - entry.t_parked) * 1e3, 1))
+        if self.tracer.enabled:
+            self.tracer.record("resume", f"slot{slot}", t0,
+                               time.monotonic(), rid=req.request_id,
+                               args={"reused_rows": s.reused,
+                                     "reprefill_rows": len(ids) - s.reused})
+        return slot
 
     def _free_count(self) -> int:
         return sum(1 for s in self.slots if s is None)
@@ -2672,26 +3029,42 @@ class Engine:
             self._release_slot(i)
             self._process_fork_waiters(i)
 
-    def _start_request(self, req: GenRequest):
+    def _start_request(self, req: GenRequest, resume=None):
         """Admit a request: install sampling state and queue its prompt for
-        chunked prefill. No model compute happens here."""
+        chunked prefill. No model compute happens here.
+
+        With ``resume`` (a ResumeEntry) this doubles as the preemption
+        restore path: ``req.prompt_ids`` already holds the full processed
+        history (original prompt + emitted tokens), so head truncation is
+        skipped — the history was truncated at first admission and stays
+        < C-1 by the context-shift invariant — and the streaming state
+        (detokenizer, counts, timings) is grafted onto the fresh slot so
+        the client sees one uninterrupted stream."""
         if self._bus is not None and req.mm_vectors is not None:
             raise ValueError(
                 "multimodal injection is not supported in multi-host "
                 "lockstep mode")
         t_adm = time.monotonic()
-        EVENTS.emit("admit", rid=req.request_id,
-                    prompt_tokens=len(req.prompt_ids),
-                    queued=self._queue.qsize())
+        if resume is None:
+            EVENTS.emit("admit", rid=req.request_id,
+                        prompt_tokens=len(req.prompt_ids),
+                        queued=self._queue.qsize())
         C = self.ecfg.max_context
         ids = list(req.prompt_ids)
-        # truncate the prompt head, keeping the tail (reference semantics:
-        # grpc-server.cpp prompt truncation keeps the last part of the prompt)
-        max_prompt = C - 1 - min(req.max_new_tokens, C // 4)
         shift = 0
-        if len(ids) > max_prompt:
-            shift = len(ids) - max_prompt
-            ids = ids[-max_prompt:]
+        if resume is not None:
+            # safety clamp only: keep the tail if the history somehow
+            # reached the context edge (the shift path should prevent it)
+            if len(ids) > C - 1:
+                shift = len(ids) - (C - 1)
+                ids = ids[-(C - 1):]
+        else:
+            # truncate the prompt head, keeping the tail (reference
+            # semantics: grpc-server.cpp truncation keeps the prompt tail)
+            max_prompt = C - 1 - min(req.max_new_tokens, C // 4)
+            if len(ids) > max_prompt:
+                shift = len(ids) - max_prompt
+                ids = ids[-max_prompt:]
         if not ids:
             ids = [getattr(self.tokenizer, "eos_token_id", 0) or 0]
 
@@ -2745,6 +3118,8 @@ class Engine:
         # mirostat v2 initializes mu at 2*tau (llama.cpp semantics)
         tau = req.params.mirostat_tau if req.params.mirostat_tau > 0 else 5.0
         self.mu[slot] = 2.0 * tau
+        if resume is not None and resume.mu is not None:
+            self.mu[slot] = resume.mu   # mirostat state survives the pause
         fallback = hash(req.request_id) & 0x7FFFFFFF
         self.rng_keys = sampling.seed_slot_key(
             self.rng_keys, slot, req.params, fallback_seed=fallback
@@ -2814,6 +3189,10 @@ class Engine:
                      and sp.repeat_penalty in (0.0, 1.0)
                      and sp.presence_penalty == 0.0
                      and sp.frequency_penalty == 0.0)
+        if resume is not None:
+            # the draft cache holds no restore path for the resumed
+            # history; spec acceptance would attend over draft zeros
+            s.spec_ok = False
         if s.spec_ok:
             self._ensure_draft_cache()
         s.pending = ids[common:]
@@ -2822,13 +3201,26 @@ class Engine:
         # multimodal rows are image embeddings, not token embeddings — a
         # later text request must never "reuse" them as a token prefix
         self._cache_tokens[slot] = [] if mm_pos is not None else list(ids)
+        if resume is not None:
+            # graft the paused stream back on: the emitter keys its state
+            # on the (slot, snap) it is handed, and its FIFO queue makes
+            # handing it the same detokenizer safe across the pause
+            s.detok = resume.detok
+            s.held_text = resume.held_text
+            s.generated = list(resume.generated)
+            s.n_decoded = resume.n_decoded
+            s.prompt_len = resume.prompt_len
+            s.t_start = resume.t_start
+            s.t_first_token = resume.t_first_token or 0.0
+            s.t_prefill_ms = resume.t_prefill_ms
+            s.preempts = resume.preempt_count
         self.slots[slot] = s
         self._prefill_queue.append(slot)
         # fold a watermark sample at admission: a request shorter than the
         # loop's sampling throttle must still leave a high-water mark
         self._sample_watermarks()
         tr = self.tracer
-        if tr.enabled:
+        if tr.enabled and resume is None:
             t1 = time.monotonic()
             if req.t_submit:
                 tr.record("queue_wait", f"slot{slot}", req.t_submit,
@@ -3472,6 +3864,19 @@ class Engine:
         S = self.ecfg.num_slots
         C = self.ecfg.max_context
         budget = self._pack_budget
+        # weighted-fair packing (ISSUE 10): when slots of MORE THAN ONE
+        # priority class have pending prompt tokens, the scheduler's
+        # deficit round-robin caps each class's share of the budget.
+        # Single-class traffic never enters the DRR path, so the packing
+        # below stays bit-for-bit identical to the FIFO engine's.
+        infl_vec = None
+        drr = None
+        sched = self._sched
+        if sched is not None:
+            infl_vec, pend, _act = self._plan_vec()
+            if sum(1 for n in pend if n > 0) > 1:
+                sched.begin_tick(budget, pend)
+                drr = pend
         segs = []                   # (slot, s, take, final)
         total = 0
         for slot in self._prefill_queue:
@@ -3484,6 +3889,18 @@ class Engine:
             take = min(len(s.pending), self._chunk, budget - total)
             if take <= 0:
                 continue
+            if drr is not None:
+                # slack = budget no other class can absorb this tick
+                # (their pending work or deficit is exhausted) — granted
+                # beyond the deficit so the walk stays work-conserving
+                r = s.prio
+                others = sum(min(sched.deficit(j), drr[j])
+                             for j in range(len(drr)) if j != r)
+                slack = max(0, (budget - total) - others)
+                take = sched.take(r, take, slack)
+                if take <= 0:
+                    continue
+                drr[r] = max(0, drr[r] - take)
             segs.append((slot, s, take, take == len(s.pending)))
             total += take
         if not segs:
@@ -3543,7 +3960,8 @@ class Engine:
                 and self._n_inflight_bursts() < self.ecfg.pipeline_depth
                 and self._pick_burst(
                     extra=[(s.written + t, s.req.max_new_tokens)
-                           for _sl, s, t in finals])
+                           for _sl, s, t in finals],
+                    infl_vec=infl_vec)
                 == self.ecfg.decode_burst):
             return self._dispatch_packed_fused(segs, args, meta, bucket,
                                                continued, t0)
@@ -3921,6 +4339,25 @@ class Engine:
         """Decode tokens already dispatched (unprocessed) for a slot."""
         return self._inflight_vec()[slot]
 
+    def _plan_vec(self):
+        """One-pass planner state for a tick (ISSUE 10, extending the
+        ISSUE-9 one-pass FIFO walk to the admission/budget walk): the
+        in-flight vector plus per-class accounting — pending prompt
+        tokens a class could pack this tick (chunk-capped, like the
+        packed walk's own ``take``) and active slot counts.  Returns
+        ``(infl_vec, pending_by_class, active_by_class)``."""
+        infl_vec = self._inflight_vec()
+        ncls = len(PRIORITY_CLASSES)
+        pend = [0] * ncls
+        act = [0] * ncls
+        for s in self.slots:
+            if s is None:
+                continue
+            act[s.prio] += 1
+            if s.phase == "prefill" and s.pending:
+                pend[s.prio] += min(len(s.pending), self._chunk)
+        return infl_vec, pend, act
+
     def _drain_fifo(self, can_feed: bool = False,
                     block: bool = True) -> bool:
         """Process dispatched work. Prefill groups activate as soon as the
@@ -3982,7 +4419,7 @@ class Engine:
             else:
                 self._process_prefill(head)
 
-    def _pick_burst(self, extra=None) -> int:
+    def _pick_burst(self, extra=None, infl_vec=None) -> int:
         """Burst length for this dispatch: a power of two <= decode_burst,
         clamped so no slot crosses its context-shift threshold mid-burst
         (tokens past the threshold would be silently position-less).
@@ -3997,7 +4434,8 @@ class Engine:
         against the capacity clamp too."""
         cap = self.ecfg.decode_burst
         budget = 1
-        infl_vec = self._inflight_vec()
+        if infl_vec is None:
+            infl_vec = self._inflight_vec()
         for i, s in enumerate(self.slots):
             if s is None or s.phase != "decode":
                 continue

@@ -8,6 +8,7 @@
 #   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
 #   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 300 here)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
+#   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #
 # Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
@@ -96,5 +97,36 @@ print(f"CHAOS_RECOVERED={line.get('recovered')} "
 sys.exit(0 if line.get("value") == 1 else 1)
 PY
 rm -f "$chaos_out"
+
+# Preemptive priority scheduler (ISSUE 10): under a saturating low
+# background, high-priority p50 TTFT must be >= 2x better with
+# preemption on than with the FIFO engine, at least one preemption must
+# actually fire, every paused request must run to completion, and the
+# resumed continuation must be bit-for-bit a fresh re-admission of
+# (prompt + emitted tokens). rc != 0 if any of that regresses.
+echo "== ci: bench priority =="
+prio_out=$(mktemp)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=128 \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_PRIO_BUDGET_S:-180}" \
+    python bench.py --priority | tee "$prio_out"
+
+python - "$prio_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{") and "metric" in ln:
+        line = json.loads(ln)
+print(f"PRIO_TTFT_RATIO={line.get('ttft_ratio')} "
+      f"PREEMPTIONS={line.get('preemptions')} "
+      f"RESUME_BYTE_MATCH={line.get('resume_byte_match')} "
+      f"p50_ttft_on_ms={line.get('p50_ttft_on_ms')} "
+      f"p50_ttft_off_ms={line.get('p50_ttft_off_ms')} "
+      f"low_complete={line.get('low_complete')}")
+sys.exit(0 if line.get("ok") == 1 else 1)
+PY
+rm -f "$prio_out"
 
 echo "== ci: OK =="

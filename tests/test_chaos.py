@@ -32,11 +32,12 @@ def _clean_faults():
     FAULTS.reset()
 
 
-def _greedy(byte_tokenizer, prompt: str, n: int = 8) -> eng.GenRequest:
+def _greedy(byte_tokenizer, prompt: str, n: int = 8,
+            priority: str = "") -> eng.GenRequest:
     return eng.GenRequest(
         prompt_ids=byte_tokenizer.encode(prompt),
         params=sampling.SamplingParamsHost(temperature=0.0),
-        max_new_tokens=n, ignore_eos=True)
+        max_new_tokens=n, ignore_eos=True, priority=priority)
 
 
 # ---- admission control ----
@@ -167,6 +168,118 @@ def test_page_alloc_fault_structured_then_recovers(
     events = list(e.generate(_greedy(byte_tokenizer, "pg", 8)))
     assert events[-1].error and "injected" in events[-1].error
     again = eng.event_ids(list(e.generate(_greedy(byte_tokenizer, "pg", 8))))
+    assert again == base
+
+
+def _manual_tick(e):
+    """One engine-loop iteration, exactly the _run order (minus timing)."""
+    e._apply_emitter_notes()
+    e._admit()
+    e._prefill_step()
+    e._dispatch_decode()
+    e._drain_fifo()
+
+
+def _manual_drain(out, timeout=30.0):
+    got = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return got
+        got.append(ev)
+
+
+def _manual_run(e, req, max_ticks=400):
+    out = e.submit(req)
+    for _ in range(max_ticks):
+        _manual_tick(e)
+        if (e.slots[0] is None and e._queue.empty() and not e._fifo
+                and (e._sched is None or e._sched.resume_depth == 0)):
+            break
+        time.sleep(0.002)   # let the emitter thread keep pace
+    else:
+        pytest.fail("manual run did not complete")
+    e._apply_emitter_notes()
+    return _manual_drain(out)
+
+
+def test_page_alloc_fault_mid_resume_structured_then_recovers(
+        tiny_llama, byte_tokenizer):
+    """ISSUE 10 chaos case: page_alloc_fail injected while a PREEMPTED
+    request is being resumed. The resume admission itself splices the
+    retained pages back (no allocator call), so the fault lands in the
+    tail re-prefill — the resumed stream must end with a structured
+    injected error (never a hang), and the recovered engine must serve
+    the same prompt byte-identically. The engine is ticked manually
+    (never started) so the fault window is deterministic."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(
+        num_slots=1, max_context=96, prefill_buckets=(16, 64),
+        decode_burst=4, kv_page_size=4, kv_prefix_cache_min_rows=4)
+    e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+    assert e._sched is not None
+
+    # fault-free baseline through the same manual-tick path
+    base = eng.event_ids(_manual_run(e, _greedy(
+        byte_tokenizer, "bg", 24, priority="low")))
+    assert len(base) == 24
+
+    # park a low request mid-decode ...
+    out_low = e.submit(_greedy(byte_tokenizer, "bg", 24, priority="low"))
+    for _ in range(200):
+        _manual_tick(e)
+        if e.slots[0] is not None and e.slots[0].n_decoded >= 5:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("low request never reached 5 decoded tokens")
+    # ... by admitting a high arrival: one admission pass must preempt
+    out_high = e.submit(_greedy(byte_tokenizer, "hi", 8, priority="high"))
+    e._admit()
+    assert e._sched.preemptions == 1
+    assert e._sched.resume_depth == 1
+
+    # run the high request to completion WITHOUT admitting (the parked
+    # low request stays parked, keeping the fault window closed)
+    for _ in range(200):
+        e._apply_emitter_notes()
+        e._prefill_step()
+        e._dispatch_decode()
+        e._drain_fifo()
+        if e.slots[0] is None and not e._fifo:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("high request did not complete")
+    e._apply_emitter_notes()
+    high_events = _manual_drain(out_high)
+    assert all(ev.error is None for ev in high_events)
+    assert len(eng.event_ids(high_events)) == 8
+
+    # now the deterministic window: resume admission splices the retained
+    # pages (consumes no fault); the very next prefill step allocates
+    # pages for the tail re-prefill and hits the injected failure
+    FAULTS.arm("page_alloc_fail", count=1)
+    e._admit()
+    assert e._sched.resume_depth == 0
+    assert e.slots[0] is not None
+    try:
+        e._prefill_step()
+        pytest.fail("tail re-prefill did not hit the injected fault")
+    except Exception as ex:
+        assert "injected" in str(ex)
+        # the exact handler the engine loop runs on a step failure
+        e._recover_step_failure(ex)
+    e._apply_emitter_notes()
+    low_events = _manual_drain(out_low)
+    assert low_events, "the resumed stream must not end silently"
+    assert low_events[-1].error and "injected" in low_events[-1].error
+    assert e.slots[0] is None
+    assert e._sched.resume_depth == 0
+
+    # recovery: the reset engine serves the same prompt byte-identically
+    again = eng.event_ids(_manual_run(e, _greedy(
+        byte_tokenizer, "bg", 24, priority="low")))
     assert again == base
 
 

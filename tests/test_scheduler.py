@@ -1,0 +1,384 @@
+"""Preemptive priority scheduler (ISSUE 10).
+
+Three layers of coverage:
+
+* pure `engine/scheduler.py` units — deficit-round-robin share
+  arithmetic, queue ordering with aging promotion, shed/preemption
+  victim selection, resume-queue ordering, knob parsing;
+* config-wire validation (`ModelConfig.validate`, no jax import);
+* live-engine integration — a ``high`` arrival preempts a ``low``
+  decode, both streams complete, every token emitted before the pause
+  matches the unpreempted run, and the resumed continuation is
+  bit-for-bit what a fresh submission of the identical token history
+  computes (the resume contract: re-admission, nothing more).  Covered
+  for the restore path (retained pages spliced back), the degraded
+  path (no retained KV -> full re-prefill), and a run racing context
+  shifts.
+"""
+
+import time
+
+import pytest
+
+from localai_tpu.config.model_config import ModelConfig
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.engine.scheduler import (
+    PRIORITY_CLASSES, ResumeEntry, Scheduler, normalize_priority,
+    parse_priority_weights)
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _greedy(tok, prompt: str, n: int = 8, priority: str = "") -> eng.GenRequest:
+    return eng.GenRequest(
+        prompt_ids=tok.encode(prompt),
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n, ignore_eos=True, priority=priority)
+
+
+def _collect(out, timeout: float = 60.0) -> list:
+    events = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return events
+        events.append(ev)
+
+
+# ---- knob parsing ----
+
+
+def test_parse_priority_weights():
+    assert parse_priority_weights("4:2:1") == (4, 2, 1)
+    assert parse_priority_weights(" 8 : 4 : 1 ") == (8, 4, 1)
+    for bad in ("4:2", "4:2:1:1", "a:b:c", "0:1:1", "-1:2:1", ""):
+        with pytest.raises(ValueError):
+            parse_priority_weights(bad)
+
+
+def test_normalize_priority():
+    assert normalize_priority("HIGH") == "high"
+    assert normalize_priority(" low ") == "low"
+    assert normalize_priority("") == "normal"
+    assert normalize_priority("urgent") == "normal"
+    assert normalize_priority(None) == "normal"
+    assert normalize_priority("bogus", default="low") == "low"
+
+
+def test_priority_knob_validation():
+    ok = ModelConfig(name="m", options=[
+        "preempt=0", "priority=high", "priority_weights=8:3:1",
+        "max_preemptions=3", "resume_reserve_pages=2",
+        "priority_aging_ms=2000"])
+    assert ok.validate() == []
+    for opt in ("priority=urgent", "priority_weights=4:2",
+                "priority_weights=0:1:1", "preempt=maybe",
+                "max_preemptions=-1", "resume_reserve_pages=two",
+                "priority_aging_ms=1.5"):
+        problems = ModelConfig(name="m", options=[opt]).validate()
+        assert problems, f"expected a problem for {opt!r}"
+
+
+# ---- deficit round-robin ----
+
+
+def test_drr_weighted_shares():
+    s = Scheduler((4, 2, 1))
+    s.begin_tick(70, [100, 100, 100])
+    assert s.take(0, 100) == 40
+    assert s.take(1, 100) == 20
+    assert s.take(2, 100) == 10
+    # deficits are spent
+    assert s.take(0, 100) == 0
+
+
+def test_drr_idle_class_forfeits_share():
+    s = Scheduler((4, 2, 1))
+    s.begin_tick(70, [100, 0, 100])     # normal has no pending work
+    assert s.deficit(1) == 0            # idle class earns nothing
+    assert s.take(0, 1000) == 56        # 70 * 4 // 5
+    assert s.take(2, 1000) == 14        # 70 * 1 // 5
+
+
+def test_drr_deficit_carries_over_and_clamps():
+    s = Scheduler((4, 2, 1))
+    s.begin_tick(70, [100, 100, 100])
+    # nothing taken: credit carries to the next tick...
+    s.begin_tick(70, [100, 100, 100])
+    assert s.deficit(0) == 80
+    # ...but is clamped at 2x budget so an untouched class cannot bank
+    # unbounded credit
+    for _ in range(10):
+        s.begin_tick(70, [100, 100, 100])
+    assert s.deficit(0) == 140
+    # a class that goes idle loses its banked credit entirely
+    s.begin_tick(70, [0, 100, 100])
+    assert s.deficit(0) == 0
+
+
+def test_drr_take_slack_is_work_conserving():
+    s = Scheduler((4, 2, 1))
+    s.begin_tick(70, [100, 100, 100])
+    # low's deficit is 10; with 30 tokens of slack (budget no other
+    # class can use) the grant extends past the deficit
+    assert s.take(2, 100, slack=30) == 40
+    assert s.deficit(2) == 0
+    # slack is never banked: a later plain take gets nothing
+    assert s.take(2, 100) == 0
+
+
+# ---- queue ordering + aging ----
+
+
+def test_order_queued_rank_then_fifo():
+    s = Scheduler()
+    now = time.monotonic()
+    out = s.order_queued([
+        ("low", now - 0.3, "l1"), ("high", now - 0.1, "h1"),
+        ("normal", now - 0.2, "n1"), ("high", now - 0.2, "h0")])
+    assert out == ["h0", "h1", "n1", "l1"]   # rank, then FIFO within
+
+
+def test_order_queued_aging_promotes_one_class():
+    s = Scheduler(aging_ms=100.0)
+    now = time.monotonic()
+    # the low request has waited past the aging bound: it runs as
+    # normal, and FIFO order within the merged class puts it first
+    out = s.order_queued([
+        ("normal", now - 0.05, "n1"), ("low", now - 0.5, "l1")])
+    assert out == ["l1", "n1"]
+    assert s.aged_promotions == 1
+    # high never promotes past high
+    assert s.effective_rank("high", 10.0) == 0
+    # aging disabled -> no promotion
+    s2 = Scheduler(aging_ms=0)
+    assert s2.effective_rank("low", 1e9) == 2
+
+
+# ---- shed victim selection ----
+
+
+def test_pick_shed_victim_strictly_lower_longest_queued():
+    s = Scheduler()
+    queued = [("low", 5.0, "l-new"), ("low", 1.0, "l-old"),
+              ("normal", 0.5, "n-old")]
+    # a normal arrival displaces the longest-queued low, never a peer
+    assert s.pick_shed_victim(1, queued) == "l-old"
+    # a high arrival picks from the lowest class first
+    assert s.pick_shed_victim(0, queued) == "l-old"
+    # a low arrival finds no one strictly below it
+    assert s.pick_shed_victim(2, queued) is None
+    # a queue full of equals refuses the newcomer (PR-7 contract)
+    assert s.pick_shed_victim(1, [("normal", 1.0, "a"),
+                                  ("normal", 2.0, "b")]) is None
+
+
+# ---- preemption victim selection ----
+
+
+def test_pick_victim_lowest_class_newest_start():
+    s = Scheduler(max_preemptions=2)
+    active = [(0, "low", 5.0, 0), (1, "low", 2.0, 0), (2, "normal", 9.0, 0)]
+    # lowest class strictly below the arrival, newest start first
+    assert s.pick_victim(0, active) == 0
+    assert s.pick_victim(1, active) == 0
+    assert s.pick_victim(2, active) is None
+    # the starvation guard skips slots already preempted max times
+    capped = [(0, "low", 5.0, 2), (1, "low", 2.0, 1)]
+    assert s.pick_victim(0, capped) == 1
+    assert s.pick_victim(0, [(0, "low", 5.0, 2)]) is None
+
+
+# ---- resume queue ----
+
+
+def test_resume_queue_rank_then_park_time():
+    s = Scheduler()
+    e_low = ResumeEntry(req=None, ids=[1], priority="low")
+    e_high = ResumeEntry(req=None, ids=[2], priority="high")
+    e_low2 = ResumeEntry(req=None, ids=[3], priority="low")
+    for e in (e_low, e_high, e_low2):
+        s.park(e)
+    assert s.preemptions == 3
+    assert s.resume_depth == 3
+    assert s.peek_resume() is e_high
+    assert s.pop_resume() is e_high
+    assert s.pop_resume() is e_low       # oldest park within a class
+    s.requeue_front(e_low)               # failed admission goes back
+    assert s.pop_resume() is e_low
+    assert s.pop_resume() is e_low2
+    assert s.pop_resume() is None
+
+
+# ---- live engine integration ----
+
+
+@pytest.fixture(scope="module")
+def prio_engine(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=1, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4)
+    e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+    e.start()
+    yield e
+    e.shutdown()
+
+
+def _preempt_resume_round(e, tok, low_prompt: str, n_low: int):
+    """Drive one preempt->resume round: a low request decodes alone,
+    a high arrival displaces it, both streams run to completion.
+    Returns (low_ids, high_ids, preempt point, scheduler stats)."""
+    EVENTS.clear()
+    req_low = _greedy(tok, low_prompt, n_low, priority="low")
+    out_low = e.submit(req_low)
+    first = out_low.get(timeout=60.0)
+    assert first.error is None
+    out_high = e.submit(_greedy(tok, "urgent", 8, priority="high"))
+    high_events = _collect(out_high)
+    low_events = [first] + _collect(out_low)
+    assert all(ev.error is None for ev in high_events + low_events)
+    pre_evs = [ev for ev in EVENTS.events()
+               if ev["event"] == "preempt" and ev["rid"] == req_low.request_id]
+    assert pre_evs, "the high arrival should have preempted the low slot"
+    return (eng.event_ids(low_events), eng.event_ids(high_events),
+            pre_evs[0]["n_decoded"], e.metrics()["scheduler"])
+
+
+def test_high_preempts_low_both_streams_complete(prio_engine, byte_tokenizer):
+    e = prio_engine
+    base_low = eng.event_ids(list(e.generate(
+        _greedy(byte_tokenizer, "background work", 48, priority="low"))))
+    base_high = eng.event_ids(list(e.generate(
+        _greedy(byte_tokenizer, "urgent", 8, priority="high"))))
+    pre = e.metrics()["scheduler"]["preemptions"]
+    low_ids, high_ids, k, stats = _preempt_resume_round(
+        e, byte_tokenizer, "background work", 48)
+    assert stats["preemptions"] >= pre + 1
+    assert stats["resumes"] >= 1
+    assert high_ids == base_high
+    # every token emitted before the pause matches the unpreempted run,
+    # and the pause loses / duplicates nothing
+    assert low_ids[:k] == base_low[:k]
+    assert len(low_ids) == 48
+    lc = e.metrics()["lifecycle"]
+    assert lc.get("preemptions", 0) >= 1
+
+
+def test_resume_reprefill_matches_fresh_readmission_bit_for_bit(
+        tiny_llama, byte_tokenizer):
+    """The resume contract: re-admission of the identical token history.
+    With the prefix cache off a preempted slot retains nothing — the
+    killed-host-entry degradation path — so resume is a full re-prefill,
+    and its continuation must be bit-for-bit what a FRESH engine computes
+    for a prompt of (original prompt + tokens emitted before the pause)."""
+    cfg, params = tiny_llama
+    kw = dict(num_slots=1, max_context=96, prefill_buckets=(16, 64),
+              decode_burst=4, kv_prefix_cache=False, kv_offload=False)
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(**kw))
+    e.start()
+    try:
+        low_ids, _high, k, stats = _preempt_resume_round(
+            e, byte_tokenizer, "cold resume", 64)
+        assert stats["preemptions"] >= 1
+        assert stats["resume_reprefills"] >= 1
+        assert stats["resume_restore_rows"] == 0
+        assert len(low_ids) == 64 and 0 < k < 64
+    finally:
+        e.shutdown()
+    ref_engine = eng.Engine(cfg, params, byte_tokenizer,
+                            eng.EngineConfig(**kw))
+    ref_engine.start()
+    try:
+        req = eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode("cold resume") + low_ids[:k],
+            params=sampling.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=64 - k, ignore_eos=True, priority="low")
+        ref = eng.event_ids(list(ref_engine.generate(req)))
+    finally:
+        ref_engine.shutdown()
+    assert low_ids[k:] == ref
+
+
+def test_resume_restores_retained_pages(tiny_llama, byte_tokenizer):
+    """With small pages the committed history always spans full pages,
+    so resume must splice the retained chain back (restore counters
+    tick, no re-prefill) and the stream completes uninterrupted."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=1, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=4, kv_prefix_cache_min_rows=4)
+    e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+    e.start()
+    try:
+        base_low = eng.event_ids(list(e.generate(
+            _greedy(byte_tokenizer, "warm resume", 48, priority="low"))))
+        low_ids, _high, k, stats = _preempt_resume_round(
+            e, byte_tokenizer, "warm resume", 48)
+        assert stats["preemptions"] >= 1
+        assert stats["resumes"] >= 1
+        assert stats["resume_restore_rows"] >= 4   # >= one spliced page
+        assert stats["resume_reprefills"] == 0
+        assert low_ids[:k] == base_low[:k]
+        assert len(low_ids) == 48
+    finally:
+        e.shutdown()
+
+
+def test_preempt_racing_context_shift_completes(prio_engine, byte_tokenizer):
+    """The low request decodes far past max_context, so context shifts
+    keep firing; the preemption lands somewhere in that churn and the
+    resumed stream must still run to its full length with the
+    pre-preemption prefix intact."""
+    e = prio_engine
+    base_low = eng.event_ids(list(e.generate(
+        _greedy(byte_tokenizer, "shifty", 160, priority="low"))))
+    assert len(base_low) == 160
+    low_ids, _high_ids, k, stats = _preempt_resume_round(
+        e, byte_tokenizer, "shifty", 160)
+    assert stats["preemptions"] >= 1
+    assert low_ids[:k] == base_low[:k]
+    assert len(low_ids) == 160
+
+
+def test_preempt_off_restores_fifo(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=1, max_context=96,
+                            prefill_buckets=(16, 64), preempt=False)
+    e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+    assert e._sched is None
+    assert e.metrics()["scheduler"] == {"preempt": False}
+
+
+def test_queue_full_displaces_longest_queued_lower_class(
+        tiny_llama, byte_tokenizer):
+    """Queue-wait-aware shedding at the door (engine deliberately NOT
+    started, like the ISSUE-7 shed test): a higher-class arrival
+    displaces the longest-queued strictly-lower request; a same-class
+    flood still sheds the newcomer."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=1, max_context=96,
+                            prefill_buckets=(16, 64),
+                            max_queued_requests=2)
+    e = eng.Engine(cfg, params, byte_tokenizer, ecfg)
+    out_l1 = e.submit(_greedy(byte_tokenizer, "bg one", priority="low"))
+    e.submit(_greedy(byte_tokenizer, "bg two", priority="low"))
+    # a normal arrival displaces the oldest low instead of being refused
+    e.submit(_greedy(byte_tokenizer, "interactive", priority="normal"))
+    ev = out_l1.get(timeout=1.0)
+    assert ev.error_kind == "shed" and "displaced" in ev.error
+    assert out_l1.get(timeout=1.0) is None
+    # a low arrival finds nobody strictly below it: newcomer refused
+    out_l3 = e.submit(_greedy(byte_tokenizer, "bg three", priority="low"))
+    ev = out_l3.get(timeout=1.0)
+    assert ev.error_kind == "shed" and "overloaded" in ev.error
+    assert e.metrics()["lifecycle"]["requests_shed"] == 2
+    m = e.metrics()["scheduler"]
+    assert m["queued_by_class"] == {"high": 0, "normal": 1, "low": 1}
