@@ -163,7 +163,8 @@ _TTFT_GAUGES = (("queue_wait", "queue_wait"),
                 ("admit_to_first", "admit_to_first"),
                 ("prefill_dispatch", "prefill_dispatch"))
 # packed-prefill scheduling totals (engine.py metrics()["packed_prefill"])
-_PACKED_COUNTERS = ("dispatches", "tokens", "segments", "pad_tokens")
+_PACKED_COUNTERS = ("dispatches", "tokens", "segments", "pad_tokens",
+                    "kernel_fallback")
 # engine-owned latency histograms (engine.py metrics()["histograms"]):
 # re-exposed verbatim with proper _bucket/_sum/_count exposition
 _LATENCY_HISTOGRAMS = ("ttft_seconds", "itl_seconds",
@@ -211,6 +212,7 @@ def _refresh_engine_metrics(state):
               *_LATENCY_HISTOGRAMS,
               *(f"ttft_{m}_p50_ms" for _k, m in _TTFT_GAUGES),
               *(f"prefill_packed_{k}_total" for k in _PACKED_COUNTERS),
+              "prefill_kernel_fallback_total",
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
               *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS),
               *(m for _k, m in _LIFECYCLE_COUNTERS),
@@ -264,6 +266,13 @@ def _refresh_engine_metrics(state):
             for key in _PACKED_COUNTERS:
                 METRICS.set_counter(f"prefill_packed_{key}_total",
                                     pp.get(key, 0), label_str(model=name))
+            # headline alias (ISSUE 11): a pack that left the Pallas
+            # kernel path for the jnp reference is a silent throughput
+            # cliff — exported under its own name so dashboards can
+            # alert on it without knowing the packed_prefill family
+            METRICS.set_counter("prefill_kernel_fallback_total",
+                                pp.get("kernel_fallback", 0),
+                                label_str(model=name))
         lc = stats.get("lifecycle")
         if lc:
             for skey, mkey in _LIFECYCLE_COUNTERS:

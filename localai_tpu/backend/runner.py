@@ -325,10 +325,17 @@ class EngineServicer(BackendServicer):
                ("0", "false", "off", "no") else {}),
             **({"prefill_token_budget": ptb} if (ptb := int(
                 extra.get("prefill_token_budget", 0) or 0)) > 0 else {}),
-            # prefill_packed_fuse=auto|0|1: fuse the packed step with
-            # the decode burst (auto = real-chip backends only)
+            # prefill_packed_fuse=auto|0|1|split: fuse the packed step
+            # with the decode burst (1 = monolithic program, split =
+            # early-emit pair, auto = split everywhere)
             **({"prefill_packed_fuse": ppf} if (ppf := str(
                 extra.get("prefill_packed_fuse", "") or "")) in
+               ("auto", "0", "1", "split") else {}),
+            # comm_overlap=auto|0|1 (ISSUE 11): TokenWeave-style halved-
+            # pack overlap of per-layer collectives with compute
+            # (auto = meshed backends only; bit-exact either way)
+            **({"comm_overlap": cov} if (cov := str(
+                extra.get("comm_overlap", "") or "")) in
                ("auto", "0", "1") else {}),
             # observability (this PR): trace=0 turns the span tracer into
             # a hot-path no-op; trace_ring_size bounds retained spans;

@@ -138,8 +138,12 @@ class ModelConfig:
     # packed-prefill knobs prefill_packed=0|1 (default on; 0 restores
     # per-slot bucketed prefill), prefill_token_budget=N (max packed
     # prompt tokens per scheduler tick, 0 = engine auto) and
-    # prefill_packed_fuse=auto|0|1 (fuse the packed step with the
-    # decode burst; auto = real-chip backends only), or the
+    # prefill_packed_fuse=auto|0|1|split (fuse the packed step with the
+    # decode burst; 1 = one monolithic program, split = early-emit
+    # back-to-back pair, auto = split everywhere) and
+    # comm_overlap=auto|0|1 (TokenWeave-style halved-pack overlap of
+    # per-layer collectives with compute; auto = meshed backends only,
+    # bit-exact either way), or the
     # observability knobs trace=0|1 (request-lifecycle span tracer,
     # default on), trace_ring_size=N (retained spans, default 4096) and
     # slow_request_ms=N (log a span decomposition when TTFT or e2e
@@ -277,9 +281,13 @@ class ModelConfig:
                     parse_priority_weights(v)
                 except ValueError as e:
                     problems.append(str(e))
-            elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1"):
+            elif k == "prefill_packed_fuse" and v not in ("auto", "0", "1",
+                                                          "split"):
                 problems.append(
-                    f"prefill_packed_fuse must be auto|0|1, got {v!r}")
+                    f"prefill_packed_fuse must be auto|0|1|split, got {v!r}")
+            elif k == "comm_overlap" and v not in ("auto", "0", "1"):
+                problems.append(
+                    f"comm_overlap must be auto|0|1, got {v!r}")
             elif k == "peak_tflops":
                 try:
                     if float(v) < 0:

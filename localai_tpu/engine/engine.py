@@ -108,15 +108,26 @@ class EngineConfig:
     # packed path (a tick's pack stalls decode for one pack's compute).
     # 0 = auto: 2 * prefill_chunk, clamped to max_context.
     prefill_token_budget: int = 0
-    # fuse the packed prefill step WITH the decode burst into one
-    # dispatch (_fused_packed_body) when a full burst is runnable.
-    # Fusing saves one dispatch per tick but delays first-token
-    # emission by the burst's compute, so the right answer is a
-    # platform property: "auto" fuses on real accelerator backends
-    # (per-dispatch overhead ~3-30 ms on the serving tunnel, r4) and
-    # stays unfused on CPU (dispatch costs ~nothing; measured 1.5x
-    # worse loaded TTFT when fused on the smoke rig). "1"/"0" force.
+    # fuse the packed prefill step WITH the decode burst so a tick
+    # costs ONE dispatch chain. "split" (the "auto" default everywhere)
+    # dispatches the fused tick as an early-emit PAIR: the prefill head
+    # (ragged prefill + final-segment first tokens) and the burst body
+    # chained off its device outputs, back-to-back with no host sync
+    # between — the head's first tokens sync ahead of the burst, so the
+    # fused path no longer pays the burst's compute in TTFT (the
+    # tradeoff that used to keep "auto" real-chip-only: CPU measured
+    # 1.5x worse loaded TTFT with the monolithic fuse). "1" forces the
+    # monolithic single-program fuse (_fused_packed_body), "0" keeps
+    # prefill and burst as independent ticks.
     prefill_packed_fuse: str = "auto"
+    # TokenWeave-style compute/communication overlap (models/llama.py +
+    # parallel/sharding.py): packed-prefill layers split the token axis
+    # in two so the out-proj / down-proj all-reduce of half N overlaps
+    # the matmul of half N+1 on the tp mesh. Bit-exact (greedy output
+    # byte-identical on or off). "auto" = only when the engine runs on
+    # a mesh (single-chip programs have no collectives to hide);
+    # "1"/"0" force.
+    comm_overlap: str = "auto"
     context_shift: bool = True  # re-prefill tail window when a slot's cache fills
     cache_dtype: Any = jnp.bfloat16
     # KV layout (llama family): "auto" -> the PAGED page-pool layout
@@ -368,13 +379,20 @@ class _Burst:
     order, which metastably collapsed serving throughput ~7x)."""
     __slots__ = ("n_steps", "slots", "pack", "group", "t_dispatch",
                  "t_ready", "pack_np", "ids_np", "lps_np", "first_ids",
-                 "first_lps", "folded", "skip_slots", "ready", "err")
+                 "first_lps", "folded", "skip_slots", "ready", "err",
+                 "head")
 
-    def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0):
+    def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0,
+                 head=None):
         self.n_steps = n_steps
         self.slots = slots          # [(index, _Slot snapshot), ...]
         self.pack = pack            # device [2K+1(+2), S] f32
         self.group = list(group)    # fused-admission slots (subset of slots)
+        # early-emit split: the _PendingPrefill head this burst is
+        # chained off on-device. The sync worker readies the head FIRST
+        # (dispatch order), so its first tokens emit before this burst
+        # syncs; _fold_burst then reads first_ids from the head.
+        self.head = head
         self.t_dispatch = t_dispatch
         self.t_ready = 0.0          # sync-worker completion stamp
         self.pack_np = None
@@ -399,9 +417,10 @@ class _PendingPrefill:
     loop never blocks on a prefill that is still queued behind in-flight
     decode bursts — r3 polled is_ready(), which lies on this platform."""
     __slots__ = ("group", "out_ids", "logprobs", "mu_out", "t0",
-                 "t_ready", "ids_np", "lps_np", "mu_np", "ready", "err")
+                 "t_ready", "ids_np", "lps_np", "mu_np", "ready", "err",
+                 "split", "processed")
 
-    def __init__(self, group, out_ids, logprobs, mu_out, t0):
+    def __init__(self, group, out_ids, logprobs, mu_out, t0, split=False):
         self.group = group
         self.out_ids = out_ids
         self.logprobs = logprobs
@@ -411,6 +430,13 @@ class _PendingPrefill:
         self.ids_np = self.lps_np = self.mu_np = None
         self.ready = threading.Event()
         self.err = None
+        # early-emit split head: device chain state was already updated
+        # in-program, so processing only EMITS first tokens + stamps
+        # timing — the chained burst carries the slots' mirror updates.
+        # ``processed`` guards against double emission when _drain_fifo
+        # block-syncs the burst past a not-yet-processed head.
+        self.split = split
+        self.processed = False
 
 
 class _PendingOffload:
@@ -705,18 +731,32 @@ class Engine:
         self._final_pad = max(8, min(16, self.ecfg.num_slots))
         # ragged packed prefill (module doc): one dispatch per tick for
         # ALL queued slots' prompt tails. Families without the ragged
-        # forward, lockstep (the pack op is not in the descriptor set)
-        # and self-extend (grouped positions go singly) keep the
-        # per-slot path; ineligible SLOTS (multimodal, draft-mirrored)
-        # fall back per-slot inside _prefill_step.
+        # forward and lockstep (the pack op is not in the descriptor
+        # set) keep the per-slot path; ineligible SLOTS (multimodal,
+        # position-compressed self-extend) fall back per-slot inside
+        # _prefill_step. Spec slots pack too — their draft mirror rides
+        # a packed ragged program (_get_draft_packed_fn); a ga engine's
+        # UNcompressed slots pack normally (compressed ones need
+        # explicit grouped positions and go singly, _prefill_ga_piece).
         self._packed = (self.ecfg.prefill_packed and self._fam_llama
-                        and bus is None and self.ecfg.ga_n <= 1)
+                        and bus is None)
         fuse = str(self.ecfg.prefill_packed_fuse)
-        try:
-            on_chip = jax.default_backend() not in ("cpu",)
-        except Exception:  # pragma: no cover
-            on_chip = False
-        self._pack_fuse = fuse == "1" or (fuse == "auto" and on_chip)
+        # fused-tick mode: "off" | "mono" (prefill + first tokens +
+        # burst as literally one program) | "split" (early-emit pair:
+        # the same work as two back-to-back dispatches with no host
+        # sync between, so the head's first tokens reach the stream
+        # while the decode half is still computing). auto = split on
+        # EVERY platform — the split recovers the first-token delay
+        # that kept the monolithic body real-chip-only.
+        self._pack_fuse = {"0": "off", "1": "mono",
+                           "split": "split"}.get(fuse, "split")
+        co = str(self.ecfg.comm_overlap)
+        # TokenWeave halved-pack overlap (models/llama.py): only ever a
+        # win when per-layer collectives exist, so auto arms it on a
+        # mesh and keeps single-device serving on the one-chain path.
+        # Bit-exact either way (parallel/sharding.py::overlap_halves).
+        self._comm_overlap = co == "1" or (co == "auto"
+                                           and self.mesh is not None)
         budget = self.ecfg.prefill_token_budget or 2 * self._chunk
         self._pack_budget = max(1, min(budget, C))
         # total-token pad buckets for the pack: the per-slot ladder
@@ -727,9 +767,12 @@ class Engine:
             {min(b, self._pack_budget) for b in self._buckets}
             | {self._pack_budget}))
         # packed-prefill telemetry (metrics(); exercised by tests):
-        # dispatches, packed real tokens, segments, and pad waste
+        # dispatches, packed real tokens, segments, pad waste, and
+        # dispatches whose shape left the Pallas kernel path
+        # (models/llama.py::ragged_kernel_shape_fallback — the ~1k-token
+        # cliff this counter keeps observable)
         self._pack_stats = {"dispatches": 0, "tokens": 0, "segments": 0,
-                            "pad_tokens": 0}
+                            "pad_tokens": 0, "kernel_fallback": 0}
 
         # grammar-constrained decoding (lazy: built on first grammar request)
         self._grammar_cache: dict[str, Any] = {}
@@ -1559,7 +1602,8 @@ class Engine:
         well-defined)."""
         logits, ck, cv = self.family.ragged_prefill(
             params, self.cfg, tokens, positions, seg_of, seg_slots,
-            seg_start, seg_off, seg_len, ck, cv, continued=continued)
+            seg_start, seg_off, seg_len, ck, cv, continued=continued,
+            comm_overlap=self._comm_overlap)
         slot_params = sampling.unpack_slot_params(slot_params)
         sp_rows = jax.tree.map(
             lambda a: jnp.take(jnp.asarray(a), seg_slots, axis=0),
@@ -1613,7 +1657,8 @@ class Engine:
 
         logits, ck, cv = self.family.ragged_prefill(
             params, self.cfg, p_tokens, p_positions, seg_of, seg_slots,
-            seg_start, seg_off, seg_len, ck, cv, continued=continued)
+            seg_start, seg_off, seg_len, ck, cv, continued=continued,
+            comm_overlap=self._comm_overlap)
         sp_rows = jax.tree.map(
             lambda a: jnp.take(jnp.asarray(a), seg_slots, axis=0), sp)
         ring_rows = jnp.take(ring, seg_slots, axis=0)
@@ -1674,6 +1719,92 @@ class Engine:
                     continued=continued),
                 donate_argnums=(2, 3, 8))
             self._burst_fns[key] = fn
+        return fn
+
+    def _split_head_body(self, params, tokens, ck, cv, lengths, ring,
+                         ring_pos, bias, keys, slot_params, active, mu,
+                         ov_pack, p_tokens, p_positions, seg_of, seg_slots,
+                         seg_start, seg_off, seg_len, final_mask,
+                         continued: bool):
+        """EARLY-EMIT split, prefill half: exactly the state evolution of
+        _fused_packed_body up to (not including) the decode scan —
+        compose overrides, ragged-prefill every segment, sample the
+        FINAL segments' first tokens, fold them into the chain state —
+        and return the per-segment first tokens as their own device
+        outputs. The engine dispatches a plain decode burst chained off
+        the returned handles back-to-back (no host sync between), so the
+        device still sees one uninterrupted tick of work; but the sync
+        worker materializes THIS half first, so first tokens reach the
+        stream a whole decode burst earlier than the monolithic fused
+        body could deliver them — that delay is what kept fused auto
+        real-chip-only."""
+        sp = sampling.unpack_slot_params(slot_params)
+        tokens, lengths, ring, ring_pos, mu, _pos_offset = \
+            self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
+                                    ov_pack)
+
+        logits, ck, cv = self.family.ragged_prefill(
+            params, self.cfg, p_tokens, p_positions, seg_of, seg_slots,
+            seg_start, seg_off, seg_len, ck, cv, continued=continued,
+            comm_overlap=self._comm_overlap)
+        ring_rows = jnp.take(ring, seg_slots, axis=0)
+        rpos_rows = jnp.take(ring_pos, seg_slots, axis=0)
+        ids_f, lps_f, new_keys, new_mu = sampling.sample(
+            logits,
+            jax.tree.map(lambda a: jnp.take(jnp.asarray(a), seg_slots,
+                                            axis=0), sp),
+            ring_rows, rpos_rows,
+            jnp.take(bias, seg_slots, axis=0),
+            jnp.take(keys, seg_slots, axis=0),
+            jnp.take(mu, seg_slots, axis=0))
+        gate = final_mask
+        keys = keys.at[seg_slots].set(
+            jnp.where(gate[:, None], new_keys,
+                      jnp.take(keys, seg_slots, axis=0)), mode="drop")
+        mu = mu.at[seg_slots].set(
+            jnp.where(gate, new_mu, jnp.take(mu, seg_slots, axis=0)),
+            mode="drop")
+        lengths = lengths.at[seg_slots].set(
+            jnp.where(gate, seg_start + seg_len,
+                      jnp.take(lengths, seg_slots, axis=0)), mode="drop")
+        tokens = tokens.at[seg_slots].set(
+            jnp.where(gate, ids_f, jnp.take(tokens, seg_slots, axis=0)),
+            mode="drop")
+        rcol = rpos_rows % sampling.RING_N
+        ring = ring.at[seg_slots, rcol].set(
+            jnp.where(gate, ids_f, ring[seg_slots, rcol]), mode="drop")
+        ring_pos = ring_pos.at[seg_slots].set(
+            jnp.where(gate, rpos_rows + 1, rpos_rows), mode="drop")
+        return (ids_f, lps_f, ck, cv, keys,
+                (tokens, lengths, ring, ring_pos, mu))
+
+    def _get_split_head_fn(self, bucket: int, continued: bool):
+        key = ("packed_head", bucket, continued)
+        fn = self._final_fns.get(key)
+        if fn is None:
+            self._cobs.note_program("prefill_pack_head", (bucket, continued))
+            fn = jax.jit(
+                lambda *a: self._split_head_body(*a, continued=continued),
+                donate_argnums=(2, 3, 8))
+            self._final_fns[key] = fn
+        return fn
+
+    def _get_draft_packed_fn(self, bucket: int):
+        """Draft-model ragged prompt ingestion (open PR-4 follow-up:
+        spec slots are packed citizens now). Same ragged program as the
+        target's, minus sampling — the draft cache is contiguous, so
+        scatter_ragged takes its contiguous branch and the attention
+        reads ride the jnp path."""
+        key = ("draft_packed", bucket)
+        fn = self._chunk_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, t, pos, so, ss, st, off, ln, ck, cv:
+                    llama.ragged_prefill(
+                        p, self.draft_cfg, t, pos, so, ss, st, off, ln,
+                        ck, cv, continued=True)[1:],
+                donate_argnums=(8, 9))
+            self._chunk_fns[key] = fn
         return fn
 
     def _get_burst_fn(self, n_steps: int, flags: tuple = (True, True, True)):
@@ -1885,14 +2016,23 @@ class Engine:
                         self.params, *pack_args,
                         self.ck, self.cv, self.ring, self.ring_pos,
                         self.bias, self.rng_keys, spp, self.mu)
-                    if not self._pack_fuse:
-                        continue
-                    ffn = self._get_fused_packed_fn(bucket, continued)
-                    _, self.ck, self.cv, self.rng_keys, _ = ffn(
-                        self.params, self.cur_tokens, self.ck, self.cv,
-                        self.lengths, self.ring, self.ring_pos, self.bias,
-                        self.rng_keys, spp, self.active_dev, self.mu,
-                        no_ov, *pack_args)
+                    if self._pack_fuse == "mono":
+                        ffn = self._get_fused_packed_fn(bucket, continued)
+                        _, self.ck, self.cv, self.rng_keys, _ = ffn(
+                            self.params, self.cur_tokens, self.ck, self.cv,
+                            self.lengths, self.ring, self.ring_pos, self.bias,
+                            self.rng_keys, spp, self.active_dev, self.mu,
+                            no_ov, *pack_args)
+                    elif self._pack_fuse == "split":
+                        # chain outputs are DISCARDED: the head donates
+                        # only ck/cv/keys, and the engine's host-side
+                        # tokens/lengths/ring/mu arrays must stay numpy
+                        hfn = self._get_split_head_fn(bucket, continued)
+                        _, _, self.ck, self.cv, self.rng_keys, _ = hfn(
+                            self.params, self.cur_tokens, self.ck, self.cv,
+                            self.lengths, self.ring, self.ring_pos, self.bias,
+                            self.rng_keys, spp, self.active_dev, self.mu,
+                            no_ov, *pack_args)
         if self._hstore is not None:
             # host-tier transfer programs: the first eviction/restore
             # must not pay a cold compile mid-serving. Gather reads page
@@ -3846,10 +3986,12 @@ class Engine:
     def _pack_eligible(self, s: "_Slot") -> bool:
         """May this slot's prompt tail ride a ragged pack? Multimodal
         prompts keep their per-request injection shapes (own compiled
-        variants), self-extend slots need explicit grouped positions,
-        and spec_ok slots mirror every chunk into the draft cache via
-        the per-slot draft program — all three go singly."""
-        return s.mm_pos is None and s.ga_blocks == 0 and not s.spec_ok
+        variants) and position-COMPRESSED self-extend slots need
+        explicit grouped positions — both go singly. Spec slots pack:
+        their draft-cache mirror rides a packed ragged program of its
+        own (_get_draft_packed_fn), dispatched right behind the
+        target's."""
+        return s.mm_pos is None and s.ga_blocks == 0
 
     def _prefill_step_packed(self) -> bool:
         """ONE ragged dispatch for this tick's prompt ingestion: walk the
@@ -3949,20 +4091,43 @@ class Engine:
         self._pack_stats["tokens"] += total
         self._pack_stats["segments"] += len(segs)
         self._pack_stats["pad_tokens"] += bucket - total
+        if continued and llama.ragged_kernel_shape_fallback(
+                self.ck, bucket, self.cfg):
+            # this pack's SHAPE pushed the attention off the Pallas
+            # kernel (the pre-segment-blocked grid fell back above ~1k
+            # tokens at 8B head shapes) — counted per dispatch so the
+            # cliff is observable in metrics() and gated in CI. Fresh
+            # packs (continued=False) read no cache rows and take the
+            # jnp path by design, so they never count.
+            self._pack_stats["kernel_fallback"] += 1
+
+        if self.dck is not None and any(
+                s.spec_ok for _sl, s, _t, _f in segs):
+            # draft mirrors the SAME ragged pack (no sampling): spec
+            # slots used to force the whole pack onto the per-slot path;
+            # padded / spec-ineligible segments are harmless duplicate
+            # KV writes into draft rows nobody reads
+            self.dck, self.dcv = self._get_draft_packed_fn(bucket)(
+                self.draft_params, *args, *meta[:4], self.dck, self.dcv)
 
         # FUSED packed admission: when the pipeline has room and a
         # full-size burst is runnable, ragged prefill + first tokens +
-        # the decode burst go out as ONE dispatch (_fused_packed_body) —
-        # the packed generalization of _dispatch_fused, now covering
-        # continued segments too
+        # the decode burst go out as ONE dispatch (_fused_packed_body)
+        # in "mono" mode, or as the early-emit back-to-back pair
+        # (_dispatch_packed_split) in "split" mode — the packed
+        # generalization of _dispatch_fused, covering continued
+        # segments too
         finals = [(slot, s, take) for slot, s, take, f in segs if f]
-        if (finals and self._pack_fuse
+        if (finals and self._pack_fuse != "off"
                 and self._n_inflight_bursts() < self.ecfg.pipeline_depth
                 and self._pick_burst(
                     extra=[(s.written + t, s.req.max_new_tokens)
                            for _sl, s, t in finals],
                     infl_vec=infl_vec)
                 == self.ecfg.decode_burst):
+            if self._pack_fuse == "split":
+                return self._dispatch_packed_split(segs, args, meta,
+                                                   bucket, continued, t0)
             return self._dispatch_packed_fused(segs, args, meta, bucket,
                                                continued, t0)
 
@@ -4090,6 +4255,131 @@ class Engine:
         self._sync_q.put(b)
         return True
 
+    def _dispatch_packed_split(self, segs, args, meta, bucket: int,
+                               continued: bool, t0: float) -> bool:
+        """EARLY-EMIT fused tick: the same one-tick work as
+        _dispatch_packed_fused, issued as TWO dispatches — the prefill
+        half (_split_head_body: ragged prefill + first-token sampling +
+        chain-state fold) and a plain decode burst chained off its
+        device outputs. Between them the head's first tokens are synced
+        and EMITTED (the only host round-trip; the device is computing
+        the head for its whole duration, so the pipeline bubble is just
+        the emit + dispatch latency) — finals' TTFT stops paying for the
+        decode half (the tradeoff that kept fused auto real-chip-only).
+        Host bookkeeping is the fused path's: finals flip to decode NOW,
+        the burst rides the FIFO with ``head`` linked for its
+        first-token rows."""
+        S = self.ecfg.num_slots
+        C = self.ecfg.max_context
+        K = self.ecfg.decode_burst
+        group_snaps = []
+        t1 = time.monotonic()
+        for slot, s, take, final in segs:
+            s.pending = s.pending[take:]
+            s.written += take
+            if not final:
+                s.committed = s.written
+                s.t_prefill_ms += (t1 - t0) * 1e3
+                continue
+            s.phase = "decode"
+            s.cache_len = s.written
+            self.lengths[slot] = s.written
+            self.active_dev[slot] = True
+            self._override.add(slot)
+            if slot in self._prefill_queue:
+                self._prefill_queue.remove(slot)
+            group_snaps.append((slot, s))
+        infl = self._inflight_vec()
+        active = self.active_dev.copy()
+        included = list(group_snaps)
+        for i, s in enumerate(self.slots):
+            if s is None or s.phase != "decode" \
+                    or any(g == i for g, _ in group_snaps):
+                continue
+            if s.req.max_new_tokens - s.n_decoded - infl[i] <= 0:
+                active[i] = False
+                continue
+            included.append((i, s))
+        for gslot, gs in group_snaps:
+            self._ensure_pages(gslot, min(C, gs.written + K + 2))
+        for i, s in included:
+            if any(g == i for g, _ in group_snaps):
+                continue
+            self._ensure_pages(i, min(C, int(self.lengths[i])
+                                      + infl[i] + K + 2))
+        self._commit_ptab()
+        ov_mask = np.zeros((S,), np.bool_)
+        if self._chain is None:
+            chain = (self.cur_tokens.copy(), self.lengths.copy(),
+                     self.ring.copy(), self.ring_pos.copy(), self.mu.copy())
+        else:
+            chain = self._chain
+            for i in self._override:
+                ov_mask[i] = True
+        self._override.clear()
+        spp = sampling.pack_slot_params(self.slot_params)
+        head_fn = self._get_split_head_fn(bucket, continued)
+        with self._annot("prefill_pack_head"):
+            ids_f, lps_f, self.ck, self.cv, self.rng_keys, chain = head_fn(
+                self.params, chain[0], self.ck, self.cv, chain[1],
+                chain[2], chain[3], self.bias, self.rng_keys, spp,
+                active, chain[4], self._pack_ov(ov_mask), *args, *meta)
+        # EARLY EMIT before the decode half goes out: the head's tiny
+        # outputs (first ids/logprobs/mu) sync on the worker while the
+        # device is still computing them, the engine processes the group
+        # — first tokens reach the streams HERE — and only then issues
+        # the chained burst. On async backends the pipeline bubble is
+        # just this host round-trip (the device is busy with the head
+        # for the whole wait); on synchronous-dispatch backends (the CPU
+        # smoke rig, where a jit call blocks for its own compute) the
+        # wait is free — so TTFT stops paying for the decode half, which
+        # is this mode's reason to exist ("mono" keeps the zero-bubble
+        # fully-fused tick for throughput-first deployments).
+        head = _PendingPrefill(group_snaps, ids_f, lps_f, chain[4], t0,
+                               split=True)
+        self._fifo.append(head)      # discoverable for the stall handler
+        self._sync_q.put(head)
+        self._wait_ready(head, t0)
+        self._fifo.remove(head)
+        tp = time.monotonic()
+        self._process_prefill(head)
+        self._tmark("finalize", tp)
+        # a grammar rollback / context shift inside the head's emission
+        # corrects host mirrors and poisons in-flight bursts by walking
+        # the FIFO — the chained burst isn't dispatched yet, so it missed
+        # that walk: anything newly in _override sampled conditioned on
+        # state the rollback discarded and must be skipped the same way
+        poisoned = set(self._override)
+        # the burst chains off the head's DEVICE outputs: overrides were
+        # consumed by the head, so its ov mask is all-False (pos_offset
+        # still rides — it is current-host-truth every dispatch)
+        burst_fn = self._get_burst_fn(K)
+        with self._annot("decode_burst"):
+            pack, self.ck, self.cv, self.rng_keys, self._chain = burst_fn(
+                self.params, chain[0], self.ck, self.cv, chain[1],
+                chain[2], chain[3], self.bias, self.rng_keys, spp,
+                active, chain[4], self._pack_ov(np.zeros((S,), np.bool_)))
+        self._tmark("dispatch_packed_split", t0)
+        self._hobserve("prefill_dispatch_seconds", time.monotonic() - t0)
+        if self.tracer.enabled:
+            self.tracer.record("prefill_dispatch", "engine", t0,
+                               time.monotonic(),
+                               args={"segments": len(segs), "bucket": bucket,
+                                     "packed": True, "fused": "split"})
+        if self._trace:
+            s_ = self._tstats.setdefault("burst_steps", [0.0, 0])
+            s_[0] += K
+            s_[1] += 1
+            occ = self._tstats.setdefault("active_slots", [0.0, 0])
+            occ[0] += len(included)
+            occ[1] += 1
+        b = _Burst(K, included, pack, group=group_snaps, t_dispatch=t0,
+                   head=head)
+        b.skip_slots |= poisoned
+        self._fifo.append(b)
+        self._sync_q.put(b)
+        return True
+
     def _dispatch_fused(self, group, bucket: int) -> bool:
         """Dispatch final-prefill + first-token sampling + a full decode
         burst for ``group`` (fresh, non-multimodal prompts) in ONE device
@@ -4213,6 +4503,8 @@ class Engine:
             self._tmark("finalize_sync", tr)
         if item.err is not None:
             raise item.err
+        if item.split:
+            return self._process_split_head(item)
         group = item.group
         ids_np, lps_np, mu_np, t0 = item.ids_np, item.lps_np, item.mu_np, item.t0
         # scatter ONLY the group's mu entries — and only where the slot
@@ -4261,6 +4553,51 @@ class Engine:
             self._emit(gslot, first_id, float(lps_np[b]))
         # leaders just committed: fork their rows to any waiting siblings
         # (vanished leaders downgrade the siblings to full prefills)
+        for gslot, _snap in group:
+            self._process_fork_waiters(gslot)
+        self._flush_grammar_bias()
+        self._flush_em_batch()
+
+    def _process_split_head(self, item: "_PendingPrefill"):
+        """EARLY-EMIT head processing (results already synced): emit the
+        final segments' first tokens and stamp TTFT — NOTHING else. The
+        slots flipped to decode at dispatch, the device chain state was
+        advanced in-program, and the chained burst's fold carries the
+        host-mirror updates; writing mirrors here would race the
+        in-flight burst (a later dispatch composing them as overrides
+        would REWIND device state). A grammar rollback / context shift /
+        self-extend inside _emit poisons pipelined bursts via the usual
+        FIFO walk; the burst CHAINED to this head dispatches after this
+        runs, so _dispatch_packed_split carries anything newly overridden
+        here into its skip_slots instead."""
+        if item.processed:
+            return
+        item.processed = True
+        group = item.group
+        ids_np, lps_np, t0 = item.ids_np, item.lps_np, item.t0
+        t1 = time.monotonic()
+        trc = self.tracer
+        if trc.enabled and item.t_ready:
+            trc.record("prefill_device", "engine", t0, item.t_ready,
+                       args={"slots": len(group), "split": True})
+            trc.record("finish_detect", "engine", item.t_ready, t1)
+        for b, (gslot, snap) in enumerate(group):
+            gs = self.slots[gslot]
+            if gs is not snap:
+                continue  # cancelled while the head was in flight
+            gs.committed = gs.written
+            gs.t_prefill_ms += (t1 - t0) * 1e3
+            if gs.t_first_token == 0.0:
+                gs.t_first_token = t1
+                if gs.req.t_submit:
+                    self._hobserve("ttft_seconds", t1 - gs.req.t_submit,
+                                   rid=gs.req.request_id)
+                if trc.enabled:
+                    trc.record("prefill", f"slot{gslot}", t0, t1,
+                               rid=gs.req.request_id,
+                               args={"prompt_tokens": gs.prompt_len,
+                                     "fused": "split"})
+            self._emit(gslot, int(ids_np[b]), float(lps_np[b]))
         for gslot, _snap in group:
             self._process_fork_waiters(gslot)
         self._flush_grammar_bias()
@@ -4447,6 +4784,23 @@ class Engine:
             cap = min(cap, max(1, self.ecfg.max_context - 2 - take))
             budget = max(budget, max_new - 1)  # first token sampled in-fn
         cap = min(cap, budget)
+        if self._sched is not None:
+            # priority-weighted burst sizing (ISSUE 11, S2): when prompt
+            # work of a strictly higher class waits behind this burst,
+            # the scheduler's weights shrink it so admission comes back
+            # around sooner. preempt=0 -> _sched is None -> bit-for-bit
+            # today's sizing; so is any single-class workload.
+            pend = [0] * len(PRIORITY_CLASSES)
+            dec_rank = None
+            for s in self.slots:
+                if s is None:
+                    continue
+                if s.phase == "prefill" and s.pending:
+                    pend[s.prio] += 1
+                elif s.phase == "decode":
+                    dec_rank = (s.prio if dec_rank is None
+                                else min(dec_rank, s.prio))
+            cap = self._sched.burst_share(dec_rank, pend, cap)
         k = 1
         while k * 2 <= cap:
             k *= 2
@@ -4664,8 +5018,26 @@ class Engine:
         b.lps_np = packed[K:2 * K]
         mu_np = packed[2 * K]
         if b.group:
-            b.first_ids = packed[2 * K + 1].astype(np.int32)
-            b.first_lps = packed[2 * K + 2]
+            if b.head is not None:
+                # early-emit split: the first tokens synced with the
+                # HEAD (ready before this burst — same worker, dispatch
+                # order); rebuild the slot-indexed rows the ring fold
+                # below reads. The burst pack itself is a PLAIN pack
+                # (no first-token rows).
+                h = b.head
+                if not h.ready.is_set():
+                    self._wait_ready(h, h.t0)
+                if h.err is not None:
+                    raise h.err
+                S = self.ecfg.num_slots
+                b.first_ids = np.zeros((S,), np.int32)
+                b.first_lps = np.zeros((S,), np.float32)
+                for gi, (i, _snap) in enumerate(b.group):
+                    b.first_ids[i] = h.ids_np[gi]
+                    b.first_lps[i] = h.lps_np[gi]
+            else:
+                b.first_ids = packed[2 * K + 1].astype(np.int32)
+                b.first_lps = packed[2 * K + 2]
         live_idx = [i for i, snap in b.slots
                     if self._live(i, snap) and i not in b.skip_slots]
         for i in live_idx:
@@ -4687,6 +5059,21 @@ class Engine:
         release slots or trigger context shifts — both mark the device
         chain dirty). Per-slot events are COALESCED into one queue put per
         burst (see StreamEvent.token_ids)."""
+        if b.head is not None and not b.head.processed:
+            # the pipeline block-synced this burst past its own
+            # not-yet-processed head (_drain_fifo's burst walk passes
+            # non-burst items): emit the head's first tokens NOW, in
+            # stream order, before the burst's. The burst is already out
+            # of the FIFO, but rollback / shift / self-extend poisoning
+            # inside the head's emission walks self._fifo — keep the
+            # burst discoverable for the duration.
+            if b.head in self._fifo:
+                self._fifo.remove(b.head)
+            self._fifo.appendleft(b)
+            try:
+                self._process_prefill(b.head)
+            finally:
+                self._fifo.remove(b)
         self._fold_burst(b)
         if not b.group and b.t_dispatch:
             dt = (time.monotonic() - b.t_dispatch) * 1e3
@@ -4725,6 +5112,12 @@ class Engine:
             t1 = time.monotonic()
             for i, snap in b.group:
                 if not self._live(i, snap) or i in b.skip_slots:
+                    continue
+                if b.head is not None:
+                    # early-emit split: the head already emitted this
+                    # slot's first token, stamped its TTFT, and set
+                    # committed/cache_len (which the emission advanced —
+                    # resetting them here would rewind the slot)
                     continue
                 snap.cache_len = snap.written
                 snap.committed = snap.written

@@ -179,6 +179,31 @@ class Scheduler:
     def deficit(self, rank: int) -> int:
         return self._deficit[rank]
 
+    def burst_share(self, dec_rank: Optional[int],
+                    pending_by_class: List[int], cap: int) -> int:
+        """Weighted decode-burst budget (the PR-10 follow-up: DRR
+        weights used to shape prefill admission only, so a saturating
+        low-class decode fleet held full bursts while high-class prompts
+        queued a whole burst behind each tick).
+
+        When prompt work of a class STRICTLY higher-priority than every
+        decoding slot is pending, shrink the burst to the decoding
+        class's weighted share so the loop returns to admission sooner.
+        Neutral (returns ``cap``) whenever nothing higher is waiting —
+        single-class traffic and ``preempt=0`` (no scheduler at all)
+        keep today's sizing bit-for-bit."""
+        if dec_rank is None or cap <= 1:
+            return cap
+        best = None
+        for r, n in enumerate(pending_by_class):
+            if n > 0:
+                best = r
+                break
+        if best is None or best >= dec_rank:
+            return cap
+        w = self.weights
+        return max(1, cap * w[dec_rank] // max(1, w[dec_rank] + w[best]))
+
     # ---- queue ordering ------------------------------------------------
 
     def order_queued(self, entries: List[Tuple[str, float, Any]]) -> List[Any]:

@@ -344,16 +344,36 @@ def prefill(
 
 def _ragged_pallas_ok(lck, N: int, cfg: LlamaConfig) -> bool:
     """Use the Pallas ragged-prefill kernel for this pack? Real TPU
-    backend, plain-float PAGED cache, pack-key blocks divide the bucket,
-    and the per-head online-softmax scratch (m/l/acc over all N*G query
-    rows, f32) fits comfortably in VMEM."""
+    backend, plain-float PAGED cache, and ragged_kernel_plan finds a
+    (qb, pkb) blocking. The kernel blocks queries per segment, so its
+    scratch is per-q-block — pack LENGTH no longer disqualifies a pack
+    (the old whole-pack scratch gate bailed above ~1k tokens at 8B head
+    shapes)."""
+    from localai_tpu.ops.pallas.ragged_prefill import ragged_kernel_plan
+
     if not (_pallas_decode() and kvcache.is_paged(lck)
             and not kvcache.is_quant(lck)):
         return False
-    if N % min(N, 128):
+    return ragged_kernel_plan(N, cfg.num_kv_heads, cfg.q_per_kv,
+                              cfg.head_dim_) is not None
+
+
+def ragged_kernel_shape_fallback(cache_k, N: int, cfg: LlamaConfig) -> bool:
+    """Would a continued [N]-token pack leave the Pallas kernel path for
+    SHAPE reasons? The engine counts these per packed dispatch
+    (metrics()["packed_prefill"]["kernel_fallback"]) so a regression of
+    the long-pack cliff is observable. Deliberately platform- and
+    dtype-independent: int8 scales and contiguous layouts are static
+    config choices routed to the jnp path by design, not a
+    length-dependent cliff — counting them would bury the signal (and
+    make the CPU-CI zero-fallback gate meaningless)."""
+    from localai_tpu.ops.pallas.ragged_prefill import ragged_kernel_plan
+
+    lck = kvcache.layer(cache_k, 0)
+    if not kvcache.is_paged(lck) or kvcache.is_quant(lck):
         return False
-    scratch = cfg.num_kv_heads * N * cfg.q_per_kv * (cfg.head_dim_ + 2) * 4
-    return scratch <= 8 * 1024 * 1024
+    return ragged_kernel_plan(N, cfg.num_kv_heads, cfg.q_per_kv,
+                              cfg.head_dim_) is None
 
 
 def ragged_prefill(
@@ -369,12 +389,25 @@ def ragged_prefill(
     cache_k: jax.Array,
     cache_v: jax.Array,
     continued: bool = False,  # STATIC: True when any seg_start may be > 0
+    rope_positions: Optional[jax.Array] = None,  # [N] RoPE override
+    comm_overlap: bool = False,  # STATIC: TokenWeave halved-pack overlap
 ):
     """RAGGED PACKED PREFILL: process the prompt tails of up to B slots
     as ONE [N]-token batch — per-segment causal self-attention plus
     (``continued`` only) attention over each slot's committed cache
     rows, with the new KV rows written through every token's own slot's
     page table in one ragged scatter (ops/kvcache.py::scatter_ragged).
+
+    ``rope_positions`` decouples rotation from placement for
+    self-extend segments: the cache position (``positions``) drives the
+    KV scatter while compressed group-attention positions drive RoPE —
+    committed rows were already re-rotated in place by the engine, so
+    attention itself stays position-table-free. ``comm_overlap``
+    (STATIC) splits the pack in two around each layer's out-projection
+    and MLP so their contraction-sharded matmuls become independent
+    matmul + all-reduce chains XLA can interleave on a tp mesh
+    (parallel/sharding.py::overlap_halves; bit-exact, so greedy output
+    is byte-identical either way).
 
     This is the reference's llama_batch packing (engine.py module doc:
     grpc-server.cpp:1671+ packs prompt chunks of all slots into one
@@ -390,10 +423,12 @@ def ragged_prefill(
     sampled (slot sentinel drops the engine's key/mu writes).
     """
     from localai_tpu.ops.ragged_prefill import ragged_prefill_attention
+    from localai_tpu.parallel.sharding import overlap_halves
 
     N = tokens.shape[0]
     B = seg_slots.shape[0]
-    sin, cos = rope_frequencies(cfg, positions[None, :])
+    rp = positions if rope_positions is None else rope_positions
+    sin, cos = rope_frequencies(cfg, rp[None, :])
     x = _embed_rows(params["embed"], tokens, cfg.dtype)[None]   # [1, N, D]
     # per-token target slot for the ragged KV scatter (pads ride the
     # clipped lookup; their position sentinel drops the write)
@@ -411,22 +446,35 @@ def ragged_prefill(
         # no-read-after-write rule as every other attention path here)
         if continued and _ragged_pallas_ok(lck, N, cfg):
             from localai_tpu.ops.pallas.ragged_prefill import (
-                ragged_prefill_attention_pallas)
+                ragged_kernel_plan, ragged_prefill_attention_pallas)
 
+            qb, pkb = ragged_kernel_plan(N, cfg.num_kv_heads, cfg.q_per_kv,
+                                         cfg.head_dim_)
             attn = ragged_prefill_attention_pallas(
                 q[0], k[0], v[0], lck["pages"], lcv["pages"], lck["ptab"],
                 seg_slots, seg_start, seg_off, seg_len, cfg.q_per_kv,
-                pkb=min(N, 128))
+                pkb=pkb, qb=qb)
         else:
             attn = ragged_prefill_attention(
                 q[0], k[0], v[0], seg_of, seg_slots, seg_start, lck, lcv,
                 cfg.q_per_kv, continued=continued)
         ck = kvcache.scatter_ragged(ck, li, slot_of, positions, k[0])
         cv = kvcache.scatter_ragged(cv, li, slot_of, positions, v[0])
-        x = x + jnp.einsum("bth,hd->btd", attn[None].reshape(1, N, -1),
-                           _mat(layer["wo"], x.dtype))
-        h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, layer)
+        attn_r = attn[None].reshape(1, N, -1)
+
+        def out_proj(t):
+            return jnp.einsum("bth,hd->btd", t, _mat(layer["wo"], x.dtype))
+
+        def mlp_half(t):
+            return _mlp(rms_norm(t, layer["mlp_norm"], cfg.rms_norm_eps),
+                        layer)
+
+        if comm_overlap:
+            x = x + overlap_halves(out_proj, attn_r, axis=1)
+            x = x + overlap_halves(mlp_half, x, axis=1)
+        else:
+            x = x + out_proj(attn_r)
+            x = x + mlp_half(x)
         return (x, ck, cv), None
 
     layers = dict(params["layers"])

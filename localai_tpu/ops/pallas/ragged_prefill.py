@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: RAGGED PACKED PREFILL attention.
+"""Pallas TPU kernel: RAGGED PACKED PREFILL attention, segment-blocked.
 
 The packed prefill step (ops/ragged_prefill.py has the semantics and the
 jnp fallback) feeds one [N]-token batch holding the prompt tails of up
@@ -6,30 +6,44 @@ to B slots; each token attends its slot's committed cache PAGES plus the
 pack's own keys causally within its segment. A naive XLA lowering
 gathers every segment's dense [C] row window per layer; this kernel
 walks the pages IN PLACE, the same way ops/pallas/paged_attention.py
-does for decode:
+does for decode.
 
-  * Grid (B, MP + NKB): for each segment, MP page-table entries of its
-    slot's committed prefix, then NKB blocks of the pack's own keys.
+The grid blocks QUERIES PER SEGMENT (Ragged Paged Attention style)
+instead of keeping the whole pack's query rows resident:
+
+  * Grid (NQB, B, MP + NKB): for each QB-row query block, sweep every
+    segment's MP committed page-table entries, then the NKB blocks of
+    the pack's own keys.
   * The page table and the per-segment metadata (slot, start, offset,
     length) are SCALAR-PREFETCH arguments consumed by the K/V BlockSpec
     index maps — the pipeline knows page j+1's physical address while
-    page j computes, and entries past the segment's last committed page
-    revisit it (no DMA), so short prefixes cost ~their own length in
-    HBM reads.
-  * Online softmax across the whole walk (m/l/acc VMEM scratch over all
-    N*G query rows, reset per segment); each segment's rows of the
-    shared [N] output are masked-merged at its final grid step, so the
-    output block stays VMEM-resident for the entire grid.
+    page j computes. Entries past a segment's last committed page, and
+    every (q-block, segment) pair that does not overlap, clamp to a
+    constant block so consecutive skipped steps revisit (no DMA), and
+    their compute is predicated off entirely (``pl.when``).
+  * Online softmax per q-block (m/l/acc VMEM scratch over QB*G query
+    rows). Each query row belongs to exactly one segment and every
+    other segment's scores are fully masked for it, so the accumulator
+    runs across the whole (segment, kv-step) sweep without per-segment
+    resets; the q-block's output is written once at the final step.
+
+Scratch is therefore INDEPENDENT of the pack size N — the old
+whole-pack layout hit a VMEM wall at ~1k packed tokens for 8B head
+shapes (KV=8, G=4, hd=128) and fell back to the jnp scan exactly where
+packing matters most. ``ragged_kernel_plan`` below is the single
+source of truth for the blocking and for "does this pack stay on the
+kernel path", shared by models/llama.py and the engine's fallback
+counter.
 
 Plain float paged caches only (the int8 paged prefill folds scales
-through the jnp fallback); VMEM bounds the pack bucket — the caller
-(models/llama.py) falls back to the jnp path for packs whose per-head
-scratch would not fit.
+through the jnp fallback).
 """
 
 from __future__ import annotations
 
 import functools
+import math
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,98 +52,142 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Per-q-block f32 scratch budget (m + l + acc over QB*G rows). QB tops
+# out at 128, so this never binds for transformer shapes; it guards
+# pathological configs rather than pack length.
+_VMEM_SCRATCH_BUDGET = 8 * 1024 * 1024
+
+
+def ragged_kernel_plan(N: int, kv_heads: int, q_per_kv: int,
+                       head_dim: int) -> Optional[Tuple[int, int]]:
+    """Blocking plan ``(qb, pkb)`` for an N-token pack, or None when the
+    pack cannot run on the kernel path.
+
+    ``qb`` (query block) and ``pkb`` (pack-key block) are the largest
+    power of two <= 128 dividing N — gcd with 128, so power-of-two pack
+    buckets get full 128-row MXU tiles and any other N still divides
+    cleanly. Scratch is per-q-block (independent of N): the plan only
+    fails for configs whose PER-BLOCK scratch exceeds VMEM, not for
+    long packs — the ~1k-token cliff of the whole-pack layout is gone.
+    """
+    if N <= 0:
+        return None
+    qb = math.gcd(N, 128)
+    scratch = kv_heads * qb * q_per_kv * (head_dim + 2) * 4
+    if scratch > _VMEM_SCRATCH_BUDGET:
+        return None
+    return qb, qb
+
 
 def _kernel(ptab_ref, slots_ref, start_ref, off_ref, len_ref,
             q_ref, ck_ref, cv_ref, kp_ref, vp_ref,
-            out_ref, m_ref, l_ref, acc_ref, *, mp: int, pkb: int):
-    """One (segment, key-block) program. q [N, KV, G, hd]; ck/cv pack
-    keys [PKB, KV, hd]; kp/vp one page [1, Pg, KV, hd]."""
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    nj = pl.num_programs(1)
-    N, kv_heads, G, hd = q_ref.shape
+            out_ref, m_ref, l_ref, acc_ref, *, mp: int, pkb: int, qb: int):
+    """One (q-block, segment, kv-step) program. q [QB, KV, G, hd];
+    ck/cv pack keys [PKB, KV, hd]; kp/vp one page [1, Pg, KV, hd]."""
+    i = pl.program_id(0)
+    b = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(1)
+    nj = pl.num_programs(2)
+    _, kv_heads, G, hd = q_ref.shape
     pg = kp_ref.shape[1]
     start = start_ref[b]
     off = off_ref[b]
     length = len_ref[b]
+    q_lo = i * qb
 
     @pl.when((b == 0) & (j == 0))
-    def _zero_out():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    @pl.when(j == 0)
     def _reset():
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    scale = jax.lax.rsqrt(jnp.float32(hd))
-    # query-row index n for each of the N*G flattened rows
-    n_of_row = jax.lax.broadcasted_iota(jnp.int32, (N * G, 1), 0) // G
+    # global query index n for each of the QB*G flattened rows
+    n_of_row = q_lo + \
+        jax.lax.broadcasted_iota(jnp.int32, (qb * G, 1), 0) // G
     in_seg_row = (n_of_row >= off) & (n_of_row < off + length)
 
-    for h in range(kv_heads):
-        qf = q_ref[:, h].astype(jnp.float32).reshape(N * G, hd) * scale
-        if_page = j < mp
-        # both regions compute with the SAME [N*G, BLK] shape so the
-        # online update below is region-agnostic; pkb == pg is not
-        # required — the two score blocks are masked independently
-        k_page = kp_ref[0, :, h, :].astype(jnp.float32)       # [Pg, hd]
-        s_page = jax.lax.dot_general(
-            qf, k_page, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [N*G, Pg]
-        col = jax.lax.broadcasted_iota(jnp.int32, s_page.shape, 1) + j * pg
-        mask_page = in_seg_row & (col < start) & if_page
-        s_page = jnp.where(mask_page, s_page, _NEG_INF)
+    # does this (q-block, segment, kv-step) contribute anything? A
+    # skipped step is exact: all its scores would mask to -inf, so
+    # m/l/acc are unchanged (alpha == 1, probs == 0).
+    seg_hit = (length > 0) & (off < q_lo + qb) & (off + length > q_lo)
+    if_page = j < mp
+    pk_lo = (j - mp) * pkb
+    need = seg_hit & jnp.where(
+        if_page,
+        j * pg < start,
+        (pk_lo < off + length) & (pk_lo + pkb > off) & (pk_lo < q_lo + qb))
 
-        k_pack = ck_ref[:, h, :].astype(jnp.float32)          # [PKB, hd]
-        s_pack = jax.lax.dot_general(
-            qf, k_pack, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [N*G, PKB]
-        midx = jax.lax.broadcasted_iota(jnp.int32, s_pack.shape, 1) \
-            + (j - mp) * pkb
-        mask_pack = in_seg_row & (midx >= off) & (midx < off + length) \
-            & (midx <= n_of_row) & jnp.logical_not(if_page)
-        s_pack = jnp.where(mask_pack, s_pack, _NEG_INF)
-
-        scores = jnp.concatenate([s_page, s_pack], axis=1)
-        masked = jnp.concatenate([mask_page, mask_pack], axis=1)
-        m_prev = m_ref[h]                                     # [N*G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        # explicit zero where masked: an all-masked block has
-        # m == _NEG_INF and exp(score - m) would be exp(0) == 1
-        probs = jnp.where(masked, jnp.exp(scores - m_new), 0.0)
-        l_ref[h] = l_ref[h] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-        v_page = vp_ref[0, :, h, :].astype(jnp.float32)
-        v_pack = cv_ref[:, h, :].astype(jnp.float32)
-        v_all = jnp.concatenate([v_page, v_pack], axis=0)
-        acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
-            probs, v_all, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[h] = m_new
-
-    @pl.when(j == nj - 1)
-    def _finish():
+    @pl.when(need)
+    def _compute():
+        scale = jax.lax.rsqrt(jnp.float32(hd))
         for h in range(kv_heads):
-            denom = l_ref[h] + (l_ref[h] == 0.0)              # pad rows: 0/1
-            res = (acc_ref[h] / denom).reshape(N, G, hd)
-            out_ref[:, h] = jnp.where(in_seg_row.reshape(N, G, 1),
-                                      res.astype(out_ref.dtype),
-                                      out_ref[:, h])
+            qf = q_ref[:, h].astype(jnp.float32).reshape(qb * G, hd) * scale
+            # both regions compute with the SAME [QB*G, BLK] shape so
+            # the online update below is region-agnostic; pkb == pg is
+            # not required — the two score blocks mask independently
+            k_page = kp_ref[0, :, h, :].astype(jnp.float32)       # [Pg, hd]
+            s_page = jax.lax.dot_general(
+                qf, k_page, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)               # [QB*G, Pg]
+            col = jax.lax.broadcasted_iota(jnp.int32, s_page.shape, 1) \
+                + j * pg
+            mask_page = in_seg_row & (col < start) & if_page
+            s_page = jnp.where(mask_page, s_page, _NEG_INF)
+
+            k_pack = ck_ref[:, h, :].astype(jnp.float32)          # [PKB, hd]
+            s_pack = jax.lax.dot_general(
+                qf, k_pack, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)               # [QB*G, PKB]
+            midx = jax.lax.broadcasted_iota(jnp.int32, s_pack.shape, 1) \
+                + pk_lo
+            mask_pack = in_seg_row & (midx >= off) & (midx < off + length) \
+                & (midx <= n_of_row) & jnp.logical_not(if_page)
+            s_pack = jnp.where(mask_pack, s_pack, _NEG_INF)
+
+            scores = jnp.concatenate([s_page, s_pack], axis=1)
+            masked = jnp.concatenate([mask_page, mask_pack], axis=1)
+            m_prev = m_ref[h]                                     # [QB*G, 1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(scores, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            # explicit zero where masked: an all-masked row has
+            # m == _NEG_INF and exp(score - m) would be exp(0) == 1
+            probs = jnp.where(masked, jnp.exp(scores - m_new), 0.0)
+            l_ref[h] = l_ref[h] * alpha \
+                + jnp.sum(probs, axis=-1, keepdims=True)
+            v_page = vp_ref[0, :, h, :].astype(jnp.float32)
+            v_pack = cv_ref[:, h, :].astype(jnp.float32)
+            v_all = jnp.concatenate([v_page, v_pack], axis=0)
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                probs, v_all, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h] = m_new
+
+    @pl.when((b == nb - 1) & (j == nj - 1))
+    def _finish():
+        # every row accumulated only from its own segment (other
+        # segments masked it); rows in no segment have l == 0 -> 0
+        for h in range(kv_heads):
+            denom = l_ref[h] + (l_ref[h] == 0.0)                  # pad: 0/1
+            out_ref[:, h] = (acc_ref[h] / denom).reshape(
+                qb, G, hd).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("q_per_kv", "pkb", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("q_per_kv", "pkb", "qb", "interpret"))
 def ragged_prefill_attention_pallas(q, chunk_k, chunk_v, pages_k, pages_v,
                                     ptab, seg_slots, seg_start, seg_off,
                                     seg_len, q_per_kv: int, pkb: int = 128,
+                                    qb: Optional[int] = None,
                                     interpret: bool = False):
     """q: [N, H, hd]; chunk_k/chunk_v: [N, KV, hd] (this pack's keys, not
     yet scattered); pages_k/v: [n_pages, page_size, KV, hd] single-layer
     page pool; ptab: [S, MP] int32 (sentinel n_pages = unallocated);
     seg_slots/seg_start/seg_off/seg_len: [B] int32 segment tables (pad
-    segments: seg_len == 0). ``pkb`` (pack-key block, must divide N)
-    trades grid steps against VMEM. Returns [N, H, hd] (q.dtype);
+    segments: seg_len == 0). ``pkb`` (pack-key block) and ``qb`` (query
+    block, default ``gcd(N, 128)``) must divide N; use
+    ``ragged_kernel_plan`` to pick both. Returns [N, H, hd] (q.dtype);
     semantics match ops/ragged_prefill.py::ragged_prefill_attention over
     a paged cache."""
     N, H, hd = q.shape
@@ -137,45 +195,61 @@ def ragged_prefill_attention_pallas(q, chunk_k, chunk_v, pages_k, pages_v,
     mp = ptab.shape[1]
     B = seg_slots.shape[0]
     G = q_per_kv
-    assert N % pkb == 0, (N, pkb)
+    if qb is None:
+        qb = math.gcd(N, 128)
+    assert N % pkb == 0 and N % qb == 0, (N, pkb, qb)
     nkb = N // pkb
+    nqb = N // qb
     qg = q.reshape(N, kv_heads, G, hd)
 
-    def page_map(b, j, ptab_ref, slots_ref, start_ref, off_ref, len_ref):
-        # pages past the segment's last committed one revisit it (no
-        # DMA); segments with no committed prefix clamp to physical
-        # page 0 — their scores are fully masked (col < 0 never holds)
+    def _seg_hit(i, b, off_ref, len_ref):
+        q_lo = i * qb
+        return (len_ref[b] > 0) & (off_ref[b] < q_lo + qb) \
+            & (off_ref[b] + len_ref[b] > q_lo)
+
+    def q_map(i, b, j, *refs):
+        return (i, 0, 0, 0)
+
+    def page_map(i, b, j, ptab_ref, slots_ref, start_ref, off_ref, len_ref):
+        # pages past the segment's last committed one — and every page
+        # of a (q-block, segment) pair with no overlap — clamp to a
+        # constant so consecutive skipped steps revisit (no DMA);
+        # their compute is predicated off in the kernel
         n_valid = (start_ref[b] + pg - 1) // pg
         last = jnp.maximum(n_valid - 1, 0)
         pid = ptab_ref[slots_ref[b], jnp.minimum(jnp.minimum(j, mp - 1),
                                                  last)]
-        return (jnp.clip(pid, 0, n_pages - 1), 0, 0, 0)
+        hit = _seg_hit(i, b, off_ref, len_ref) & (j * pg < start_ref[b])
+        return (jnp.where(hit, jnp.clip(pid, 0, n_pages - 1), 0), 0, 0, 0)
 
-    def pack_map(b, j, *refs):
-        return (jnp.clip(j - mp, 0, nkb - 1), 0, 0)
-
-    def whole(b, j, *refs):
-        return (0, 0, 0, 0)
+    def pack_map(i, b, j, ptab_ref, slots_ref, start_ref, off_ref, len_ref):
+        blk = jnp.clip(j - mp, 0, nkb - 1)
+        q_lo = i * qb
+        lo, hi = off_ref[b], off_ref[b] + len_ref[b]
+        pk_lo = blk * pkb
+        hit = _seg_hit(i, b, off_ref, len_ref) & (j >= mp) \
+            & (pk_lo < hi) & (pk_lo + pkb > lo) & (pk_lo < q_lo + qb)
+        return (jnp.where(hit, blk, 0), 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,      # ptab, seg_slots, seg_start/off/len
-        grid=(B, mp + nkb),
+        grid=(nqb, B, mp + nkb),
         in_specs=[
-            pl.BlockSpec((N, kv_heads, G, hd), whole),
+            pl.BlockSpec((qb, kv_heads, G, hd), q_map),
             pl.BlockSpec((pkb, kv_heads, hd), pack_map),
             pl.BlockSpec((pkb, kv_heads, hd), pack_map),
             pl.BlockSpec((1, pg, kv_heads, hd), page_map),
             pl.BlockSpec((1, pg, kv_heads, hd), page_map),
         ],
-        out_specs=pl.BlockSpec((N, kv_heads, G, hd), whole),
+        out_specs=pl.BlockSpec((qb, kv_heads, G, hd), q_map),
         scratch_shapes=[
-            pltpu.VMEM((kv_heads, N * G, 1), jnp.float32),    # running max
-            pltpu.VMEM((kv_heads, N * G, 1), jnp.float32),    # running denom
-            pltpu.VMEM((kv_heads, N * G, hd), jnp.float32),   # running out
+            pltpu.VMEM((kv_heads, qb * G, 1), jnp.float32),    # running max
+            pltpu.VMEM((kv_heads, qb * G, 1), jnp.float32),    # running denom
+            pltpu.VMEM((kv_heads, qb * G, hd), jnp.float32),   # running out
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, mp=mp, pkb=pkb),
+        functools.partial(_kernel, mp=mp, pkb=pkb, qb=qb),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N, kv_heads, G, hd), q.dtype),
         interpret=interpret,

@@ -116,6 +116,30 @@ def ragged_seg_spec() -> P:
     return P(None)
 
 
+def overlap_halves(fn, x, axis: int = 1):
+    """TokenWeave-style compute/communication overlap: apply ``fn`` to
+    the two halves of ``x`` along ``axis`` independently and concatenate.
+
+    A row-wise fn whose chain ends in a contraction-sharded matmul (wo,
+    w_down — the psum producers above) becomes two INDEPENDENT
+    matmul + all-reduce chains; XLA's latency-hiding scheduler overlaps
+    half A's all-reduce with half B's matmul, recovering most of the
+    collective time that a single full-batch chain serializes
+    (TokenWeave, PAPERS.md). Bit-exact by construction: slicing the
+    token axis changes neither any row's operands nor its reduction
+    order, so greedy outputs are byte-identical with the overlap on or
+    off. Token axes shorter than 2 rows fall through to one call."""
+    import jax.numpy as jnp
+
+    n = x.shape[axis]
+    if n < 2:
+        return fn(x)
+    h = n // 2
+    a = jax.lax.slice_in_dim(x, 0, h, axis=axis)
+    b = jax.lax.slice_in_dim(x, h, n, axis=axis)
+    return jnp.concatenate([fn(a), fn(b)], axis=axis)
+
+
 def fit_spec(mesh: Mesh, shape, spec: P) -> P:
     """Drop (replicate) any spec axis whose dimension the mesh degree
     does not divide — e.g. a 258-row test vocab on tp=8. Every case the

@@ -6,7 +6,9 @@
 #
 # Fails (rc != 0) if either stage fails. Environment knobs:
 #   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
-#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 300 here)
+#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 560 here —
+#                             the packed phase runs three fuse modes plus
+#                             the >1k-token long-pack gate since ISSUE 11)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #
@@ -23,7 +25,7 @@ scripts/run_tier1.sh
 
 echo "== ci: bench smoke =="
 smoke_out=$(mktemp)
-LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-300}" \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-560}" \
     python bench.py --smoke | tee "$smoke_out"
 
 echo "== ci: tracked =="
@@ -39,6 +41,19 @@ pp = line.get("packed_prefill") or {}
 print(f"TTFT_LOADED_UNLOADED_RATIO={line.get('ttft_loaded_unloaded_ratio')} "
       f"packed_vs_sequential_speedup={pp.get('ttft_speedup')} "
       f"greedy_match={pp.get('greedy_match')}")
+# segment-blocked kernel gate (ISSUE 11): the long-prompt phase packs
+# >1k tokens per dispatch and must stay on the kernel plan — any shape
+# fallback is the old VMEM cliff coming back. Plus the early-emit
+# split's first-token recovery: fused loaded p50 TTFT vs fuse=0.
+print(f"PACK_KERNEL_FALLBACKS={pp.get('longpack_fallbacks')} "
+      f"longpack_max_bucket={pp.get('longpack_max_bucket')} "
+      f"longpack_match={pp.get('longpack_match')}")
+print(f"FUSED_TTFT_MS={pp.get('fused_ttft_ms')} "
+      f"UNFUSED_TTFT_MS={pp.get('unfused_ttft_ms')}")
+if pp and pp.get("longpack_fallbacks") != 0:
+    print(f"FAIL: long-pack phase left the kernel path "
+          f"(fallbacks={pp.get('longpack_fallbacks')})")
+    sys.exit(1)
 # host-loop vs device-time decomposition from the span tracer (this is
 # the 505-vs-809 tok/s gap, measured — track it across rounds), for the
 # event-driven emitter path AND the in-loop emitter=0 path (ISSUE 9):

@@ -178,14 +178,15 @@ class _Tok:
         return [chr(97 + (i % 26)) for i in ids]
 
 
-def _engine(cfg, params, packed, mesh=None, slots=4, ctx=128, **kw):
+def _engine(cfg, params, packed, mesh=None, slots=4, ctx=128, draft=None,
+            **kw):
     e = eng.Engine(
         cfg, params, _Tok(),
         eng.EngineConfig(num_slots=slots, max_context=ctx,
                          prefill_buckets=(16, 64), prefill_chunk=32,
                          cache_dtype=jnp.float32, kv_layout="paged",
                          kv_page_size=16, prefill_packed=packed, **kw),
-        mesh=mesh)
+        mesh=mesh, draft=draft)
     e.start()
     return e
 
@@ -378,6 +379,233 @@ def test_packed_mesh_parity(tiny_cfg_params):
     assert got == ref
 
 
+# ---------- ISSUE 11: segment-blocked kernel + early-emit + overlap ----------
+
+def test_ragged_kernel_plan_long_packs():
+    """Long packs STAY on the kernel path at 8B head shapes (KV=8, G=4,
+    hd=128): the plan's scratch is per-q-block, so pack length never
+    disqualifies — only pathological per-block widths do."""
+    from localai_tpu.ops.pallas.ragged_prefill import ragged_kernel_plan
+
+    for N in (1024, 1152, 2048, 4096):
+        plan = ragged_kernel_plan(N, 8, 4, 128)
+        assert plan is not None, N
+        qb, pkb = plan
+        assert N % qb == 0 and N % pkb == 0 and qb <= 128
+    assert ragged_kernel_plan(2048, 8, 4, 128) == (128, 128)
+    assert ragged_kernel_plan(0, 8, 4, 128) is None
+    # only PER-BLOCK scratch can disqualify (pathological head widths)
+    assert ragged_kernel_plan(1024, 64, 8, 1024) is None
+
+
+def test_ragged_kernel_shape_fallback_predicate():
+    """The engine's fallback counter predicate: SHAPE-driven only —
+    static layout/dtype choices (contiguous, int8) route to jnp by
+    design and must NOT count, or the CI zero-fallback gate is noise."""
+    big = llama.LlamaConfig(
+        vocab_size=32, hidden_size=512 * 1024, intermediate_size=64,
+        num_layers=1, num_heads=512, num_kv_heads=64,
+        max_position_embeddings=64)
+    small = llama.LlamaConfig(
+        vocab_size=32, hidden_size=64, intermediate_size=64,
+        num_layers=1, num_heads=4, num_kv_heads=2,
+        max_position_embeddings=64)
+    pc = kvcache.init_paged((1, 2, 32, 2, 16), jnp.float32, 8)
+    qc = kvcache.init_paged((1, 2, 32, 2, 16), jnp.int8, 8)
+    cc = kvcache.init((1, 2, 32, 2, 16), jnp.float32)
+    assert llama.ragged_kernel_shape_fallback(pc, 64, small) is False
+    assert llama.ragged_kernel_shape_fallback(pc, 1024, big) is True
+    assert llama.ragged_kernel_shape_fallback(qc, 1024, big) is False
+    assert llama.ragged_kernel_shape_fallback(cc, 1024, big) is False
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.int8])
+def test_long_pack_parity_vs_per_slot(dtype):
+    """>1k packed tokens (the old whole-pack layout's VMEM cliff): the
+    segment-blocked kernel (interpret mode) == the jnp packed reference
+    == the per-slot references. int8 pages run the jnp path only (the
+    kernel is plain-float by design)."""
+    from localai_tpu.ops.attention import mixed_prefill_attention
+    from localai_tpu.ops.pallas.ragged_prefill import (
+        ragged_kernel_plan, ragged_prefill_attention_pallas)
+    from localai_tpu.ops.ragged_prefill import ragged_prefill_attention
+
+    rng = np.random.default_rng(11)
+    S, C, KV, G, hd, pgs = 4, 64, 2, 2, 16, 16
+    N = 1152  # > 1k, not a power of two: qb == gcd(N, 128) == 128
+    lc = _paged_layer((1, S, C, KV, hd), dtype, pgs, rng)
+    segs = [(0, 1, 40, 0, 500), (1, 3, 0, 500, 380), (2, 0, 17, 880, 260)]
+    seg_of, seg_slots, seg_start, seg_off, seg_len = _pack_meta(
+        C, N, S, segs)
+    q = jnp.asarray(rng.normal(size=(N, KV * G, hd)).astype(np.float32))
+    ck = jnp.asarray(rng.normal(size=(N, KV, hd)).astype(np.float32))
+    cv = jnp.asarray(rng.normal(size=(N, KV, hd)).astype(np.float32))
+    ref = ragged_prefill_attention(q, ck, cv, seg_of, seg_slots, seg_start,
+                                   lc, lc, G, continued=True)
+    for b, slot, start, off, ln in segs:
+        k_rows = kvcache.gather_layer_rows(lc, jnp.asarray([slot]))
+        sref = mixed_prefill_attention(
+            q[off:off + ln][None], ck[off:off + ln][None],
+            cv[off:off + ln][None], k_rows, k_rows,
+            jnp.asarray([start]), jnp.asarray([ln]), G)[0]
+        np.testing.assert_allclose(np.asarray(ref[off:off + ln]),
+                                   np.asarray(sref), atol=3e-4)
+    if dtype == jnp.int8:
+        return  # kernel path is plain-float; jnp vs per-slot was the pin
+    plan = ragged_kernel_plan(N, KV, G, hd)
+    assert plan == (128, 128)
+    out = ragged_prefill_attention_pallas(
+        q, ck, cv, lc["pages"], lc["pages"], lc["ptab"], seg_slots,
+        seg_start, seg_off, seg_len, G, pkb=plan[1], qb=plan[0],
+        interpret=True)
+    real = np.asarray(seg_of) < S
+    np.testing.assert_allclose(np.asarray(out)[real],
+                               np.asarray(ref)[real], atol=3e-4)
+
+
+def test_split_early_emit_default_and_parity(tiny_cfg_params, engine_pair):
+    """prefill_packed_fuse=auto now resolves to the EARLY-EMIT split on
+    every platform: the head program actually ran on the shared packed
+    engine, an explicit split engine stays byte-identical to the
+    per-slot path, and the shape-fallback counter stays 0 (every CPU
+    test pack has a kernel plan)."""
+    cfg, params = tiny_cfg_params
+    e0, e1 = engine_pair
+    assert e1.metrics()["prefill_packed_fuse"] == "split"
+    assert any(isinstance(k, tuple) and k[0] == "packed_head"
+               for k in e1._final_fns), "split head never compiled"
+    assert e1.metrics()["packed_prefill"]["kernel_fallback"] == 0
+    prompts = _mixed_prompts(np.random.default_rng(21))
+    ref = _run_wave(e0, prompts, n=24)
+    e2 = _engine(cfg, params, packed=True, prefill_packed_fuse="split")
+    try:
+        got = _run_wave(e2, prompts, n=24)
+        assert e2.metrics()["packed_prefill"]["kernel_fallback"] == 0
+    finally:
+        e2.shutdown()
+    assert got == ref
+
+
+def test_kernel_fallback_counter_plumbing(tiny_cfg_params, monkeypatch):
+    """A continued pack whose shape has no kernel plan increments
+    metrics()["packed_prefill"]["kernel_fallback"] (the predicate is
+    consulted once per continued packed dispatch)."""
+    cfg, params = tiny_cfg_params
+    e = _engine(cfg, params, packed=True)
+    try:
+        _run_wave(e, _mixed_prompts(np.random.default_rng(22)))
+        assert e.metrics()["packed_prefill"]["kernel_fallback"] == 0
+        calls = []
+        monkeypatch.setattr(llama, "ragged_kernel_shape_fallback",
+                            lambda *a: calls.append(a) or True)
+        _run_wave(e, _mixed_prompts(np.random.default_rng(23)))
+        assert calls, "no continued pack consulted the predicate"
+        assert e.metrics()["packed_prefill"]["kernel_fallback"] >= len(calls)
+    finally:
+        e.shutdown()
+
+
+def test_packed_spec_slots_parity(tiny_cfg_params):
+    """Spec-eligible slots now pack (ISSUE 11 lifted the exclusion): a
+    draft-equipped packed engine stays byte-identical to the unpacked
+    draft engine, and the packed draft-cache mirror actually compiled."""
+    cfg, params = tiny_cfg_params
+    draft_params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    prompts = _mixed_prompts(np.random.default_rng(24))
+    e0 = _engine(cfg, params, packed=False, draft=(cfg, draft_params),
+                 n_draft=3)
+    try:
+        ref = _run_wave(e0, prompts, n=16)
+    finally:
+        e0.shutdown()
+    e1 = _engine(cfg, params, packed=True, draft=(cfg, draft_params),
+                 n_draft=3)
+    try:
+        got = _run_wave(e1, prompts, n=16)
+        assert any(isinstance(k, tuple) and k[0] == "draft_packed"
+                   for k in e1._chunk_fns), "draft mirror never compiled"
+        assert e1.metrics()["packed_prefill"]["dispatches"] > 0
+    finally:
+        e1.shutdown()
+    assert got == ref
+
+
+def test_overlap_halves_unit():
+    """overlap_halves is bit-exact for any row-wise fn: slicing the
+    token axis changes no operand and no reduction order."""
+    from localai_tpu.parallel.sharding import overlap_halves
+
+    rng = np.random.default_rng(30)
+    w = jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32))
+
+    def fn(t):
+        return jnp.einsum("bnd,df->bnf", t, w)
+
+    for n in (1, 2, 7, 64):  # n < 2 falls through to one call
+        x = jnp.asarray(rng.normal(size=(2, n, 16)).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(overlap_halves(fn, x, axis=1)), np.asarray(fn(x)))
+
+
+def test_comm_overlap_forced_greedy_parity(tiny_cfg_params, engine_pair):
+    """comm_overlap=1 (forced on, no mesh) keeps greedy output
+    byte-identical — the halved-pack layer body is exact, not an
+    approximation; auto stays OFF without a mesh."""
+    cfg, params = tiny_cfg_params
+    e0, e1 = engine_pair
+    assert e1._comm_overlap is False  # auto + no mesh
+    prompts = _mixed_prompts(np.random.default_rng(31))
+    ref = _run_wave(e0, prompts)
+    e2 = _engine(cfg, params, packed=True, comm_overlap="1")
+    try:
+        assert e2._comm_overlap is True
+        got = _run_wave(e2, prompts)
+    finally:
+        e2.shutdown()
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_comm_overlap_mesh_parity(tiny_cfg_params):
+    """comm_overlap auto (meshed -> ON) vs 0 on the 8-device dryrun
+    mesh (dp=2, tp=4): greedy byte parity with the overlap engaged."""
+    from localai_tpu.parallel import mesh as meshlib
+    from localai_tpu.parallel.sharding import shard_params
+
+    cfg, params = tiny_cfg_params
+    mesh = meshlib.make_mesh(meshlib.MeshPlan(dp=2, tp=4),
+                             devices=jax.devices()[:8])
+    prompts = [p[:24] for p in _mixed_prompts(np.random.default_rng(32))][:4]
+    outs = {}
+    for co in ("0", "auto"):
+        sharded = shard_params(mesh, params, cfg.tie_word_embeddings)
+        e = _engine(cfg, sharded, packed=True, mesh=mesh, slots=4,
+                    comm_overlap=co)
+        try:
+            assert e._comm_overlap is (co == "auto")
+            outs[co] = _run_wave(e, prompts, n=6)
+        finally:
+            e.shutdown()
+    assert outs["auto"] == outs["0"]
+
+
+def test_burst_share_weighted():
+    """Decode-burst DRR shaping (PR-10 follow-up): neutral whenever no
+    STRICTLY higher class has prefill work pending, weighted shrink
+    when one does."""
+    from localai_tpu.engine.scheduler import Scheduler
+
+    s = Scheduler()  # weights 4:2:1
+    assert s.burst_share(None, [0, 0, 0], 8) == 8  # nothing decoding
+    assert s.burst_share(1, [0, 0, 0], 8) == 8     # nothing pending
+    assert s.burst_share(0, [0, 4, 2], 8) == 8     # only lower pending
+    assert s.burst_share(1, [0, 3, 0], 8) == 8     # same class pending
+    assert s.burst_share(2, [1, 0, 0], 1) == 1     # cap floor
+    assert s.burst_share(2, [1, 0, 0], 8) == 1     # 8*1 // (1+4)
+    assert s.burst_share(1, [2, 0, 0], 8) == 2     # 8*2 // (2+4)
+    assert s.burst_share(2, [0, 1, 0], 8) == 2     # 8*1 // (1+2)
+
+
 # ---------- knobs + telemetry ----------
 
 def test_packed_knobs_validate():
@@ -390,6 +618,13 @@ def test_packed_knobs_validate():
     assert any("prefill_packed" in p for p in bad.validate())
     bad2 = ModelConfig(name="m", options=["prefill_token_budget=-1"])
     assert any("prefill_token_budget" in p for p in bad2.validate())
+    ok2 = ModelConfig(name="m", options=["prefill_packed_fuse=split",
+                                         "comm_overlap=auto"])
+    assert ok2.validate() == []
+    bad3 = ModelConfig(name="m", options=["prefill_packed_fuse=both"])
+    assert any("prefill_packed_fuse" in p for p in bad3.validate())
+    bad4 = ModelConfig(name="m", options=["comm_overlap=yes"])
+    assert any("comm_overlap" in p for p in bad4.validate())
 
 
 def test_ttft_metrics_exposition():
@@ -405,7 +640,9 @@ def test_ttft_metrics_exposition():
     m.set_gauge("ttft_samples", 42, 'model="x"')
     m.set_counter("prefill_packed_dispatches_total", 7, 'model="x"')
     m.set_counter("prefill_packed_tokens_total", 1234, 'model="x"')
+    m.set_counter("prefill_kernel_fallback_total", 3, 'model="x"')
     text = m.render()
+    assert 'localai_prefill_kernel_fallback_total{model="x"} 3' in text
     assert 'localai_ttft_queue_wait_p50_ms{model="x"} 12.5' in text
     assert 'localai_ttft_admit_to_first_p50_ms{model="x"} 80' in text
     assert 'localai_ttft_prefill_dispatch_p50_ms{model="x"} 30.5' in text
