@@ -1101,6 +1101,122 @@ def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
     return out
 
 
+def bench_slo(cfg, S, C, n_low=6, n_high=4, max_new=8):
+    """Per-class SLO burn-rate + violation flight-recorder scenario
+    (ISSUE 12), on ONE engine with a deliberately split objective:
+
+    * ``low`` gets an impossible 0.01 ms TTFT objective — every low
+      request MUST violate, so the 5m burn rate must exceed 1, a
+      rate-limited ``slo_burn`` event must fire, and the flight
+      recorder must land at least one dump (tagged with the low class)
+      on disk;
+    * ``high`` gets a loose 60 s objective — its samples must record
+      but with ZERO violations and a 0.0 burn (the alerting side must
+      not cry wolf on a healthy class).
+
+    Also stitches a synthetic frontend http span to the engine's span
+    ring with the same epoch-anchored shift /debug/trace uses (offset
+    is exactly 0 in-process), and checks one request id shows up under
+    BOTH pids of one valid merged JSON trace (``trace_merged``)."""
+    import tempfile
+
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.weights import random_params
+    from localai_tpu.services import tracing
+    from localai_tpu.services.eventlog import EVENTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(17)
+    plen = max(8, C // 8)
+    prompts = [rng.integers(0, 255, size=plen).tolist()
+               for _ in range(n_low + n_high)]
+
+    dump_dir = tempfile.mkdtemp(prefix="localai-slo-")
+    ecfg = eng.EngineConfig(num_slots=S, max_context=C,
+                            prefill_buckets=(32, 128),
+                            cache_dtype=jnp.float32,
+                            slo_ttft_ms="high=60000:low=0.01",
+                            stall_dump_dir=dump_dir)
+    engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
+                        eos_token_ids={cfg.vocab_size - 1})
+    engine.start(precompile=True)
+
+    def run_one(ids, priority):
+        req = eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=max_new, ignore_eos=True,
+            priority=priority,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+        o = engine.submit(req)
+        while True:
+            if o.get() is None:
+                break
+        return req.request_id
+
+    out = {"n_low": n_low, "n_high": n_high}
+    try:
+        EVENTS.clear()
+        rid0 = ""
+        for i in range(n_low):
+            rid = run_one(prompts[i], "low")
+            rid0 = rid0 or rid
+        for i in range(n_high):
+            run_one(prompts[n_low + i], "high")
+        # one metrics pull = the /metrics scrape: snapshots burn rates
+        # and emits the rate-limited slo_burn events
+        slo = engine.metrics().get("slo") or {}
+        low = ((slo.get("classes") or {}).get("low") or {}).get(
+            "ttft_ms") or {}
+        high = ((slo.get("classes") or {}).get("high") or {}).get(
+            "ttft_ms") or {}
+        out["burn_5m_low"] = low.get("burn_5m")
+        out["burn_5m_high"] = high.get("burn_5m")
+        out["violations_low"] = low.get("violations")
+        out["violations_high"] = high.get("violations")
+        evs = EVENTS.events()
+        out["violation_events"] = sum(
+            1 for e in evs if e["event"] == "slo_violation")
+        out["burn_events"] = sum(
+            1 for e in evs if e["event"] == "slo_burn")
+        dumps = sorted(f for f in os.listdir(dump_dir)
+                       if f.startswith("localai-flight-")
+                       and f.endswith(".json"))
+        out["flight_dumps"] = len(dumps)
+        out["flight_dump_low"] = False
+        if dumps:
+            with open(os.path.join(dump_dir, dumps[0])) as f:
+                doc = json.load(f)
+            out["flight_dump_low"] = any(
+                v.get("class") == "low"
+                for v in doc.get("violations") or [])
+
+        # ---- merged cross-process trace (the /debug/trace shift; the
+        # handshake offset is identically 0 for a same-process pair) ----
+        ft = tracing.RingTracer(size=64)
+        t1 = time.monotonic()
+        ft.record("http", "http", t1 - 0.005, t1, rid=rid0)
+        fdoc = tracing.chrome_trace(ft, pid=0, process_name="localai-http")
+        bdoc = engine.trace_events()
+        shift_us = (bdoc["localai"]["t0_epoch"]
+                    - fdoc["localai"]["t0_epoch"]) * 1e6
+        merged = list(fdoc["traceEvents"])
+        for evd in bdoc["traceEvents"]:
+            evd = dict(evd)
+            if evd.get("ph") != "M":
+                evd["ts"] = evd.get("ts", 0.0) + shift_us
+            merged.append(evd)
+        blob = json.dumps({"displayTimeUnit": "ms",
+                           "traceEvents": merged})
+        pids = {evd.get("pid")
+                for evd in json.loads(blob)["traceEvents"]
+                if (evd.get("args") or {}).get("request_id") == rid0}
+        out["trace_merged"] = int(len(pids) >= 2)
+    finally:
+        engine.shutdown()
+    return out
+
+
 def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
                     pressure=False):
     """Multi-turn shared-prefix scenario (PR 2 acceptance): N greedy
@@ -1593,6 +1709,64 @@ def _engine_direct_priority(deadline: float, partial: dict) -> dict:
     return out
 
 
+def _engine_direct_slo(deadline: float, partial: dict) -> dict:
+    """The per-class SLO burn-rate + flight-recorder scenario (ISSUE 12)
+    as a bench phase: tight low-class objective must burn and dump,
+    loose high-class must stay clean, one merged two-pid trace —
+    engine-direct in a subprocess on the CPU-safe smoke shape
+    (LOCALAI_BENCH_SLO_PRESET to override)."""
+    import subprocess
+
+    sl_preset = os.environ.get("LOCALAI_BENCH_SLO_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(sl_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": sl_preset,
+        "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_BENCH_DEADLINE_S": "0",
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--slo"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"ok": r.get("value"),
+                       "burn_5m_low": r.get("burn_5m_low"),
+                       "burn_5m_high": r.get("burn_5m_high"),
+                       "violations_low": r.get("violations_low"),
+                       "violations_high": r.get("violations_high"),
+                       "violation_events": r.get("violation_events"),
+                       "burn_events": r.get("burn_events"),
+                       "flight_dumps": r.get("flight_dumps"),
+                       "flight_dump_low": r.get("flight_dump_low"),
+                       "trace_merged": r.get("trace_merged")}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"slo_{k}": v for k, v in out.items()})
+    _emit_phase("slo", out)
+    return out
+
+
 def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     """The PR-2 acceptance scenario as a default-bench phase: multi-turn
     conversations under slot churn, prefix cache on vs off, in one
@@ -1782,7 +1956,8 @@ def main():
 
     if ("--engine" in sys.argv or "--kernel" in sys.argv
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
-            or "--chaos" in sys.argv or "--priority" in sys.argv):
+            or "--chaos" in sys.argv or "--priority" in sys.argv
+            or "--slo" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -1904,6 +2079,34 @@ def main():
             }))
             return
 
+        if "--slo" in sys.argv:
+            # per-class SLO burn + flight recorder (ISSUE 12): a tight
+            # low-class TTFT objective must burn and dump, a loose
+            # high-class one must stay clean, and the request id must
+            # survive into one merged two-pid trace
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(96, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_slo(cfg, S, C)
+            ok = (r.get("burn_5m_low") is not None
+                  and r.get("burn_5m_low") > 1.0
+                  and r.get("burn_5m_high") == 0.0
+                  and r.get("violations_low", 0) >= 1
+                  and r.get("violations_high") == 0
+                  and r.get("violation_events", 0) >= 1
+                  and r.get("flight_dumps", 0) >= 1
+                  and r.get("flight_dump_low") is True
+                  and r.get("trace_merged") == 1)
+            print(json.dumps({
+                "metric": f"slo_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", **r,
+            }))
+            return
+
         if "--kernel" in sys.argv:
             steps = int(os.environ.get("LOCALAI_BENCH_STEPS", "128"))
             inner = int(os.environ.get("LOCALAI_BENCH_INNER", "16"))
@@ -1964,12 +2167,16 @@ def main():
         # scripts/ci.sh gates on — finish_detect(emitter on) must beat
         # the polled in-loop path
         decomp_off = _engine_direct_decomp(deadline, partial, emitter=False)
+        # per-class SLO burn + flight recorder + merged trace (ISSUE 12,
+        # scripts/ci.sh SLO_BURN_5M/SLO_VIOLATIONS/TRACE_MERGED line)
+        slo = _engine_direct_slo(deadline, partial)
         ok = ("paged_tok_s" in layout_cmp
               and packed.get("greedy_match") is True
               and multiturn.get("greedy_match") is True
               and offload.get("greedy_match") is True
               and "host_device_decomp_ms" in decomp
-              and "host_device_decomp_ms" in decomp_off)
+              and "host_device_decomp_ms" in decomp_off
+              and slo.get("ok") == 1)
         print(json.dumps({
             "metric": "bench_smoke", "value": 1 if ok else 0, "unit": "ok",
             "kv_layout_compare": layout_cmp,
@@ -1994,6 +2201,13 @@ def main():
             "mfu": decomp.get("mfu"),
             "cold_bucket_detected": (decomp.get("cold_bucket")
                                      or {}).get("detected"),
+            # SLO burn + flight recorder (ISSUE 12): the tight low class
+            # must burn (>1) and dump; the loose high class must stay
+            # clean; one request id under both pids of the merged trace
+            "slo": slo,
+            "slo_burn_5m": slo.get("burn_5m_low"),
+            "slo_violations": slo.get("violations_low"),
+            "trace_merged": slo.get("trace_merged"),
         }))
         sys.exit(0 if ok else 1)
 
@@ -2017,6 +2231,7 @@ def main():
     offload_cmp = _engine_direct_offload(deadline, partial)
     chaos_cmp = _engine_direct_chaos(deadline, partial)
     priority_cmp = _engine_direct_priority(deadline, partial)
+    slo_cmp = _engine_direct_slo(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -2043,6 +2258,7 @@ def main():
                 "kv_offload_pressure": offload_cmp,
                 "chaos": chaos_cmp,
                 "priority": priority_cmp,
+                "slo": slo_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -2156,6 +2372,7 @@ def main():
         "kv_offload_pressure": offload_cmp,
         "chaos": chaos_cmp,
         "priority": priority_cmp,
+        "slo": slo_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

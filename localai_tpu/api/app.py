@@ -81,12 +81,33 @@ def error_response(e: ServingError) -> web.Response:
                              headers=headers)
 
 
+# observability surfaces excluded from per-request http spans: scrapes
+# and debug pulls would otherwise fill the frontend ring with their own
+# reads of it
+_TRACE_SKIP = re.compile(r"^/(metrics|debug/|healthz|readyz|static/)")
+
+
 def make_metrics_middleware():
+    import uuid
+
+    from localai_tpu.services.tracing import frontend_tracer
+
     @web.middleware
     async def metrics_middleware(request, handler):
         t0 = time.perf_counter()
+        # ONE trace context per request (ISSUE 12): minted here (or taken
+        # from X-Correlation-ID), read by every route via
+        # request["correlation_id"], propagated to the backend over
+        # localai-trace-id invocation metadata — both processes' spans
+        # share this id on the merged /debug/trace timeline.
+        rid = request.headers.get("X-Correlation-ID") or uuid.uuid4().hex
+        request["correlation_id"] = rid
+        t_mono = time.monotonic()
+        status = [0]
         try:
-            return await handler(request)
+            resp = await handler(request)
+            status[0] = resp.status
+            return resp
         finally:
             # label by the matched route PATTERN, not the raw path —
             # raw paths (job uuids, 404 probes) are unbounded-cardinality
@@ -94,6 +115,12 @@ def make_metrics_middleware():
             path = resource.canonical if resource else "unmatched"
             METRICS.observe_api_call(request.method, path,
                                      time.perf_counter() - t0)
+            tr = frontend_tracer()
+            if tr.enabled and not _TRACE_SKIP.match(request.path):
+                tr.record("http", "http", t_mono, time.monotonic(),
+                          rid=rid, args={"method": request.method,
+                                         "path": path,
+                                         "status": status[0] or 500})
     return metrics_middleware
 
 

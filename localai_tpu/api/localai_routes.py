@@ -194,7 +194,17 @@ _SYSOBS_GAUGES = ("xla_compile_seconds", "mfu", "goodput_tok_s",
 _SYSOBS_WATERMARKS = ("peak_queued", "peak_slots_active",
                       "peak_tokens_total", "peak_pool_active_pages",
                       "peak_pool_retained_pages", "peak_pool_pages_in_use",
-                      "peak_host_offloaded_pages", "peak_host_bytes")
+                      "peak_host_offloaded_pages", "peak_host_bytes",
+                      "peak_device_bytes_in_use")
+# device allocator stats (ISSUE 12 satellite): engine sysobs.device_mem
+# key -> localai_mem_device_<metric>; absent on CPU backends
+_DEVICE_MEM_GAUGES = (("bytes_in_use", "bytes_in_use"),
+                      ("peak_bytes_in_use", "peak_bytes_in_use"),
+                      ("bytes_limit", "bytes_limit"))
+# per-class SLO engine (ISSUE 12): burn-rate gauges per
+# (model, priority, metric, window) + violation totals, from engine
+# metrics()["slo"]; flight-recorder dump counters ride along
+_SLO_WINDOWS = (("burn_5m", "5m"), ("burn_1h", "1h"))
 
 
 def _refresh_engine_metrics(state):
@@ -220,6 +230,10 @@ def _refresh_engine_metrics(state):
               "queue_depth_class", "resume_queue_depth",
               *_SYSOBS_COUNTERS, *_SYSOBS_GAUGES,
               *(f"mem_{k}" for k in _SYSOBS_WATERMARKS),
+              *(f"mem_device_{m}" for _k, m in _DEVICE_MEM_GAUGES),
+              "slo_burn_rate", "slo_objective_ms", "slo_violations_total",
+              "slo_error_budget", "flight_dumps_total",
+              "flight_dumps_suppressed_total",
               "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
     # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
@@ -327,6 +341,42 @@ def _refresh_engine_metrics(state):
                 METRICS.set_gauge("mem_pool_frag_ratio",
                                   frag.get("ratio", 0.0),
                                   label_str(model=name))
+            # device allocator stats (ISSUE 12 satellite): real HBM
+            # numbers when the backend platform exposes memory_stats()
+            dm = so.get("device_mem")
+            if dm:
+                for skey, mkey in _DEVICE_MEM_GAUGES:
+                    if skey in dm:
+                        METRICS.set_gauge(f"mem_device_{mkey}", dm[skey],
+                                          label_str(model=name))
+        # per-class SLO engine (ISSUE 12): burn-rate gauges + violation
+        # counters per (priority class, metric); the flight recorder's
+        # dump/suppression totals ride the same pull
+        slo = stats.get("slo")
+        if slo:
+            METRICS.set_gauge("slo_error_budget",
+                              slo.get("error_budget", 0.0),
+                              label_str(model=name))
+            for cls, metrics_d in (slo.get("classes") or {}).items():
+                for metric, s in (metrics_d or {}).items():
+                    labels = label_str(model=name, priority=cls,
+                                       slo_metric=metric)
+                    METRICS.set_gauge("slo_objective_ms",
+                                      s.get("objective_ms", 0.0), labels)
+                    METRICS.set_counter("slo_violations_total",
+                                        s.get("violations", 0), labels)
+                    for skey, window in _SLO_WINDOWS:
+                        METRICS.set_gauge(
+                            "slo_burn_rate", s.get(skey, 0.0),
+                            label_str(model=name, priority=cls,
+                                      slo_metric=metric, window=window))
+        fr = stats.get("flight_recorder")
+        if fr:
+            METRICS.set_counter("flight_dumps_total", fr.get("dumps", 0),
+                                label_str(model=name))
+            METRICS.set_counter("flight_dumps_suppressed_total",
+                                fr.get("suppressed", 0),
+                                label_str(model=name))
         # per-span exemplars (ISSUE 8 satellite, closes the PR-6
         # follow-up): worst-since-last-pull observation per histogram,
         # tagged with its request correlation id
@@ -378,14 +428,29 @@ async def metrics(request):
 
 
 def _collect_traces(state) -> dict:
-    """Merge every loaded model's span ring into ONE Chrome trace JSON:
-    each backend becomes its own process (pid) with its slot/scheduler
-    tracks under it. Backends without GetTrace (fake/tts/...) and RPC
-    failures are skipped — a debug surface must never 500 because one
-    backend is old."""
+    """Merge the HTTP process's span ring AND every loaded model's ring
+    into ONE clock-aligned Chrome trace JSON (ISSUE 12 tentpole): the
+    frontend is pid 0 ("localai-http"), each backend its own pid with
+    its slot/scheduler tracks under it. Backend timestamps are relative
+    to THAT process's trace epoch, so each event is shifted by
+
+        (backend_t0_epoch - offset_s - frontend_t0_epoch) µs
+
+    where offset_s is the LoadModel clock-handshake estimate of the
+    backend-vs-frontend wall-clock skew (loader.LoadedModel.clock; the
+    residual error is bounded by that handshake's rtt_s). Backends
+    without GetTrace or without the epoch block (old fakes) and RPC
+    failures are skipped/unshifted — a debug surface must never 500
+    because one backend is old."""
     import json as _json
 
-    events: list = []
+    from localai_tpu.services.tracing import chrome_trace, frontend_tracer
+
+    front = chrome_trace(frontend_tracer(), pid=0,
+                         process_name="localai-http")
+    f_epoch = front["localai"]["t0_epoch"]
+    events: list = list(front["traceEvents"])
+    clocks: dict = {}
     pid = 0
     for name in state.caps.loader.list_loaded():
         lm = state.caps.loader.get(name)
@@ -397,12 +462,23 @@ def _collect_traces(state) -> dict:
         except Exception:
             continue
         pid += 1
+        clock = getattr(lm, "clock", None) or {}
+        b_epoch = float((trace.get("localai") or {}).get("t0_epoch", 0.0)
+                        or 0.0)
+        shift_us = ((b_epoch - clock.get("offset_s", 0.0) - f_epoch) * 1e6
+                    if b_epoch else 0.0)
+        clocks[name] = {"offset_s": clock.get("offset_s", 0.0),
+                        "rtt_s": clock.get("rtt_s", 0.0),
+                        "t0_epoch": b_epoch, "shift_us": round(shift_us, 1)}
         for ev in trace.get("traceEvents", []):
             ev["pid"] = pid
             if ev.get("ph") == "M" and ev.get("name") == "process_name":
                 ev["args"] = {"name": f"localai-engine:{name}"}
+            elif shift_us and "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift_us, 1)
             events.append(ev)
-    return {"displayTimeUnit": "ms", "traceEvents": events}
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "localai": {"t0_epoch": f_epoch, "clocks": clocks}}
 
 
 async def debug_trace(request):
@@ -465,8 +541,17 @@ def _collect_events(state, last: int = 0) -> list:
     order — one correlation-id'd stream across process boundaries."""
     merged = [dict(ev, proc="core") for ev in EVENTS.events()]
     for name, p in _backend_state_payloads(state).items():
+        lm = state.caps.loader.get(name)
+        # clock-handshake correction (ISSUE 12): backend events carry
+        # the BACKEND's wall clock; subtracting the measured offset puts
+        # them on the frontend timeline so the sort below is honest
+        off = (getattr(lm, "clock", None) or {}).get("offset_s", 0.0) \
+            if lm is not None else 0.0
         for ev in p.get("events") or []:
-            merged.append(dict(ev, proc=f"backend:{name}", model=name))
+            ev = dict(ev, proc=f"backend:{name}", model=name)
+            if off and "ts" in ev:
+                ev["ts"] = ev["ts"] - off
+            merged.append(ev)
     merged.sort(key=lambda ev: ev.get("ts", 0.0))
     if last > 0:
         merged = merged[-last:]

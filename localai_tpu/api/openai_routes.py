@@ -95,7 +95,9 @@ async def chat_completions(request):
     if not messages:
         return api_error("messages is required", 400, "invalid_request_error")
 
-    correlation_id = request.headers.get("X-Correlation-ID", uuid.uuid4().hex)
+    # minted (or taken from X-Correlation-ID) by the metrics middleware:
+    # one trace context per request, shared with the backend (ISSUE 12)
+    correlation_id = request.get("correlation_id") or uuid.uuid4().hex
     overrides = _overrides_from(body)
 
     tools = body.get("tools") or []
@@ -122,9 +124,17 @@ async def chat_completions(request):
         if grammar:
             overrides["grammar"] = grammar
 
+    t_route = time.monotonic()
     prompt, images, audios, videos = await state.run_blocking(
         build_chat_prompt, mc, messages, None, functions or None
     )
+    from localai_tpu.capabilities import trace_enabled
+    from localai_tpu.services.tracing import frontend_tracer
+
+    _tr = frontend_tracer()
+    if _tr.enabled and trace_enabled(mc):
+        _tr.record("build_prompt", "route", t_route, time.monotonic(),
+                   rid=correlation_id, args={"model": model})
     # media parts the loaded model cannot consume are a 400, never a
     # silent drop (VERDICT r4 #6 — r4 fetched audio/video then discarded
     # them, answering confidently about media the model never saw)
@@ -293,6 +303,7 @@ async def completions(request):
     model = _model_from(request, body)
     mc = state.caps.resolve(model)
     overrides = _overrides_from(body)
+    correlation_id = request.get("correlation_id") or uuid.uuid4().hex
     prompts = body.get("prompt", "")
     if isinstance(prompts, str):
         prompts = [prompts]
@@ -316,7 +327,8 @@ async def completions(request):
                     f'"created":{created},"model":{json.dumps(model)},'
                     '"choices":[{"index":0,"text":').encode()
             tail = b',"finish_reason":null}]}\n\n'
-            for chunk in state.caps.inference_stream(mc, prompt, overrides):
+            for chunk in state.caps.inference_stream(mc, prompt, overrides,
+                                                     correlation_id):
                 usage = [chunk.prompt_tokens, chunk.completion_tokens]
                 if chunk.finish_reason:
                     finish = chunk.finish_reason
@@ -335,8 +347,9 @@ async def completions(request):
     import asyncio
 
     chunks = await asyncio.gather(*[
-        state.run_blocking(state.caps.inference, mc, render(p), overrides)
-        for p in prompts
+        state.run_blocking(state.caps.inference, mc, render(p), overrides,
+                           f"{correlation_id}-p{i}" if i else correlation_id)
+        for i, p in enumerate(prompts)
     ])
     choices = []
     usage_pt, usage_ct = 0, 0

@@ -26,16 +26,44 @@ from localai_tpu.backend.service import BackendServicer, make_server
 
 
 class FakeServicer(BackendServicer):
-    def __init__(self, delay_s: float = 0.0):
+    def __init__(self, delay_s: float = 0.0, handshake: bool = True):
         self.delay_s = delay_s
         self.loaded = None
         self.store: dict = {}
+        # clock-handshake + trace-propagation test hooks (ISSUE 12):
+        # handshake=False restores the legacy plain-"loaded" reply the
+        # loader must stay tolerant of; seen_metadata records each
+        # Predict/PredictStream call's invocation metadata so tests can
+        # assert the localai-trace-id hop end to end
+        self.handshake = handshake
+        self.seen_metadata: list = []
+        self.last_trace_id = ""
+        self._t0_epoch = time.time()
 
     def LoadModel(self, request, context):
         if "fail" in request.model:
             return pb.Result(success=False, message="fake load failure")
         self.loaded = request
-        return pb.Result(success=True, message="loaded")
+        if not self.handshake:
+            return pb.Result(success=True, message="loaded")
+        import json
+
+        return pb.Result(success=True, message=json.dumps({
+            "status": "loaded",
+            "handshake": {"wall": time.time(), "mono": time.monotonic(),
+                          "trace_epoch": self._t0_epoch,
+                          "pid": os.getpid()}}))
+
+    def _capture_meta(self, context) -> dict:
+        md = {}
+        fn = getattr(context, "invocation_metadata", None)
+        if fn is not None:
+            for k, v in fn() or ():
+                md[str(k)] = str(v)
+        self.seen_metadata.append(md)
+        if md.get("localai-trace-id"):
+            self.last_trace_id = md["localai-trace-id"]
+        return md
 
     def _chunks(self, opts):
         words = opts.prompt.split() or ["echo"]
@@ -43,6 +71,7 @@ class FakeServicer(BackendServicer):
         return words[:n]
 
     def Predict(self, request, context):
+        self._capture_meta(context)
         chunks = self._chunks(request)
         text = " ".join(chunks)
         if request.echo:
@@ -53,6 +82,7 @@ class FakeServicer(BackendServicer):
         )
 
     def PredictStream(self, request, context):
+        self._capture_meta(context)
         chunks = self._chunks(request)
         stops = list(request.stop_sequences)
         for i, w in enumerate(chunks):
@@ -152,17 +182,23 @@ class FakeServicer(BackendServicer):
 
     def GetTrace(self, request, context):
         # minimal valid Chrome trace (the /debug/trace merge path needs
-        # a backend that answers; shape mirrors services/tracing.py)
+        # a backend that answers; shape mirrors services/tracing.py,
+        # INCLUDING the localai epoch block and a span keyed by the last
+        # propagated trace id so the cross-process merge is testable)
         import json
 
+        decode_args = {}
+        if self.last_trace_id:
+            decode_args["request_id"] = self.last_trace_id
         return pb.Reply(message=json.dumps({
             "displayTimeUnit": "ms",
             "traceEvents": [
                 {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
                  "args": {"name": "fake"}},
                 {"name": "decode", "cat": "engine", "ph": "X", "pid": 1,
-                 "tid": 1, "ts": 0.0, "dur": 100.0, "args": {}},
+                 "tid": 1, "ts": 0.0, "dur": 100.0, "args": decode_args},
             ],
+            "localai": {"t0_epoch": self._t0_epoch, "pid": os.getpid()},
         }).encode("utf-8"))
 
     # --- stores: real in-memory implementation ---

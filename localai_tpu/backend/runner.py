@@ -116,7 +116,19 @@ class EngineServicer(BackendServicer):
             try:
                 self._load(request)
                 self._state = pb.StatusResponse.READY
-                return pb.Result(success=True, message="loaded")
+                # clock handshake (ISSUE 12): Result.message carries this
+                # process's wall/monotonic clocks and the tracer epoch so
+                # the loader can measure the cross-process clock offset
+                # that aligns merged /debug/trace timelines. The loader
+                # tolerates a plain "loaded" from backends that don't
+                # participate (fakes, external bridges).
+                hs = {"status": "loaded",
+                      "handshake": {
+                          "wall": time.time(),
+                          "mono": time.monotonic(),
+                          "trace_epoch": self.engine.tracer.t0_epoch,
+                          "pid": os.getpid()}}
+                return pb.Result(success=True, message=json.dumps(hs))
             except Exception as e:  # surface the error to the core
                 self._state = pb.StatusResponse.ERROR
                 log.exception("LoadModel failed")
@@ -402,6 +414,18 @@ class EngineServicer(BackendServicer):
             **({"priority_aging_ms": int(v)} if (v := str(
                 extra.get("priority_aging_ms", "")).strip()).isdigit()
                else {}),
+            # per-class SLO objectives (ISSUE 12): colon-separated
+            # high:normal:low thresholds in ms (one value = all classes),
+            # like priority_weights — the options wire splits on commas.
+            # slo_error_budget tunes the burn-rate denominator.
+            **({"slo_ttft_ms": st} if (st := str(
+                extra.get("slo_ttft_ms", "") or "")) else {}),
+            **({"slo_itl_ms": si} if (si := str(
+                extra.get("slo_itl_ms", "") or "")) else {}),
+            **({"slo_queue_wait_ms": sq} if (sq := str(
+                extra.get("slo_queue_wait_ms", "") or "")) else {}),
+            **({"slo_error_budget": seb} if (seb := float(
+                extra.get("slo_error_budget", 0) or 0)) > 0 else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -501,17 +525,23 @@ class EngineServicer(BackendServicer):
     def _build_request(self, opts: pb.PredictOptions, context=None):
         from localai_tpu.engine.engine import GenRequest
 
-        # per-request priority class rides invocation metadata (the
-        # compiled descriptor cannot grow PredictOptions fields — same
-        # constraint as the localai-retry-after trailing metadata);
-        # empty -> the engine applies the model-default class. Guarded
-        # with getattr: in-process callers pass bare context fakes.
+        # per-request hints ride invocation metadata (the compiled
+        # descriptor cannot grow PredictOptions fields — same constraint
+        # as the localai-retry-after trailing metadata): the priority
+        # class (ISSUE 10) and the cross-process trace id (ISSUE 12).
+        # Guarded with getattr: in-process callers pass bare context
+        # fakes. An empty priority -> the engine applies the model
+        # default; an empty trace id falls back to the correlation_id
+        # proto field, keeping older cores traceable.
         priority = ""
+        trace_id = ""
         meta_fn = getattr(context, "invocation_metadata", None)
         if meta_fn is not None:
             for key, value in meta_fn() or ():
                 if key == "localai-priority":
                     priority = str(value)
+                elif key == "localai-trace-id":
+                    trace_id = str(value)
 
         # media parts the backend cannot consume are a loud error, never a
         # silent drop (VERDICT r4 #6): the HTTP layer 400s these first;
@@ -547,7 +577,7 @@ class EngineServicer(BackendServicer):
             grammar=opts.grammar,
             mm_positions=mm_positions,
             mm_vectors=mm_vectors,
-            request_id=opts.correlation_id or "",
+            request_id=trace_id or opts.correlation_id or "",
             prompt_cache_path=cache_path,
             prompt_cache_ro=opts.prompt_cache_ro,
             prompt_cache_all=opts.prompt_cache_all,

@@ -95,15 +95,34 @@ def build_predict_options(mc: ModelConfig, prompt: str, overrides: Optional[dict
     return opts
 
 
-def predict_metadata(overrides: Optional[dict]) -> Optional[tuple]:
-    """gRPC invocation metadata for per-request scheduling hints
-    (ISSUE 10): the compiled descriptor cannot grow PredictOptions
-    fields, so the priority class rides ``localai-priority`` metadata
-    (same constraint as the retry-after trailing metadata)."""
+def predict_metadata(overrides: Optional[dict],
+                     correlation_id: str = "") -> Optional[tuple]:
+    """gRPC invocation metadata for per-request hints: the compiled
+    descriptor cannot grow PredictOptions fields, so the priority class
+    rides ``localai-priority`` (ISSUE 10) and the request's trace
+    context rides ``localai-trace-id`` (ISSUE 12) — the backend keys
+    its RingTracer spans and event-log records by it, so the frontend
+    and backend halves of a request share ONE trace id."""
+    md = []
     pr = (overrides or {}).get("priority")
     if pr:
-        return (("localai-priority", str(pr).strip().lower()),)
-    return None
+        md.append(("localai-priority", str(pr).strip().lower()))
+    if correlation_id:
+        md.append(("localai-trace-id", str(correlation_id)))
+    return tuple(md) or None
+
+
+def trace_enabled(mc: ModelConfig) -> bool:
+    """Is request tracing on for this model? Mirrors the backend's
+    parse of the ``trace`` option so the frontend's per-request spans
+    (HTTP/route/gRPC-hop) go quiet exactly when the backend's do —
+    trace=0 is a true no-op on BOTH sides of the boundary."""
+    for o in mc.options or []:
+        s = str(o)
+        if s.startswith("trace="):
+            return s.split("=", 1)[1].strip().lower() not in (
+                "0", "false", "off", "no")
+    return True
 
 
 def finetune_response(mc: ModelConfig, prediction: str, prompt: str = "",
@@ -171,12 +190,21 @@ class Capabilities:
                          overrides: Optional[dict] = None,
                          correlation_id: str = "") -> Iterator[TokenChunk]:
         """Streaming inference (reference: ModelInference llm.go:35-174)."""
+        import time as _time
+
+        from localai_tpu.services.tracing import frontend_tracer
+
         lm = self._load(mc)
         popts = build_predict_options(mc, prompt, overrides, correlation_id)
-        md = predict_metadata(overrides)
+        md = predict_metadata(overrides, correlation_id)
+        tr = frontend_tracer() if trace_enabled(mc) else None
+        t_call = _time.monotonic()
+        t_first = None
         lm.mark_busy()
         try:
             for reply in lm.client.predict_stream(popts, metadata=md):
+                if t_first is None:
+                    t_first = _time.monotonic()
                 yield TokenChunk(
                     text=reply.message.decode("utf-8", errors="replace"),
                     token_id=reply.token_id,
@@ -193,13 +221,26 @@ class Capabilities:
             raise wrap_backend_error(e, mc.name) from e
         finally:
             lm.mark_idle()
+            if tr is not None and tr.enabled:
+                t1 = _time.monotonic()
+                if t_first is not None:
+                    tr.record("grpc_first_reply", "grpc", t_call, t_first,
+                              rid=correlation_id, args={"model": mc.name})
+                tr.record("grpc_predict_stream", "grpc", t_call, t1,
+                          rid=correlation_id, args={"model": mc.name})
 
     def inference(self, mc: ModelConfig, prompt: str,
                   overrides: Optional[dict] = None,
                   correlation_id: str = "") -> TokenChunk:
+        import time as _time
+
+        from localai_tpu.services.tracing import frontend_tracer
+
         lm = self._load(mc)
         popts = build_predict_options(mc, prompt, overrides, correlation_id)
-        md = predict_metadata(overrides)
+        md = predict_metadata(overrides, correlation_id)
+        tr = frontend_tracer() if trace_enabled(mc) else None
+        t_call = _time.monotonic()
         lm.mark_busy()
         try:
             reply = lm.client.predict(popts, metadata=md)
@@ -207,6 +248,9 @@ class Capabilities:
             raise wrap_backend_error(e, mc.name) from e
         finally:
             lm.mark_idle()
+            if tr is not None and tr.enabled:
+                tr.record("grpc_predict", "grpc", t_call, _time.monotonic(),
+                          rid=correlation_id, args={"model": mc.name})
         text = finetune_response(mc, reply.message.decode("utf-8", errors="replace"))
         return TokenChunk(
             text=text, finish_reason=reply.finish_reason or "stop",

@@ -151,7 +151,11 @@ class ModelConfig:
     # event_log=path|stderr|off (structured JSON-lines event sink for the
     # backend process; the ring at /debug/events works regardless) and
     # peak_tflops=N (override the device peak used for MFU — needed on
-    # CPU/unknown device kinds where the built-in table reports 0).
+    # CPU/unknown device kinds where the built-in table reports 0), or
+    # the per-class SLO objectives (ISSUE 12) slo_ttft_ms= / slo_itl_ms=
+    # / slo_queue_wait_ms= with value "500" (all classes), "250:1000:5000"
+    # (high:normal:low) or "high=250:low=5000" (named subset) and
+    # slo_error_budget=F (allowed violation fraction, default 0.01).
     # The known knobs are value-validated in validate() so a typo fails
     # at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
@@ -296,6 +300,24 @@ class ModelConfig:
                 except ValueError:
                     problems.append(
                         f"peak_tflops must be a number, got {v!r}")
+            elif k in ("slo_ttft_ms", "slo_itl_ms", "slo_queue_wait_ms"):
+                # per-class SLO objectives (ISSUE 12): same fail-at-scan
+                # contract as priority_weights — the parser IS the
+                # validator
+                try:
+                    from localai_tpu.services.sysobs import parse_slo_classes
+
+                    parse_slo_classes(v)
+                except ValueError as e:
+                    problems.append(str(e))
+            elif k == "slo_error_budget":
+                try:
+                    if not 0 < float(v) <= 1:
+                        problems.append(
+                            f"slo_error_budget must be in (0, 1], got {v!r}")
+                except ValueError:
+                    problems.append(
+                        f"slo_error_budget must be a number, got {v!r}")
         return problems
 
     def usecases(self) -> Usecase:

@@ -275,6 +275,20 @@ class EngineConfig:
     # starvation aging: queued/parked work older than this is treated
     # one class higher when ordering admissions. 0 disables.
     priority_aging_ms: int = 4000
+    # --- per-class SLO engine (ISSUE 12, services/sysobs.py) ---
+    # latency objectives per priority class, colon-separated
+    # high:normal:low thresholds in ms (one value applies to every
+    # class; named subsets like "high=250:low=5000" work too — option
+    # values ride a comma-joined wire, so colon separates, as in
+    # priority_weights). "" = no objective for that metric; all three
+    # empty leaves the SLO engine unbuilt (zero per-request cost).
+    slo_ttft_ms: str = ""
+    slo_itl_ms: str = ""
+    slo_queue_wait_ms: str = ""
+    # error budget the burn rate is measured against: burn = (violation
+    # fraction in window) / budget, so burn > 1 means the class misses
+    # its SLO if the rate holds. 0.01 = a 99% objective.
+    slo_error_budget: float = 0.01
 
 
 @dataclasses.dataclass
@@ -884,6 +898,27 @@ class Engine:
                 parse_priority_weights(self.ecfg.priority_weights),
                 max_preemptions=self.ecfg.max_preemptions,
                 aging_ms=float(self.ecfg.priority_aging_ms))
+        # --- per-class SLO engine + violation flight recorder (ISSUE 12)
+        # Built only when an objective is declared — the finish-path
+        # observe() calls are then dict lookups; with no objectives the
+        # whole layer is None-checked away.
+        objectives = {}
+        for metric, spec in (("ttft_ms", self.ecfg.slo_ttft_ms),
+                             ("itl_ms", self.ecfg.slo_itl_ms),
+                             ("queue_wait_ms", self.ecfg.slo_queue_wait_ms)):
+            classes = sysobs.parse_slo_classes(spec)   # raises on typos
+            if classes:
+                objectives[metric] = classes
+        self._slo = (sysobs.SLOEngine(
+            objectives, error_budget=self.ecfg.slo_error_budget)
+            if objectives else None)
+        # the flight recorder dumps merged trace + state + events on SLO
+        # violations AND watchdog/stall events, into the same directory
+        # the stall ring dumps use
+        self._flight = sysobs.FlightRecorder(self.ecfg.stall_dump_dir)
+        # last device allocator sample (bytes_in_use/peak/limit); {} on
+        # backends without memory_stats() (CPU) — see _sample_watermarks
+        self._device_mem: dict = {}
 
     def _sync_worker(self):
         """ALL device->host syncs run here, one at a time, in dispatch
@@ -961,6 +996,67 @@ class Engine:
             worst = self._hist_worst.get(name)
             if worst is None or seconds > worst[0]:
                 self._hist_worst[name] = (seconds, rid, time.time())
+
+    def _flight_dump(self, reason: str, tag: str = "slo", **extra):
+        """Violation flight recorder (ISSUE 12): atomically persist the
+        merged evidence for ONE bad moment — chrome trace, /debug/state
+        snapshot and the last events — so a stall or SLO burn seen on a
+        dashboard at 3am still has its context on disk at 9am. Rate
+        limiting and disk bounds live in sysobs.FlightRecorder; this
+        wrapper only assembles the payload and must never raise into the
+        engine loop."""
+        try:
+            payload = {
+                "trace": self.trace_events(),
+                "state": self.state_snapshot(),
+                "events": EVENTS.events(last=256),
+            }
+            payload.update(extra)
+            path = self._flight.dump(reason, payload, tag=tag)
+            if path:
+                EVENTS.emit("flight_dump", reason=reason, tag=tag, path=path)
+            return path
+        except Exception:  # pragma: no cover - defensive
+            __import__("logging").getLogger(__name__).exception(
+                "flight dump failed")
+            return ""
+
+    def _slo_finish(self, s, ndec: int, t_done: float, ttft_ms: float,
+                    queue_wait_ms: float):
+        """Feed one finished request into the SLO engine (ISSUE 12).
+
+        Called from BOTH finish paths (in-loop _emit_token branch and the
+        event-driven _finish_accounting_ev) with the same timings the
+        histograms see, so burn rates and latency buckets can never
+        disagree about what happened. ITL is the per-request mean
+        inter-token gap — (t_done - t_first)/(ndec-1) — which matches how
+        a client experiences stream smoothness without keeping per-token
+        stamps around."""
+        if self._slo is None or not self._slo.enabled:
+            return
+        cls = s.req.priority or "normal"
+        violations = []
+        v = self._slo.observe("ttft_ms", cls, ttft_ms, rid=s.req.request_id)
+        if v:
+            violations.append(v)
+        v = self._slo.observe("queue_wait_ms", cls, queue_wait_ms,
+                              rid=s.req.request_id)
+        if v:
+            violations.append(v)
+        if ndec > 1 and s.t_first_token:
+            itl_ms = (t_done - s.t_first_token) * 1e3 / (ndec - 1)
+            v = self._slo.observe("itl_ms", cls, itl_ms,
+                                  rid=s.req.request_id)
+            if v:
+                violations.append(v)
+        for v in violations:
+            EVENTS.emit("slo_violation", rid=v["rid"], metric=v["metric"],
+                        cls=v["class"], value_ms=round(v["value_ms"], 1),
+                        objective_ms=v["objective_ms"])
+        if violations:
+            self._flight_dump(
+                f"slo:{violations[0]['metric']}:{violations[0]['class']}",
+                tag="slo", violations=violations)
 
     def _annot(self, name: str):
         """jax.profiler annotation around a dispatch, so device traces
@@ -2358,7 +2454,18 @@ class Engine:
                    "weight_bytes": self._weight_bytes}
         if self._paged:
             sys_obs["fragmentation"] = self._pool.fragmentation()
+        if self._device_mem:
+            sys_obs["device_mem"] = dict(self._device_mem)
         out["sysobs"] = sys_obs
+        # SLO engine (ISSUE 12): per-class burn rates + violation totals,
+        # re-exposed as localai_slo_* gauges; short-window burns > 1 also
+        # become rate-limited slo_burn events so the log tells the same
+        # story the dashboard does
+        if self._slo is not None and self._slo.enabled:
+            out["slo"] = self._slo.snapshot()
+            for rec in self._slo.burn_events():
+                EVENTS.emit("slo_burn", **rec)
+        out["flight_recorder"] = self._flight.snapshot()
         # preemptive priority scheduler (ISSUE 10): DRR counters, resume
         # queue depth, per-class queue/active gauges + effective knobs
         if self._sched is not None:
@@ -2399,6 +2506,14 @@ class Engine:
         excursion, cleared when the pool recovers past 2x)."""
         wm = {"queued": self._queue.qsize(), "slots_active": self.num_active,
               "tokens_total": self._total_tokens}
+        # device memory (ISSUE 12 satellite): real allocator stats when
+        # the backend exposes them (TPU/GPU), cached for /debug/state and
+        # folded into the high-water marks; {} on CPU — the analytic
+        # weight/KV accounting above remains the fallback there
+        dm = sysobs.device_memory_stats()
+        if dm:
+            self._device_mem = dm
+            wm["device_bytes_in_use"] = dm.get("bytes_in_use", 0)
         if self._paged:
             wm["pool_active_pages"] = self._pool.active_pages
             wm["pool_retained_pages"] = self._pool.retained_pages
@@ -2444,6 +2559,11 @@ class Engine:
             "goodput": self._goodput.snapshot(),
             "weight_bytes": self._weight_bytes,
         }
+        if self._device_mem:
+            out["device_mem"] = dict(self._device_mem)
+        if self._slo is not None and self._slo.enabled:
+            out["slo"] = self._slo.snapshot()
+        out["flight_recorder"] = self._flight.snapshot()
         with self._lc_lock:
             out["lifecycle"] = dict(self._lc)
         if self._paged:
@@ -3149,6 +3269,11 @@ class Engine:
                     dispatch_stall_ms=self.ecfg.dispatch_stall_ms,
                     requests=[snap.req.request_id for _, snap in stalled],
                     ring_dump=dump_path)
+        # flight recorder (ISSUE 12): the ring dump above is spans only;
+        # this bundle adds state + recent events for the same moment
+        self._flight_dump("stall", tag="stall",
+                          requests=[snap.req.request_id
+                                    for _, snap in stalled])
         try:
             self._fifo.remove(item)
         except ValueError:
@@ -5291,6 +5416,9 @@ class Engine:
             # goodput (ISSUE 8): ONLY clean finishes count — sheds,
             # timeouts and stall aborts never reach this branch
             self._goodput.add(s.n_decoded)
+            self._slo_finish(s, s.n_decoded, t_done,
+                            queue_wait_ms + admit_to_first_ms,
+                            queue_wait_ms)
             EVENTS.emit("complete", rid=s.req.request_id, finish=finish,
                         completion_tokens=s.n_decoded,
                         e2e_ms=round((t_done - s.req.t_submit) * 1e3, 1)
@@ -5442,6 +5570,10 @@ class Engine:
         # goodput (ISSUE 8): ONLY clean finishes count — sheds, timeouts
         # and stall aborts never reach this branch
         self._goodput.add(ndec)
+        self._slo_finish(s, ndec, t_done,
+                         timings["queue_wait_ms"]
+                         + timings["admit_to_first_ms"],
+                         timings["queue_wait_ms"])
         EVENTS.emit("complete", rid=s.req.request_id, finish=finish,
                     completion_tokens=ndec,
                     e2e_ms=round((t_done - s.req.t_submit) * 1e3, 1)

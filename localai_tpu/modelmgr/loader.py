@@ -136,6 +136,13 @@ class LoadedModel:
         # operator-requested shutdown from a crash it must respawn
         self.intentional_stop = False
         self.supervisor: Optional[threading.Thread] = None
+        # cross-process clock handshake (ISSUE 12): offset/rtt measured
+        # around LoadModel, used to shift backend trace timestamps onto
+        # the frontend timeline. {} when the backend sent no handshake
+        # (e.g. FakeServicer's plain "loaded") — merge then falls back
+        # to raw epochs. Re-measured automatically on respawn because
+        # every spawn goes through _spawn_and_load.
+        self.clock: dict = {}
         self._lock = threading.Lock()
 
     def mark_busy(self):
@@ -386,7 +393,9 @@ class ModelLoader:
         client, process, server = self._connect_backend(backend_name)
         try:
             self._wait_healthy(client, process)
+            t_send = time.time()
             res = client.load_model(model_opts)
+            t_recv = time.time()
             if not res.success:
                 raise RuntimeError(f"LoadModel failed: {res.message}")
         except Exception:
@@ -397,6 +406,7 @@ class ModelLoader:
                 process.stop()
             raise
         lm = LoadedModel(model_id, backend_name, client, process, server)
+        lm.clock = _parse_handshake(res.message, t_send, t_recv)
         lm.watchdog = self.watchdog
         if self.watchdog is not None:
             self.watchdog.add(model_id, lm)
@@ -496,6 +506,35 @@ class ModelLoader:
             victims = [self._pop_locked(m) for m in list(self.models)]
         for lm in victims:
             self._close_lm(lm)
+
+
+def _parse_handshake(message: str, t_send: float, t_recv: float) -> dict:
+    """Clock-offset handshake from a LoadModel reply (ISSUE 12).
+
+    The backend stamps its wall clock inside the Result.message JSON;
+    the midpoint of the RPC round-trip is the best single-sample
+    estimate of WHEN that stamp was taken on the frontend's clock, so
+
+        offset_s = backend_wall - (t_send + t_recv) / 2
+
+    with the full round-trip as the honest uncertainty bound (the true
+    offset lies within ±rtt/2 of the estimate). Backends that reply
+    with a plain string (FakeServicer's "loaded", older runners) yield
+    {} — merged traces then fall back to raw epoch alignment."""
+    try:
+        doc = __import__("json").loads(message)
+        hs = doc.get("handshake") or {}
+        bw = float(hs["wall"])
+    except (ValueError, TypeError, KeyError, AttributeError):
+        return {}
+    return {
+        "offset_s": bw - (t_send + t_recv) / 2.0,
+        "rtt_s": max(0.0, t_recv - t_send),
+        "backend_wall": bw,
+        "backend_pid": int(hs.get("pid", 0) or 0),
+        "trace_epoch": float(hs.get("trace_epoch", 0.0) or 0.0),
+        "measured_at": t_recv,
+    }
 
 
 def _looks_like_addr(target: str) -> bool:

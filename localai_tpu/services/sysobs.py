@@ -296,3 +296,290 @@ class GoodputMeter:
                 "mfu": round(self.mfu(rate), 6),
                 "flops_per_token": self.flops_per_tok,
                 "peak_flops": self.peak_flops}
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (ISSUE 12): per-priority-class latency objectives with
+# multi-window burn-rate evaluation, plus the violation flight recorder.
+# ---------------------------------------------------------------------------
+
+# the priority classes the scheduler knows, in the same order the
+# colon-separated option values use (matches priority_weights)
+SLO_CLASSES = ("high", "normal", "low")
+
+# metric -> EngineConfig/options knob suffix; all thresholds in ms
+SLO_METRICS = ("ttft_ms", "itl_ms", "queue_wait_ms")
+
+# burn-rate windows (name -> seconds). Multi-window per SRE practice:
+# the short window catches fast burns, the long one sustained ones.
+SLO_WINDOWS = (("5m", 300.0), ("1h", 3600.0))
+
+
+def parse_slo_classes(spec: str) -> dict:
+    """Parse a colon-separated per-class threshold spec into
+    {class: threshold_ms}. Accepted shapes (option values ride a
+    comma-joined wire, so colon is the list separator, as in
+    priority_weights):
+
+    * ``""``            -> {} (no objective declared)
+    * ``"500"``         -> the one threshold applies to every class
+    * ``"250:1000:5000"`` -> high:normal:low
+    * ``"high=250:low=5000"`` -> named subset; unnamed classes have no
+      objective
+
+    Raises ValueError on anything else so config validation can reject
+    typos at scan time instead of silently serving without SLOs."""
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    parts = [p.strip() for p in spec.split(":") if p.strip()]
+    if not parts:
+        return {}
+
+    def _ms(v: str) -> float:
+        ms = float(v)
+        if not ms > 0:
+            raise ValueError(f"SLO threshold must be > 0 ms, got {v!r}")
+        return ms
+
+    if any("=" in p for p in parts):
+        out = {}
+        for p in parts:
+            if "=" not in p:
+                raise ValueError(
+                    f"mixed named and positional SLO classes in {spec!r}")
+            k, v = (x.strip() for x in p.split("=", 1))
+            if k not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown SLO class {k!r} (want one of {SLO_CLASSES})")
+            out[k] = _ms(v)
+        return out
+    if len(parts) == 1:
+        ms = _ms(parts[0])
+        return {c: ms for c in SLO_CLASSES}
+    if len(parts) == len(SLO_CLASSES):
+        return {c: _ms(p) for c, p in zip(SLO_CLASSES, parts)}
+    raise ValueError(
+        f"SLO spec {spec!r} must have 1 or {len(SLO_CLASSES)} "
+        f"(high:normal:low) colon-separated values, got {len(parts)}")
+
+
+class SLOEngine:
+    """Per-(metric, class) objective tracking with windowed burn rates.
+
+    Samples are (timestamp, violated?) pairs in bounded deques; the burn
+    rate of a window is ``(violations / samples) / error_budget`` — the
+    standard "how many times faster than allowed are we spending the
+    error budget" number: 1.0 means exactly on budget, >1 means the SLO
+    will be missed if the rate holds. `clock` is injectable so the
+    window arithmetic is unit-testable with hand-picked timestamps.
+
+    Thread-safety: observe() is called from the engine loop (single
+    writer); snapshot()/burn_events() from metrics pulls — a lock keeps
+    the deques consistent."""
+
+    def __init__(self, objectives: dict, error_budget: float = 0.01,
+                 clock=time.monotonic, max_samples: int = 4096,
+                 burn_event_interval_s: float = 30.0):
+        # objectives: {metric: {class: threshold_ms}}
+        self.objectives = {m: dict(c) for m, c in (objectives or {}).items()
+                           if c}
+        self.error_budget = max(1e-6, float(error_budget))
+        self.clock = clock
+        self._samples: dict = {}     # (metric, cls) -> deque[(t, bad)]
+        self._violations: dict = {}  # (metric, cls) -> int
+        self._last_burn_event: dict = {}  # (metric, cls) -> t
+        self._burn_event_interval = float(burn_event_interval_s)
+        self._max_samples = int(max_samples)
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.objectives)
+
+    def observe(self, metric: str, cls: str, value_ms: float,
+                rid: str = ""):
+        """Record one sample; returns the violation record (dict) when
+        the sample broke its objective, else None. No objective declared
+        for (metric, class) -> cheap no-op."""
+        threshold = self.objectives.get(metric, {}).get(cls)
+        if threshold is None:
+            return None
+        bad = value_ms > threshold
+        now = self.clock()
+        with self._lock:
+            dq = self._samples.get((metric, cls))
+            if dq is None:
+                dq = self._samples[(metric, cls)] = deque(
+                    maxlen=self._max_samples)
+            dq.append((now, bad))
+            if bad:
+                self._violations[(metric, cls)] = \
+                    self._violations.get((metric, cls), 0) + 1
+        if not bad:
+            return None
+        return {"metric": metric, "class": cls,
+                "value_ms": round(float(value_ms), 3),
+                "objective_ms": threshold, "rid": rid}
+
+    def _burn(self, dq, now: float, window_s: float):
+        total = bad = 0
+        horizon = now - window_s
+        for t, b in dq:
+            if t >= horizon:
+                total += 1
+                bad += b
+        if total == 0:
+            return 0.0, 0
+        return (bad / total) / self.error_budget, total
+
+    def snapshot(self) -> dict:
+        """{class: {metric: {objective_ms, burn_5m, burn_1h, n_5m,
+        violations}}, violations_total, error_budget}."""
+        now = self.clock()
+        out = {"error_budget": self.error_budget, "classes": {}}
+        total_viol = 0
+        with self._lock:
+            for metric, classes in self.objectives.items():
+                for cls, threshold in classes.items():
+                    dq = self._samples.get((metric, cls), ())
+                    viol = self._violations.get((metric, cls), 0)
+                    total_viol += viol
+                    rec = {"objective_ms": threshold, "violations": viol}
+                    for wname, wsec in SLO_WINDOWS:
+                        burn, n = self._burn(dq, now, wsec)
+                        rec[f"burn_{wname}"] = round(burn, 4)
+                        rec[f"n_{wname}"] = n
+                    out["classes"].setdefault(cls, {})[metric] = rec
+        out["violations_total"] = total_viol
+        return out
+
+    def burn_events(self) -> list:
+        """(metric, class) pairs whose SHORT-window burn is > 1 right
+        now, rate-limited to one record per pair per
+        `burn_event_interval_s` — the caller turns these into `slo_burn`
+        structured events."""
+        now = self.clock()
+        out = []
+        wname, wsec = SLO_WINDOWS[0]
+        with self._lock:
+            for (metric, cls), dq in self._samples.items():
+                burn, n = self._burn(dq, now, wsec)
+                if burn <= 1.0 or n == 0:
+                    continue
+                last = self._last_burn_event.get((metric, cls), -1e18)
+                if now - last < self._burn_event_interval:
+                    continue
+                self._last_burn_event[(metric, cls)] = now
+                out.append({"metric": metric, "class": cls,
+                            "window": wname, "burn": round(burn, 4),
+                            "samples": n,
+                            "objective_ms":
+                                self.objectives[metric][cls]})
+        return out
+
+
+class FlightRecorder:
+    """Atomic on-violation dumps: merged chrome trace + state snapshot +
+    last-N events written as ONE json file to `out_dir` (tmp file +
+    os.replace so a reader never sees a half-written dump).
+
+    Rate-limited (`min_interval_s` between dumps) and disk-bounded
+    (`max_dumps` newest kept; older flight dumps are pruned) so a
+    sustained violation storm cannot fill the disk. `clock` injectable
+    for deterministic tests. dump() never raises — the recorder is
+    telemetry, not a serving dependency."""
+
+    PREFIX = "localai-flight-"
+
+    def __init__(self, out_dir: str = "", min_interval_s: float = 30.0,
+                 max_dumps: int = 8, clock=time.monotonic):
+        import tempfile
+
+        self.out_dir = out_dir or tempfile.gettempdir()
+        self.min_interval_s = float(min_interval_s)
+        self.max_dumps = max(1, int(max_dumps))
+        self.clock = clock
+        self.dumps = 0          # written
+        self.suppressed = 0     # rate-limited away
+        self._last_t = None
+        self._lock = threading.Lock()
+
+    def dump(self, reason: str, payload: dict, tag: str = "slo") -> str:
+        """Write one flight dump; returns its path, or "" when
+        rate-limited or on write failure."""
+        now = self.clock()
+        with self._lock:
+            if self._last_t is not None \
+                    and now - self._last_t < self.min_interval_s:
+                self.suppressed += 1
+                return ""
+            self._last_t = now
+            self.dumps += 1
+            seq = self.dumps
+        rec = {"reason": reason, "tag": tag, "ts": round(time.time(), 6)}
+        rec.update(payload or {})
+        name = (f"{self.PREFIX}{tag}-{os.getpid()}-"
+                f"{int(time.time() * 1000)}-{seq}.json")
+        path = os.path.join(self.out_dir, name)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(rec, f, default=str)
+            os.replace(tmp, path)
+        except Exception as e:
+            log.warning("flight-recorder dump failed: %s", e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return ""
+        self._prune()
+        return path
+
+    def _prune(self):
+        """Keep only the newest `max_dumps` flight dumps in out_dir."""
+        try:
+            mine = sorted(
+                f for f in os.listdir(self.out_dir)
+                if f.startswith(self.PREFIX) and f.endswith(".json"))
+            for f in mine[:-self.max_dumps]:
+                try:
+                    os.unlink(os.path.join(self.out_dir, f))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"dumps": self.dumps, "suppressed": self.suppressed,
+                    "dir": self.out_dir, "max_dumps": self.max_dumps,
+                    "min_interval_s": self.min_interval_s}
+
+
+def device_memory_stats() -> dict:
+    """Real-device memory watermarks (closes the PR-8 follow-up):
+    `jax.local_devices()[0].memory_stats()` where the platform provides
+    it (TPU and GPU runtimes do; CPU returns None/raises -> {}). Keys
+    normalized to bytes_in_use / peak_bytes_in_use / bytes_limit; {}
+    means "no device counters here — analytic accounting is the
+    fallback"."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        v = stats.get(key)
+        if v is not None:
+            out[key] = int(v)
+    if out:
+        out["device_kind"] = getattr(dev, "device_kind", "")
+    return out

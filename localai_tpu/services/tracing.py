@@ -174,6 +174,12 @@ def chrome_trace(tracer: RingTracer, pid: int = 1,
     One thread (track) per slot plus "sched" (the engine tick loop) and
     "engine" (dispatch/device spans). Load the serialized dict at
     https://ui.perfetto.dev or chrome://tracing.
+
+    The top-level ``localai`` block carries this process's trace epoch
+    (wall-clock t0 of the relative-µs timeline) and pid — the anchor the
+    HTTP process uses to re-base backend timelines onto ONE merged
+    cross-process trace (ISSUE 12), corrected by the LoadModel clock
+    handshake offset.
     """
     spans = tracer.spans()
     tracks = sorted({s["track"] for s in spans}, key=_track_order_key)
@@ -206,7 +212,34 @@ def chrome_trace(tracer: RingTracer, pid: int = 1,
             "dur": round(max(0.0, s["t1"] - s["t0"]) * 1e6, 1),
             "args": args,
         })
-    return {"displayTimeUnit": "ms", "traceEvents": events}
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "localai": {"t0_epoch": tracer.t0_epoch,
+                        "pid": os.getpid()}}
+
+
+# --- frontend (HTTP/API process) tracer (ISSUE 12) -------------------------
+# The core process gets its own RingTracer so the request timeline no
+# longer fractures at the gRPC boundary: HTTP parse/route spans and the
+# gRPC-hop span are recorded here under the same correlation id the
+# backend keys its spans by, and /debug/trace merges both rings onto one
+# clock-aligned timeline. LOCALAI_TRACE=0 disables it (record() is then
+# the same first-line no-op the engine's trace=0 knob gives the backend).
+
+_frontend_tracer = None
+_frontend_lock = threading.Lock()
+
+
+def frontend_tracer() -> RingTracer:
+    """Per-process singleton tracer for the HTTP/API process."""
+    global _frontend_tracer
+    with _frontend_lock:
+        if _frontend_tracer is None:
+            enabled = os.environ.get("LOCALAI_TRACE", "1").strip().lower() \
+                not in ("0", "false", "off", "no")
+            size = int(os.environ.get("LOCALAI_TRACE_RING_SIZE", "2048")
+                       or 2048)
+            _frontend_tracer = RingTracer(size, enabled=enabled)
+        return _frontend_tracer
 
 
 def dump_ring(tracer: RingTracer, out_dir: str = "", tag: str = "stall") -> str:

@@ -6,9 +6,11 @@
 #
 # Fails (rc != 0) if either stage fails. Environment knobs:
 #   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
-#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 560 here —
+#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 640 here —
 #                             the packed phase runs three fuse modes plus
-#                             the >1k-token long-pack gate since ISSUE 11)
+#                             the >1k-token long-pack gate since ISSUE 11,
+#                             and the SLO burn phase rides along since
+#                             ISSUE 12)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #
@@ -16,6 +18,11 @@
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
 # phase — the number the ragged packed prefill exists to hold down — so
 # regressions show up in every CI log without reading the JSON blob.
+# Since ISSUE 12 the smoke also runs the SLO burn/flight-recorder phase
+# (SLO_BURN_5M/SLO_VIOLATIONS/TRACE_MERGED tracked line): the tight
+# low-class objective must burn AND land a flight dump on disk, the
+# loose high-class one must stay clean, and one request id must appear
+# under both pids of the merged cross-process trace.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -25,7 +32,7 @@ scripts/run_tier1.sh
 
 echo "== ci: bench smoke =="
 smoke_out=$(mktemp)
-LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-560}" \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-640}" \
     python bench.py --smoke | tee "$smoke_out"
 
 echo "== ci: tracked =="
@@ -82,6 +89,33 @@ print(f"COMPILES_AFTER_WARMUP={line.get('compiles_after_warmup')} "
       f"PEAK_POOL_PAGES={line.get('peak_pool_pages')} "
       f"MFU={line.get('mfu')} "
       f"cold_bucket_detected={line.get('cold_bucket_detected')}")
+# per-class SLO burn + flight recorder + merged trace (ISSUE 12): the
+# smoke's slo phase gives the low class an impossible 0.01 ms TTFT
+# objective (must burn > 1 and dump) and the high class a loose 60 s
+# one (must stay at 0 violations), and checks one request id appears
+# under both pids of the clock-aligned merged trace
+slo = line.get("slo") or {}
+print(f"SLO_BURN_5M={line.get('slo_burn_5m')} "
+      f"SLO_VIOLATIONS={line.get('slo_violations')} "
+      f"TRACE_MERGED={line.get('trace_merged')} "
+      f"burn_5m_high={slo.get('burn_5m_high')} "
+      f"violations_high={slo.get('violations_high')} "
+      f"flight_dumps={slo.get('flight_dumps')}")
+if not slo.get("flight_dumps") or slo.get("flight_dump_low") is not True:
+    print(f"FAIL: flight recorder produced no dump for the burned low "
+          f"class (dumps={slo.get('flight_dumps')}, "
+          f"low={slo.get('flight_dump_low')})")
+    sys.exit(1)
+burn = line.get("slo_burn_5m")
+if burn is None or not burn > 1 or slo.get("burn_5m_high") != 0 \
+        or slo.get("violations_high") != 0:
+    print(f"FAIL: SLO burn split regressed (low={burn} must be > 1, "
+          f"high={slo.get('burn_5m_high')}/"
+          f"{slo.get('violations_high')} must be 0)")
+    sys.exit(1)
+if line.get("trace_merged") != 1:
+    print("FAIL: request id did not survive into a merged two-pid trace")
+    sys.exit(1)
 PY
 rm -f "$smoke_out"
 
