@@ -1101,6 +1101,85 @@ def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
     return out
 
 
+def bench_spec(cfg, S, C, n_req=None, max_new=64):
+    """Speculative decoding scenario (ISSUE 13): a mixed greedy wave with
+    model-free n-gram self-speculation (``draft=ngram``) vs speculation
+    off (``draft=0``), byte-identical by construction (greedy speculation
+    is lossless) and faster per emitted token when acceptance lands.
+
+    Prompts tile a short repeated pattern so the greedy continuation has
+    self-similar structure the prompt-lookup drafter can exploit (small
+    random-weight models also fall into greedy cycles, which n-gram
+    drafting predicts near-perfectly once entered). Headline numbers:
+    accepted-tokens-per-dispatch (emitted spec tokens per verify round —
+    1.0 means speculation bought nothing) and the emitted-token ITL on
+    vs off. The byte gate doubles as the ``spec=0`` untouched check: the
+    off engine runs the plain burst path bit-for-bit."""
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.weights import random_params
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(17)
+    n_req = n_req or 2 * S
+    plen = max(16, C // 8)
+    pat = rng.integers(0, 255, size=8)
+    prompts = []
+    for i in range(n_req):
+        p = np.tile(np.roll(pat, i), plen // 8 + 1)[:plen]
+        prompts.append(p.tolist())
+
+    def run_wave(draft):
+        ecfg = eng.EngineConfig(
+            num_slots=S, max_context=C, prefill_buckets=(32, 128),
+            cache_dtype=jnp.float32, draft=draft)
+        engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
+                            eos_token_ids={cfg.vocab_size - 1})
+        engine.start(precompile=True)
+        try:
+            outs = [engine.submit(eng.GenRequest(
+                prompt_ids=list(p), max_new_tokens=max_new, ignore_eos=True,
+                params=sampling.SamplingParamsHost(temperature=0.0)))
+                for p in prompts]
+            ids, itls = [], []
+            for o in outs:
+                toks, times = [], []
+                while True:
+                    ev = o.get()
+                    if ev is None:
+                        break
+                    got = list(ev.token_ids) if ev.token_ids else (
+                        [ev.token_id] if ev.token_id >= 0 else [])
+                    toks.extend(got)
+                    times.extend([time.monotonic()] * len(got))
+                ids.append(toks)
+                if len(times) > 1:
+                    itls.append((times[-1] - times[0]) / (len(times) - 1))
+            spec = (engine.metrics().get("spec") or {})
+            return ids, itls, spec
+        finally:
+            engine.shutdown()
+
+    ids_off, itls_off, _ = run_wave("0")
+    ids_on, itls_on, spec = run_wave("ngram")
+    out = {"n_req": n_req, "max_new": max_new,
+           "byte_match": ids_on == ids_off,
+           "itl_on_ms": round(float(np.median(itls_on)) * 1e3, 3)
+           if itls_on else None,
+           "itl_off_ms": round(float(np.median(itls_off)) * 1e3, 3)
+           if itls_off else None,
+           "accept_per_dispatch": round(
+               spec.get("accept_per_dispatch", 0.0), 3),
+           "acceptance_rate": round(spec.get("acceptance_rate", 0.0), 3),
+           "rounds": spec.get("rounds", 0),
+           "dispatches": spec.get("dispatches", 0),
+           "mixed_dispatches": spec.get("mixed_dispatches", 0)}
+    if out["itl_on_ms"] and out["itl_off_ms"]:
+        out["itl_speedup"] = round(out["itl_off_ms"] / out["itl_on_ms"], 2)
+    return out
+
+
 def bench_slo(cfg, S, C, n_low=6, n_high=4, max_new=8):
     """Per-class SLO burn-rate + violation flight-recorder scenario
     (ISSUE 12), on ONE engine with a deliberately split objective:
@@ -1767,6 +1846,64 @@ def _engine_direct_slo(deadline: float, partial: dict) -> dict:
     return out
 
 
+def _engine_direct_spec(deadline: float, partial: dict) -> dict:
+    """The speculative-decoding scenario (ISSUE 13) as a bench phase:
+    n-gram self-speculation on vs off over the same greedy wave —
+    accepted-tokens-per-dispatch, ITL both ways, byte-identical output —
+    engine-direct in a subprocess on the CPU-safe smoke shape
+    (LOCALAI_BENCH_SPEC_PRESET to override)."""
+    import subprocess
+
+    sp_preset = os.environ.get("LOCALAI_BENCH_SPEC_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(sp_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": sp_preset,
+        "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_BENCH_DEADLINE_S": "0",
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--spec"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"ok": r.get("ok"),
+                       "accept_per_dispatch": r.get("accept_per_dispatch"),
+                       "acceptance_rate": r.get("acceptance_rate"),
+                       "byte_match": r.get("byte_match"),
+                       "itl_on_ms": r.get("itl_on_ms"),
+                       "itl_off_ms": r.get("itl_off_ms"),
+                       "itl_speedup": r.get("itl_speedup"),
+                       "rounds": r.get("rounds"),
+                       "dispatches": r.get("dispatches"),
+                       "mixed_dispatches": r.get("mixed_dispatches")}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"spec_{k}": v for k, v in out.items()})
+    _emit_phase("spec", out)
+    return out
+
+
 def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     """The PR-2 acceptance scenario as a default-bench phase: multi-turn
     conversations under slot churn, prefix cache on vs off, in one
@@ -1957,7 +2094,7 @@ def main():
     if ("--engine" in sys.argv or "--kernel" in sys.argv
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
             or "--chaos" in sys.argv or "--priority" in sys.argv
-            or "--slo" in sys.argv):
+            or "--slo" in sys.argv or "--spec" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -2079,6 +2216,28 @@ def main():
             }))
             return
 
+        if "--spec" in sys.argv:
+            # speculative decoding (ISSUE 13): f32 weights so the greedy
+            # byte gate compares the spec tick against the plain burst
+            # across two differently shaped programs
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(96, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_spec(cfg, S, C)
+            ok = (r.get("accept_per_dispatch") is not None
+                  and r.get("accept_per_dispatch") > 1.0
+                  and r.get("byte_match") is True)
+            print(json.dumps({
+                "metric": f"spec_{preset}",
+                "value": r.get("accept_per_dispatch"),
+                "unit": "tok/dispatch", "ok": 1 if ok else 0, **r,
+            }))
+            return
+
         if "--slo" in sys.argv:
             # per-class SLO burn + flight recorder (ISSUE 12): a tight
             # low-class TTFT objective must burn and dump, a loose
@@ -2170,13 +2329,19 @@ def main():
         # per-class SLO burn + flight recorder + merged trace (ISSUE 12,
         # scripts/ci.sh SLO_BURN_5M/SLO_VIOLATIONS/TRACE_MERGED line)
         slo = _engine_direct_slo(deadline, partial)
+        # speculative decoding (ISSUE 13, scripts/ci.sh
+        # SPEC_ACCEPT_PER_DISPATCH/SPEC_BYTE_MATCH line): n-gram
+        # self-speculation must beat 1.0 accepted-tokens-per-dispatch
+        # and stay byte-identical to speculation-off greedy
+        spec = _engine_direct_spec(deadline, partial)
         ok = ("paged_tok_s" in layout_cmp
               and packed.get("greedy_match") is True
               and multiturn.get("greedy_match") is True
               and offload.get("greedy_match") is True
               and "host_device_decomp_ms" in decomp
               and "host_device_decomp_ms" in decomp_off
-              and slo.get("ok") == 1)
+              and slo.get("ok") == 1
+              and spec.get("ok") == 1)
         print(json.dumps({
             "metric": "bench_smoke", "value": 1 if ok else 0, "unit": "ok",
             "kv_layout_compare": layout_cmp,
@@ -2208,6 +2373,11 @@ def main():
             "slo_burn_5m": slo.get("burn_5m_low"),
             "slo_violations": slo.get("violations_low"),
             "trace_merged": slo.get("trace_merged"),
+            # speculative decoding (ISSUE 13): accepted tokens per verify
+            # dispatch with draft=ngram, byte parity vs speculation off
+            "spec": spec,
+            "spec_accept_per_dispatch": spec.get("accept_per_dispatch"),
+            "spec_byte_match": spec.get("byte_match"),
         }))
         sys.exit(0 if ok else 1)
 
@@ -2232,6 +2402,7 @@ def main():
     chaos_cmp = _engine_direct_chaos(deadline, partial)
     priority_cmp = _engine_direct_priority(deadline, partial)
     slo_cmp = _engine_direct_slo(deadline, partial)
+    spec_cmp = _engine_direct_spec(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -2259,6 +2430,7 @@ def main():
                 "chaos": chaos_cmp,
                 "priority": priority_cmp,
                 "slo": slo_cmp,
+                "spec": spec_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -2373,6 +2545,7 @@ def main():
         "chaos": chaos_cmp,
         "priority": priority_cmp,
         "slo": slo_cmp,
+        "spec": spec_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

@@ -205,6 +205,11 @@ _DEVICE_MEM_GAUGES = (("bytes_in_use", "bytes_in_use"),
 # (model, priority, metric, window) + violation totals, from engine
 # metrics()["slo"]; flight-recorder dump counters ride along
 _SLO_WINDOWS = (("burn_5m", "5m"), ("burn_1h", "1h"))
+# speculative decoding (ISSUE 13): per-round totals + the acceptance
+# rate, from engine metrics()["spec"]
+_SPEC_COUNTERS = (("rounds", "spec_rounds_total"),
+                  ("proposed", "spec_proposed_total"),
+                  ("accepted", "spec_accepted_total"))
 
 
 def _refresh_engine_metrics(state):
@@ -234,6 +239,8 @@ def _refresh_engine_metrics(state):
               "slo_burn_rate", "slo_objective_ms", "slo_violations_total",
               "slo_error_budget", "flight_dumps_total",
               "flight_dumps_suppressed_total",
+              *(m for _k, m in _SPEC_COUNTERS),
+              "spec_acceptance_rate",
               "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
     # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
@@ -305,6 +312,17 @@ def _refresh_engine_metrics(state):
             for cls, n in (sch.get("queued_by_class") or {}).items():
                 METRICS.set_gauge("queue_depth_class", n,
                                   label_str(model=name, priority=cls))
+        # speculative decoding (ISSUE 13): per-round proposal/acceptance
+        # totals + the derived acceptance rate, skipped when the engine
+        # resolved speculation off (non-llama, lockstep, draft=0)
+        spec = stats.get("spec")
+        if spec and spec.get("mode") not in (None, "off"):
+            for skey, mkey in _SPEC_COUNTERS:
+                METRICS.set_counter(mkey, spec.get(skey, 0),
+                                    label_str(model=name))
+            METRICS.set_gauge("spec_acceptance_rate",
+                              spec.get("acceptance_rate", 0.0),
+                              label_str(model=name))
         # system observability (ISSUE 8): compile counters, memory
         # watermarks, goodput/MFU
         so = stats.get("sysobs")

@@ -426,6 +426,19 @@ class EngineServicer(BackendServicer):
                 extra.get("slo_queue_wait_ms", "") or "")) else {}),
             **({"slo_error_budget": seb} if (seb := float(
                 extra.get("slo_error_budget", 0) or 0)) > 0 else {}),
+            # speculative decoding (ISSUE 13): draft picks the drafter
+            # (auto = model when a draft model is loaded, else n-gram
+            # self-speculation; 0/off disables), n_draft sets the
+            # proposal depth (explicit 0 disables, so isdigit passes it
+            # through), spec_ngram the lookup n-gram length
+            **({"draft": dr} if (dr := str(
+                extra.get("draft", "") or "").strip().lower()) in
+               ("auto", "model", "ngram", "0", "off", "none", "false")
+               else {}),
+            **({"n_draft": int(v)} if (v := str(
+                extra.get("n_draft", "")).strip()).isdigit() else {}),
+            **({"spec_ngram": sn} if (sn := int(
+                extra.get("spec_ngram", 0) or 0)) > 0 else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,

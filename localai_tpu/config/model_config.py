@@ -155,7 +155,11 @@ class ModelConfig:
     # the per-class SLO objectives (ISSUE 12) slo_ttft_ms= / slo_itl_ms=
     # / slo_queue_wait_ms= with value "500" (all classes), "250:1000:5000"
     # (high:normal:low) or "high=250:low=5000" (named subset) and
-    # slo_error_budget=F (allowed violation fraction, default 0.01).
+    # slo_error_budget=F (allowed violation fraction, default 0.01), or
+    # the speculative-decoding knobs (ISSUE 13) draft=auto|model|ngram|0
+    # (auto = draft model when loaded, else n-gram self-speculation;
+    # 0 disables), n_draft=N (proposal depth per round, 0 disables) and
+    # spec_ngram=N (lookup n-gram length, default 3).
     # The known knobs are value-validated in validate() so a typo fails
     # at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
@@ -259,7 +263,10 @@ class ModelConfig:
                        # respective guard (aging / reserve / preemption cap)
                        "max_preemptions",
                        "resume_reserve_pages",
-                       "priority_aging_ms") and not v.isdigit():
+                       "priority_aging_ms",
+                       # speculative decoding (ISSUE 13); explicit
+                       # n_draft=0 disables speculation
+                       "n_draft") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
@@ -292,6 +299,13 @@ class ModelConfig:
             elif k == "comm_overlap" and v not in ("auto", "0", "1"):
                 problems.append(
                     f"comm_overlap must be auto|0|1, got {v!r}")
+            elif k == "draft" and v.lower() not in (
+                    "auto", "model", "ngram", "0", "off", "none", "false"):
+                problems.append(
+                    f"draft must be auto|model|ngram|0, got {v!r}")
+            elif k == "spec_ngram" and not (v.isdigit() and int(v) > 0):
+                problems.append(
+                    f"spec_ngram must be a positive integer, got {v!r}")
             elif k == "peak_tflops":
                 try:
                     if float(v) < 0:

@@ -176,6 +176,17 @@ class EngineConfig:
     # speculative decoding: draft proposals per round (0 disables even
     # when a draft model is loaded); greedy slots only
     n_draft: int = 4
+    # drafting mode (ISSUE 13): "auto" uses the loaded draft model when
+    # one exists and falls back to model-free n-gram self-speculation
+    # (prompt-lookup over the slot's own token ring) for llama-family
+    # greedy slots; "model" / "ngram" force a drafter; "0" disables
+    # speculation entirely. Greedy speculation is LOSSLESS whatever the
+    # drafter proposes (see engine/speculative.py).
+    draft: str = "auto"
+    # n-gram length the prompt-lookup drafter matches against the token
+    # ring (draft=ngram); longer grams propose less often but more
+    # accurately on repetitive continuations
+    spec_ngram: int = 3
     # decode BURST: run up to this many decode steps per device dispatch
     # (lax.scan), amortizing per-dispatch overhead (measured ~3-12 ms on the
     # serving chip — larger than one step's compute). Grammar-constrained
@@ -394,13 +405,18 @@ class _Burst:
     __slots__ = ("n_steps", "slots", "pack", "group", "t_dispatch",
                  "t_ready", "pack_np", "ids_np", "lps_np", "first_ids",
                  "first_lps", "folded", "skip_slots", "ready", "err",
-                 "head")
+                 "head", "spec_mask", "spec_width", "n_out_np")
 
     def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0,
                  head=None):
         self.n_steps = n_steps
         self.slots = slots          # [(index, _Slot snapshot), ...]
         self.pack = pack            # device [2K+1(+2), S] f32
+        # fused spec tick (ISSUE 13): per-slot spec mask and tokens per
+        # round (n_draft + 1); spec_width 0 marks a plain burst
+        self.spec_mask = None
+        self.spec_width = 0
+        self.n_out_np = None        # [R, S] per-round emit counts
         self.group = list(group)    # fused-admission slots (subset of slots)
         # early-emit split: the _PendingPrefill head this burst is
         # chained off on-device. The sync worker readies the head FIRST
@@ -461,13 +477,14 @@ class _PendingOffload:
     dispatch order, so offloading never stalls the serving loop. Once
     materialized, the worker inserts the pages straight into the host
     store (HostPageStore locks internally)."""
-    __slots__ = ("metas", "k_rows", "v_rows", "store", "err")
+    __slots__ = ("metas", "k_rows", "v_rows", "store", "d_rows", "err")
 
-    def __init__(self, metas, k_rows, v_rows, store):
+    def __init__(self, metas, k_rows, v_rows, store, d_rows=None):
         self.metas = metas        # [(key, parent, depth), ...] per page
         self.k_rows = k_rows      # device [L, B, pg, KV, hd] (+ scales)
         self.v_rows = v_rows
         self.store = store
+        self.d_rows = d_rows      # (dk, dv) draft-cache rows or None
         self.err = None
 
     def run(self):
@@ -476,6 +493,10 @@ class _PendingOffload:
 
         k_np = _jax.tree.map(np.asarray, self.k_rows)
         v_np = _jax.tree.map(np.asarray, self.v_rows)
+        dk_np = dv_np = None
+        if self.d_rows is not None:
+            dk_np = _jax.tree.map(np.asarray, self.d_rows[0])
+            dv_np = _jax.tree.map(np.asarray, self.d_rows[1])
 
         def page(rows, i):
             if isinstance(rows, dict):
@@ -484,7 +505,10 @@ class _PendingOffload:
             return np.ascontiguousarray(rows[:, i])
 
         for i, (key, parent, depth) in enumerate(self.metas):
-            self.store.put(key, parent, depth, page(k_np, i), page(v_np, i))
+            self.store.put(
+                key, parent, depth, page(k_np, i), page(v_np, i),
+                dk=page(dk_np, i) if dk_np is not None else None,
+                dv=page(dv_np, i) if dv_np is not None else None)
 
 
 class _Slot:
@@ -573,6 +597,26 @@ class Engine:
         self.params = params
         # speculative decoding (greedy-lossless; see engine/speculative.py)
         self.draft_cfg, self.draft_params = draft if draft else (None, None)
+        # drafting-mode resolution (ISSUE 13): llama-family only (the
+        # spec tick composes llama.prefill), never in lockstep (spec
+        # dispatches are not in the descriptor set) and never with
+        # self-extend (rounds advance row=position). Everything outside
+        # those engine modes keeps its pre-spec dispatch stream
+        # bit-for-bit (the fused tick is only ever compiled or
+        # dispatched when _spec_mode != "off").
+        d = str(self.ecfg.draft or "auto").lower()
+        if d in ("0", "off", "none", "false"):
+            mode = "off"
+        elif d == "model":
+            mode = "model" if self.draft_params is not None else "off"
+        elif d == "ngram":
+            mode = "ngram"
+        else:   # auto
+            mode = "model" if self.draft_params is not None else "ngram"
+        if (not self._fam_llama or bus is not None or self.ecfg.ga_n > 1
+                or self.ecfg.n_draft <= 0):
+            mode = "off"
+        self._spec_mode = mode
         self._state_shardings = self._make_state_shardings()
         # paged KV layout resolution (EngineConfig.kv_layout doc):
         # llama-family only; lockstep followers can't replay the leader's
@@ -703,8 +747,13 @@ class Engine:
         self._burst_fns: dict[int, Callable] = {}
         self._chunk_fns: dict[int, Callable] = {}
         self._final_fns: dict[tuple, Callable] = {}
-        self._spec_fn = None
-        self._spec_turn = True   # mixed-traffic spec/burst alternation
+        # fused spec-tick counters (ISSUE 13): dispatches = spec ticks
+        # issued, mixed_dispatches = ticks that carried BOTH spec rounds
+        # and plain-decode rows, rounds/proposed/accepted = per-slot
+        # round totals, tokens = emitted spec tokens (accepted + bonus)
+        self._spec_stats = {"dispatches": 0, "mixed_dispatches": 0,
+                            "rounds": 0, "proposed": 0, "accepted": 0,
+                            "tokens": 0}
 
         # pipelined decode state (r4 redesign): bursts chain device-side
         # through (tokens, lengths, ring, ring_pos, mu) output handles, and
@@ -1134,8 +1183,12 @@ class Engine:
         # ck and cv are donated separately, so they need DISTINCT table
         # buffers — but one stacked host->device transfer plus two
         # device-side slices beats two independent uploads (ISSUE 9:
-        # half the transfer dispatches on every allocator change)
-        stacked = np.stack((self._pool.ptab, self._pool.ptab))
+        # half the transfer dispatches on every allocator change). The
+        # paged draft cache (ISSUE 13) rides the SAME table: draft rows
+        # live at the same page ids as the target's, so spec slots share
+        # the prefix cache and offload/restore machinery for free.
+        n = 4 if self.dck is not None else 2
+        stacked = np.stack((self._pool.ptab,) * n)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1145,6 +1198,9 @@ class Engine:
             both = jnp.asarray(stacked)
         self.ck = kvcache.with_page_table(self.ck, both[0])
         self.cv = kvcache.with_page_table(self.cv, both[1])
+        if self.dck is not None:
+            self.dck = kvcache.with_page_table(self.dck, both[2])
+            self.dcv = kvcache.with_page_table(self.dcv, both[3])
         self._pool.dirty = False
 
     def _reclaim_pages(self, slot, need_free: int):
@@ -1245,12 +1301,24 @@ class Engine:
             self._fork_fns["page_clone"] = fn
         return fn
 
+    def _get_draft_clone_fn(self):
+        fn = self._fork_fns.get("page_clone_draft")
+        if fn is None:
+            self._cobs.note_program("page_clone_draft")
+            fn = jax.jit(
+                lambda ck, cv, src, dst: (kvcache.clone_page(ck, src, dst),
+                                          kvcache.clone_page(cv, src, dst)),
+                donate_argnums=(0, 1))
+            self._fork_fns["page_clone_draft"] = fn
+        return fn
+
     def _cow_guard(self, slot: int, row: int):
         """Copy-on-write: if the page containing ``row`` (the slot's first
         write position) is shared, clone it into a fresh page before any
         scatter can touch it. Pages before it stay shared — zero copies
         for the common prefix; this one page is the 'first divergent
-        page' clone."""
+        page' clone. The paged draft cache clones the same page id: its
+        rows diverge exactly when the target's do."""
         if not self._paged:
             return
         pi = self._pool.cow_page(slot, row)
@@ -1261,6 +1329,9 @@ class Engine:
         self._commit_ptab()
         self.ck, self.cv = self._get_page_clone_fn()(
             self.ck, self.cv, np.int32(old), np.int32(new))
+        if self.dck is not None:
+            self.dck, self.dcv = self._get_draft_clone_fn()(
+                self.dck, self.dcv, np.int32(old), np.int32(new))
         self._pool.replace(slot, pi, new)
 
     def _get_offload_gather_fn(self, batch: int):
@@ -1302,8 +1373,17 @@ class Engine:
         with self._annot("kv_offload_gather"):
             k_rows, v_rows = self._get_offload_gather_fn(B)(self.ck,
                                                             self.cv, idx)
+        d_rows = None
+        if self.dck is not None:
+            # paged draft cache (ISSUE 13): offload the draft rows of the
+            # same pages so a restored spec slot resumes drafting without
+            # a cold draft cache (the gather fn re-specializes per cache
+            # shape under jit, so the same callable serves both)
+            with self._annot("kv_offload_gather_draft"):
+                d_rows = self._get_offload_gather_fn(B)(self.dck,
+                                                        self.dcv, idx)
         item = _PendingOffload([(k, p, d) for k, p, d, _pg in victims],
-                               k_rows, v_rows, self._hstore)
+                               k_rows, v_rows, self._hstore, d_rows)
         self._sync_q.put(item)
         self._tmark("offload_dispatch", t0)
         if self.tracer.enabled:
@@ -1350,6 +1430,26 @@ class Engine:
         with self._annot("kv_restore_scatter"):
             self.ck, self.cv = self._get_restore_scatter_fn(B)(
                 self.ck, self.cv, idx, ks, vs)
+        # paged draft cache (ISSUE 13): restore the draft rows of any hit
+        # that carried them (entries offloaded pre-draft, loaded from an
+        # old disk snapshot, or whose draft payload failed its CRC have
+        # dk None — their draft rows stay cold, which is merely an
+        # acceptance-rate hit, never a correctness one)
+        dhits = [(j, e) for j, e in enumerate(host_hits)
+                 if e.dk is not None] if self.dck is not None else []
+        if dhits:
+            B2 = 1
+            while B2 < len(dhits):
+                B2 *= 2
+            didx = np.full((B2,), pool.num_pages, np.int32)
+            for c, (j, _e) in enumerate(dhits):
+                didx[c] = pages[j]
+            dents = [e for _j, e in dhits]
+            dks = self._rstager.fill(par, "dk", dents, lambda e: e.dk, B2)
+            dvs = self._rstager.fill(par, "dv", dents, lambda e: e.dv, B2)
+            with self._annot("kv_restore_scatter_draft"):
+                self.dck, self.dcv = self._get_restore_scatter_fn(B2)(
+                    self.dck, self.dcv, didx, dks, dvs)
         for e, p in zip(host_hits, pages[:n]):
             pool.adopt(slot, p)
             # restored pages re-enter the device tier immediately: the
@@ -1376,6 +1476,9 @@ class Engine:
             self._commit_ptab()
             self.ck, self.cv = self._get_page_clone_fn()(
                 self.ck, self.cv, np.int32(src_page), np.int32(new))
+            if self.dck is not None:
+                self.dck, self.dcv = self._get_draft_clone_fn()(
+                    self.dck, self.dcv, np.int32(src_page), np.int32(new))
             self._pool.adopt(dst, new)
             shared = rows
         return shared
@@ -1888,9 +1991,10 @@ class Engine:
     def _get_draft_packed_fn(self, bucket: int):
         """Draft-model ragged prompt ingestion (open PR-4 follow-up:
         spec slots are packed citizens now). Same ragged program as the
-        target's, minus sampling — the draft cache is contiguous, so
-        scatter_ragged takes its contiguous branch and the attention
-        reads ride the jnp path."""
+        target's, minus sampling — the draft cache embeds its own layout
+        (paged since ISSUE 13, riding the main page table; contiguous on
+        the fallbacks), so scatter_ragged branches to the right path by
+        itself."""
         key = ("draft_packed", bucket)
         fn = self._chunk_fns.get(key)
         if fn is None:
@@ -1927,7 +2031,9 @@ class Engine:
 
     def _get_draft_chunk_fn(self, bucket: int):
         """Draft-model prompt ingestion (the draft has its OWN config —
-        the target-cfg chunk body would mis-shape or mis-parameterize it)."""
+        the target-cfg chunk body would mis-shape or mis-parameterize
+        it). The draft cache embeds its layout, so the same body serves
+        the paged draft cache (ISSUE 13) and the contiguous fallbacks."""
         key = ("draft", bucket)
         fn = self._chunk_fns.get(key)
         if fn is None:
@@ -2050,6 +2156,40 @@ class Engine:
                     self.params, self.cur_tokens, self.ck, self.cv, self.lengths,
                     self.ring, self.ring_pos, self.bias, self.rng_keys,
                     spp, self.active_dev, self.mu, no_ov)
+        if self._spec_mode != "off" and self.ecfg.ga_n <= 1:
+            # fused spec-tick ladder (ISSUE 13): same pow2 discipline as
+            # the burst ladder, capped exactly like _plan_spec so no spec
+            # round-count ever compiles mid-serving. The warmup mask is
+            # all-inactive: every KV write drops.
+            if self._spec_mode == "model":
+                self._ensure_draft_cache()
+                self._commit_ptab()
+            no_spec = np.zeros((S,), np.bool_)
+            r = 1
+            rs = []
+            while r <= max(1, self.ecfg.decode_burst
+                           // (self.ecfg.n_draft + 1)):
+                rs.append(r)
+                r *= 2
+            for r in rs:
+                for flags in ((False, False, False), (True, True, True)):
+                    fn = self._get_spec_tick_fn(r, flags)
+                    if self._spec_mode == "model":
+                        (_, self.ck, self.cv, self.rng_keys, _,
+                         self.dck, self.dcv) = fn(
+                            self.params, self.cur_tokens, self.ck,
+                            self.cv, self.lengths, self.ring,
+                            self.ring_pos, self.bias, self.rng_keys,
+                            spp, self.active_dev, self.mu, no_ov,
+                            no_spec, self.draft_params, self.dck,
+                            self.dcv)
+                    else:
+                        _, self.ck, self.cv, self.rng_keys, _ = fn(
+                            self.params, self.cur_tokens, self.ck,
+                            self.cv, self.lengths, self.ring,
+                            self.ring_pos, self.bias, self.rng_keys,
+                            spp, self.active_dev, self.mu, no_ov,
+                            no_spec)
         for bucket in self._buckets:
             one = np.ones((1,), np.int32)
             zero = np.zeros((1,), np.int32)
@@ -2145,6 +2285,16 @@ class Engine:
                     jax.tree.map(np.asarray, rows[0]))
                 self.ck, self.cv = self._get_restore_scatter_fn(B)(
                     self.ck, self.cv, idx_s, zeros, zeros)
+                if self.dck is not None and self._paged:
+                    # draft-cache shapes re-specialize the same jitted
+                    # gather/scatter callables (ISSUE 13): warm them too
+                    drows = self._get_offload_gather_fn(B)(
+                        self.dck, self.dcv, idx_g)
+                    dzeros = jax.tree.map(
+                        lambda a: np.zeros(a.shape, a.dtype),
+                        jax.tree.map(np.asarray, drows[0]))
+                    self.dck, self.dcv = self._get_restore_scatter_fn(B)(
+                        self.dck, self.dcv, idx_s, dzeros, dzeros)
                 B *= 2
         # admission-path op-level helpers: seed_slot_key builds a PRNGKey
         # (broadcast + squeeze) and scatters it into the key matrix —
@@ -2385,6 +2535,22 @@ class Engine:
             "prefill_packed_fuse": self._pack_fuse,
             "prefill_token_budget": self._pack_budget,
             "packed_prefill": dict(self._pack_stats),
+        }
+        # speculative decoding (ISSUE 13): per-round counters + the two
+        # derived rates the bench/CI gate on — acceptance (accepted /
+        # proposed) and accepted-tokens-per-dispatch (emitted spec
+        # tokens, bonus included, per slot-round — the per-dispatch
+        # verify unit; 1.0 means speculation is buying nothing, >1.0 is
+        # the whole point)
+        st = self._spec_stats
+        out["spec"] = {
+            "mode": self._spec_mode,
+            "n_draft": self.ecfg.n_draft,
+            **st,
+            "acceptance_rate": (st["accepted"] / st["proposed"]
+                                if st["proposed"] else 0.0),
+            "accept_per_dispatch": (st["tokens"] / st["rounds"]
+                                    if st["rounds"] else 0.0),
         }
         if self._paged:
             out["kv_layout"] = "paged"
@@ -3016,12 +3182,13 @@ class Engine:
         """Pausable slots only: pause/resume round-trips through token
         re-admission, so anything whose slot state is NOT reconstructible
         from tokens is excluded — grammar automata (mid-generation state),
-        multimodal rows (image embeddings, not tokens), draft-mirrored
-        spec slots (the draft cache has no restore path), prompt-cache
+        multimodal rows (image embeddings, not tokens), prompt-cache
         requests (their save path assumes one continuous tenancy), and
-        fork leaders with waiters still attached."""
+        fork leaders with waiters still attached. Spec slots are
+        pausable since ISSUE 13: the paged draft cache offloads/restores
+        with the main pages, the n-gram drafter has no slot state, and a
+        contiguous-draft slot simply resumes without speculation."""
         return (s.grammar is None and s.mm_pos is None
-                and not s.spec_ok
                 and not s.req.prompt_cache_path
                 and s.phase in ("prefill", "decode")
                 and slot not in self._fork_waiters
@@ -3439,26 +3606,30 @@ class Engine:
         s.cur_penalty = penalty0
         s.mm_pos, s.mm_vec = mm_pos, mm_vec
         self._init_ga(slot, s, len(ids))
-        # per-SLOT speculation eligibility (r3; r2 was fleet-wide). Gates:
-        #   * greedy, ungrammared, no logit_bias and no penalties — the
-        #     spec verify accepts via raw argmax (speculative.py), so any
-        #     logit shaping would silently diverge from the burst sampler;
-        #   * no reused prefix (common == 0) — reused/restored rows exist
-        #     only in the MAIN cache; the draft would attend over zeros
-        #     for the prefix and every proposal would be garbage.
+        # per-SLOT speculation eligibility (ISSUE 13: per-request, any
+        # drafting mode — with draft=auto every llama-family greedy
+        # request speculates via n-gram self-drafting). Gates: greedy,
+        # ungrammared, no logit_bias, no penalties — the spec verify
+        # accepts via the sampler's own greedy top-k, so any logit
+        # shaping would silently diverge from the burst sampler. The
+        # n-gram drafter has no draft state, so reused prefixes and
+        # preemption resumes stay eligible; the model drafter on the
+        # CONTIGUOUS fallback still requires a draft-mirrored prompt (no
+        # reused prefix, no resume) — only the PAGED draft cache shares
+        # and restores prefix rows (stale draft planes there cost
+        # acceptance quality, never correctness).
         sp = req.params
-        s.spec_ok = (self.draft_params is not None and self.ecfg.n_draft > 0
+        s.spec_ok = (self._spec_mode != "off"
                      and sp.temperature <= 0 and not req.grammar
-                     and mm_pos is None and common == 0
+                     and mm_pos is None
                      and not sp.logit_bias
                      and sp.repeat_penalty in (0.0, 1.0)
                      and sp.presence_penalty == 0.0
                      and sp.frequency_penalty == 0.0)
-        if resume is not None:
-            # the draft cache holds no restore path for the resumed
-            # history; spec acceptance would attend over draft zeros
+        if self._spec_mode == "model" and not self._paged \
+                and (common != 0 or resume is not None):
             s.spec_ok = False
-        if s.spec_ok:
+        if s.spec_ok and self._spec_mode == "model":
             self._ensure_draft_cache()
         s.pending = ids[common:]
         s.written = common
@@ -3580,23 +3751,37 @@ class Engine:
                 s.reused = shared
                 self._reused_total += shared
                 self._cache_tokens[sib] = list(ids)
-                # the draft cache stays contiguous and unshared — paged
-                # siblings never join spec rounds
-                s.spec_ok = False
+                # paged siblings share the draft planes of the same pages
+                # (ISSUE 13), so spec eligibility follows the same
+                # admission purity gates as _start_request
+                fsp = s.req.params
+                s.spec_ok = (self._spec_mode != "off"
+                             and fsp.temperature <= 0 and not s.req.grammar
+                             and s.mm_pos is None
+                             and not fsp.logit_bias
+                             and fsp.repeat_penalty in (0.0, 1.0)
+                             and fsp.presence_penalty == 0.0
+                             and fsp.frequency_penalty == 0.0)
+                if s.spec_ok and self._spec_mode == "model":
+                    self._ensure_draft_cache()
             elif leader_ok and len(ids) > 1:
                 n = len(ids) - 1
                 self.ck, self.cv = self._get_fork_fn("main")(
                     self.ck, self.cv, leader_slot, sib, n)
-                # a sibling inherits spec eligibility only when the leader's
-                # draft rows exist to fork and its own request qualifies
-                # under the same admission gates (see _start_request)
+                # a sibling qualifies under the same purity gates as
+                # admission; with the model drafter it additionally needs
+                # the leader's draft rows to exist so they can be forked
                 sp = s.req.params
-                s.spec_ok = (lsnap.spec_ok and self.dck is not None
-                             and sp.temperature <= 0 and not s.req.grammar
-                             and not sp.logit_bias
-                             and sp.repeat_penalty in (0.0, 1.0)
-                             and sp.presence_penalty == 0.0
-                             and sp.frequency_penalty == 0.0)
+                pure = (sp.temperature <= 0 and not s.req.grammar
+                        and not sp.logit_bias
+                        and sp.repeat_penalty in (0.0, 1.0)
+                        and sp.presence_penalty == 0.0
+                        and sp.frequency_penalty == 0.0)
+                if self._spec_mode == "model":
+                    s.spec_ok = (pure and lsnap.spec_ok
+                                 and self.dck is not None)
+                else:
+                    s.spec_ok = self._spec_mode != "off" and pure
                 if self.dck is not None and lsnap.spec_ok:
                     self.dck, self.dcv = self._get_fork_fn("draft")(
                         self.dck, self.dcv, leader_slot, sib, n)
@@ -4314,7 +4499,7 @@ class Engine:
                 continue
             s.phase = "decode"
             # cache_len must reflect the prompt rows NOW (_pick_burst /
-            # _spec_eligible cost capacity against in-flight steps)
+            # _plan_spec cost capacity against in-flight steps)
             s.cache_len = s.written
             self.lengths[slot] = s.written
             self.active_dev[slot] = True
@@ -4537,7 +4722,7 @@ class Engine:
             gs.written += gtake
             gs.phase = "decode"
             # cache_len must reflect the prompt rows NOW: _pick_burst and
-            # _spec_eligible cost capacity as cache_len + inflight decode
+            # _plan_spec cost capacity as cache_len + inflight decode
             # steps, and the fused burst is in flight from this moment
             gs.cache_len = gs.written
             self.lengths[gslot] = gs.written
@@ -4794,7 +4979,12 @@ class Engine:
             gset = {i for i, _ in b.group}
             for i, _ in b.slots:
                 if i not in b.skip_slots:
-                    n[i] += b.n_steps + (1 if i in gset else 0)
+                    # spec-masked slots may emit up to W tokens per round
+                    # (conservative upper bound — acceptance is unknown
+                    # until the tick syncs)
+                    w = (b.spec_width if b.spec_width
+                         and b.spec_mask[i] else 1)
+                    n[i] += b.n_steps * w + (1 if i in gset else 0)
         return n
 
     def _inflight_steps(self, slot: int) -> int:
@@ -4869,18 +5059,6 @@ class Engine:
                 break
         return progressed
 
-    def _drain_all(self):
-        """Sync + process every dispatched item (spec rounds and device
-        resets need the host mirrors fully caught up). Bursts first in
-        device order, then any remaining prefill groups (waiting on the
-        sync worker where needed)."""
-        while self._fifo:
-            head = self._fifo.popleft()
-            if isinstance(head, _Burst):
-                self._process_burst(head)
-            else:
-                self._process_prefill(head)
-
     def _pick_burst(self, extra=None, infl_vec=None) -> int:
         """Burst length for this dispatch: a power of two <= decode_burst,
         clamped so no slot crosses its context-shift threshold mid-burst
@@ -4932,86 +5110,219 @@ class Engine:
         return k
 
     def _ensure_draft_cache(self):
-        if self.dck is None and self.draft_cfg is not None:
-            self.dck, self.dcv = llama.init_cache(
-                self.draft_cfg, self.ecfg.num_slots, self.ecfg.max_context,
-                self.ecfg.cache_dtype)
+        """Lazily materialize the draft-model KV cache (model drafter
+        only — the n-gram drafter has no draft state). On paged engines
+        it lives in the PAGED pool riding the MAIN page table (ISSUE
+        13): draft rows sit at the same page ids as the target's, so
+        prefix sharing, COW cloning and offload/restore extend to spec
+        slots with no second allocator."""
+        if self.dck is not None or self.draft_cfg is None:
+            return
+        self.dck, self.dcv = llama.init_cache(
+            self.draft_cfg, self.ecfg.num_slots, self.ecfg.max_context,
+            self.ecfg.cache_dtype,
+            **({"page_size": self._pool.page_size,
+                "num_pages": self._pool_pages} if self._paged else {}))
+        if self._paged:
+            # the fresh draft cache carries an empty page table; dirty
+            # the allocator so the next commit stamps live state into it
+            self._pool.dirty = True
 
-    def _get_spec_fn(self):
-        if self._spec_fn is None:
-            from localai_tpu.engine import speculative
+    def _spec_tick_body(self, params, tokens, ck, cv, lengths, ring,
+                        ring_pos, bias, keys, slot_params, active, mu,
+                        ov_pack, spec_mask, dparams=None, dck=None,
+                        dcv=None, *, n_rounds: int,
+                        flags: tuple = (True, True, True)):
+        """The FUSED spec tick (ISSUE 13): n_rounds speculative rounds in
+        ONE dispatch, where spec-masked slots take a D-token
+        draft-propose + target-verify round and every other active slot
+        takes a plain decode+sample step. The plain rows run the exact
+        _make_scan_step ops (engine_decode + sampling.sample, spec rows
+        masked out of the KV write and the state folds) so their stream
+        stays bit-identical to a plain burst; spec rows verify through
+        the same continued-prefill forward spec_round uses, with plain
+        rows parked at the OOB row so the scatter drops them. Replaces
+        the r3 whole-engine spec/burst alternation (_spec_turn) — mixed
+        traffic no longer starves greedy slots of speculation, and spec
+        ticks ride the same pipelined device chain as plain bursts.
 
-            D = self.ecfg.n_draft
-            self._spec_fn = jax.jit(
-                lambda *a: speculative.spec_round(
-                    *a[:2], self.cfg, self.draft_cfg, *a[2:], n_draft=D),
-                donate_argnums=(4, 5, 6, 7))
-        return self._spec_fn
+        Pack layout [2*R*W + R + 1, S] f32: ids (R*W rows, round-major),
+        logprobs (R*W), per-round emit counts (R), mu — where W =
+        n_draft + 1 tokens per spec round (accepted prefix + bonus) and
+        plain rows emit exactly 1 at position 0 of their round."""
+        from localai_tpu.engine import speculative
 
-    def _spec_eligible(self) -> "np.ndarray":
-        """Per-SLOT speculation mask (r3; the r2 design was all-or-nothing
-        across the fleet): a slot joins spec rounds iff it admitted as
-        spec_ok (greedy, ungrammared, draft-mirrored prompt) and has D+1
-        rows of headroom."""
+        sp = sampling.unpack_slot_params(slot_params)
+        tokens, lengths, ring, ring_pos, mu, pos_offset = \
+            self._compose_overrides(tokens, lengths, ring, ring_pos, mu,
+                                    ov_pack)
+        D = self.ecfg.n_draft
+        W = D + 1
         S = self.ecfg.num_slots
-        mask = np.zeros((S,), np.bool_)
-        if self.dck is None or self.ecfg.n_draft <= 0 or self.ecfg.ga_n > 1:
+        C = kvcache.shape(ck)[2]
+        model_mode = dck is not None
+        spec_active = active & spec_mask
+        plain_active = active & ~spec_mask
+        slot_ids = jnp.arange(S, dtype=jnp.int32)
+
+        def round_step(carry, _):
+            (tokens, ck, cv, dck, dcv, lengths, ring, ring_pos, keys,
+             mu) = carry
+            if model_mode:
+                drafts, dck, dcv = speculative.draft_propose(
+                    dparams, self.draft_cfg, tokens, lengths, dck, dcv,
+                    spec_active, D)
+            else:
+                drafts = speculative.ngram_propose(
+                    tokens, ring, ring_pos, D, self.ecfg.spec_ngram)
+            # plain decode step for the non-spec rows (bit-identical ops
+            # to _make_scan_step; spec rows masked out of the KV write)
+            logits, ck, cv = self.family.engine_decode(
+                params, self.cfg, tokens, lengths, plain_active, ck, cv,
+                pos_offset=pos_offset)
+            ids0, lps0, new_keys, new_mu = sampling.sample(
+                logits, sp, ring, ring_pos, bias, keys, mu,
+                use_penalties=flags[0], use_typical=flags[1],
+                use_mirostat=flags[2])
+            keys = jnp.where(plain_active[:, None], new_keys, keys)
+            mu = jnp.where(plain_active, new_mu, mu)
+            # verify forward for the spec rows: current token + D
+            # proposals scored in one continued prefill; plain rows park
+            # at the OOB start so their writes drop (their single KV
+            # write stays the decode step's above)
+            tin = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            seq = jnp.full((S,), W, jnp.int32)
+            start = jnp.where(spec_active, lengths, C)
+            all_logits, ck, cv = self.family.prefill(
+                params, self.cfg, tin, seq, ck, cv, slot_ids, start,
+                continued=True, return_all_logits=True)
+            # greedy picks via the sampler's own top-k primitive:
+            # approx_max_k always retains the global argmax and breaks
+            # ties exactly like sampling.sample's greedy path, so the
+            # spec stream matches plain greedy bit-for-bit
+            k_top = min(sampling.SORT_K, all_logits.shape[-1])
+            _, top_idx = jax.lax.approx_max_k(
+                all_logits.reshape(S * W, -1), k_top)
+            greedy = top_idx[:, 0].astype(jnp.int32).reshape(S, W)
+            out_spec, n_spec, _k = speculative.accept_greedy(
+                drafts, greedy, spec_active)
+            logp = jax.nn.log_softmax(all_logits, axis=-1)
+            lp_spec = jnp.take_along_axis(
+                logp, out_spec[:, :, None], axis=2)[:, :, 0]
+            pad = jnp.zeros((S, D), jnp.int32)
+            out = jnp.where(spec_mask[:, None], out_spec,
+                            jnp.concatenate([ids0[:, None], pad], axis=1))
+            lps = jnp.where(spec_mask[:, None], lp_spec,
+                            jnp.concatenate(
+                                [lps0[:, None], pad.astype(jnp.float32)],
+                                axis=1))
+            n_out = jnp.where(spec_active, n_spec,
+                              plain_active.astype(jnp.int32))
+            for j in range(W):   # W is static: unrolled ring pushes
+                ring, ring_pos = sampling.update_ring(
+                    ring, ring_pos, out[:, j], active & (j < n_out))
+            lengths = lengths + n_out
+            last = jnp.take_along_axis(
+                out, jnp.maximum(n_out - 1, 0)[:, None], axis=1)[:, 0]
+            tokens = jnp.where(active, last, tokens)
+            return ((tokens, ck, cv, dck, dcv, lengths, ring, ring_pos,
+                     keys, mu), (out.T, lps.T, n_out))
+
+        carry = (tokens, ck, cv, dck, dcv, lengths, ring, ring_pos, keys,
+                 mu)
+        carry, (ids_all, lps_all, n_all) = jax.lax.scan(
+            round_step, carry, None, length=n_rounds)
+        (tokens, ck, cv, dck, dcv, lengths, ring, ring_pos, keys,
+         mu) = carry
+        R = n_rounds
+        pack = jnp.concatenate(
+            [ids_all.reshape(R * W, S).astype(jnp.float32),
+             lps_all.reshape(R * W, S),
+             n_all.astype(jnp.float32), mu[None, :]], axis=0)
+        chain = (tokens, lengths, ring, ring_pos, mu)
+        if model_mode:
+            return pack, ck, cv, keys, chain, dck, dcv
+        return pack, ck, cv, keys, chain
+
+    def _get_spec_tick_fn(self, n_rounds: int,
+                          flags: tuple = (True, True, True)):
+        key = ("spec_tick", n_rounds, flags)
+        fn = self._burst_fns.get(key)
+        if fn is None:
+            self._cobs.note_program("spec_tick", (n_rounds, flags))
+            donate = ((2, 3, 8, 15, 16) if self._spec_mode == "model"
+                      else (2, 3, 8))
+            fn = jax.jit(
+                lambda *a: self._spec_tick_body(*a, n_rounds=n_rounds,
+                                                flags=flags),
+                donate_argnums=donate)
+            self._burst_fns[key] = fn
+        return fn
+
+    def _plan_spec(self, included: list, infl: list):
+        """Spec plan for this tick: (n_rounds, spec_mask) or None for a
+        plain burst. A slot joins spec rounds iff it admitted spec_ok
+        (greedy, ungrammared) and has W = n_draft + 1 rows of headroom
+        past the steps already in flight; everyone else in ``included``
+        rides the same tick as a plain-decode row. Round count follows
+        _pick_burst's sizing discipline with spec slots charged W rows
+        and W tokens of budget per round, floored to a power of two so
+        only the precompiled ladder ever runs."""
+        if self._spec_mode == "off" or self.ecfg.ga_n > 1:
             # spec rounds advance positions row=position; they are not
             # self-extend-aware — mutually exclusive features
-            return mask
-        D = self.ecfg.n_draft
-        for i, s in enumerate(self.slots):
-            if (s is not None and s.phase == "decode" and s.spec_ok
-                    and self.ecfg.max_context - 2 - s.cache_len >= D + 1):
+            return None
+        if self._spec_mode == "model" and self.dck is None:
+            return None
+        W = self.ecfg.n_draft + 1
+        S = self.ecfg.num_slots
+        C = self.ecfg.max_context
+        mask = np.zeros((S,), np.bool_)
+        for i in included:
+            s = self.slots[i]
+            if s.spec_ok and C - 2 - (s.cache_len + infl[i]) >= W:
                 mask[i] = True
-        return mask
-
-    def _spec_once(self, eligible: "np.ndarray"):
-        """One speculative round for the ELIGIBLE slots only (no
-        pipelining: rounds advance lengths per-slot, so the burst chain is
-        not reusable). The caller drains the dispatch FIFO first."""
-        assert not self._fifo, "_spec_once requires a drained FIFO"
-        fn = self._get_spec_fn()
-        burst_slots = [(i, s) for i, s in enumerate(self.slots)
-                       if s is not None and s.phase == "decode"
-                       and eligible[i]]
-        if self._paged:
-            C = self.ecfg.max_context
-            for i, _s in burst_slots:
-                self._ensure_pages(i, min(C, int(self.lengths[i])
-                                          + self.ecfg.n_draft + 2))
-            self._commit_ptab()
-        out, out_lp, n_out, self.ck, self.cv, self.dck, self.dcv, _ = fn(
-            self.params, self.draft_params, self.cur_tokens.copy(),
-            self.lengths.copy(), self.ck, self.cv, self.dck, self.dcv,
-            self.active_dev.copy() & eligible)
-        out_np = np.asarray(out)
-        lp_np = np.asarray(out_lp)
-        n_np = np.asarray(n_out)
-        self._chain = None
-        self._override.clear()
-        for i, snap in burst_slots:
-            if not self._live(i, snap):
-                continue
-            n = int(n_np[i])
-            if n <= 0:
-                continue
-            self.cur_tokens[i] = out_np[i, n - 1]
-            self.lengths[i] += n
-            for j in range(n):
-                tok = int(out_np[i, j])
-                self.ring[i, self.ring_pos[i] % sampling.RING_N] = tok
-                self.ring_pos[i] += 1
-            for j in range(n):
-                if not self._live(i, snap):
-                    break
-                snap.committed = min(snap.committed + 1, snap.cache_len)
-                self._emit_token(i, int(out_np[i, j]), float(lp_np[i, j]))
+        if not mask.any():
+            return None
+        cap = max(1, self.ecfg.decode_burst // W)
+        budget = 1
+        for i in included:
+            s = self.slots[i]
+            used = s.cache_len + infl[i]
+            rem = s.req.max_new_tokens - s.n_decoded - infl[i]
+            if mask[i]:
+                cap = min(cap, max(1, (C - 2 - used) // W))
+                budget = max(budget, (rem + W - 1) // W)
+            else:
+                cap = min(cap, max(1, C - 2 - used))
+                budget = max(budget, rem)
+        cap = min(cap, budget)
+        if self._sched is not None:
+            # priority-weighted sizing, mirroring _pick_burst (ISSUE 11)
+            pend = [0] * len(PRIORITY_CLASSES)
+            dec_rank = None
+            for s in self.slots:
+                if s is None:
+                    continue
+                if s.phase == "prefill" and s.pending:
+                    pend[s.prio] += 1
+                elif s.phase == "decode":
+                    dec_rank = (s.prio if dec_rank is None
+                                else min(dec_rank, s.prio))
+            cap = self._sched.burst_share(dec_rank, pend, cap)
+        k = 1
+        while k * 2 <= cap:
+            k *= 2
+        return k, mask
 
     def _dispatch_decode(self) -> bool:
-        """Dispatch the next decode burst (or run a spec round) if the
-        pipeline has room and some decoding slot still has budget beyond
-        the steps already in flight. Never blocks: burst-to-burst state
+        """Dispatch the next decode burst — or, when spec-eligible slots
+        are decoding, a FUSED SPEC TICK (ISSUE 13: draft-propose +
+        target-verify rounds for the eligible slots, plain decode steps
+        for everyone else, ONE chained dispatch — no whole-engine
+        spec/burst alternation) — if the pipeline has room and some
+        decoding slot still has budget beyond the steps already in
+        flight. Never blocks: burst-to-burst state
         (tokens/lengths/ring/mu) chains device-side, and host events are
         composed in as per-slot overrides (see _decode_burst_body)."""
         if self._n_inflight_bursts() >= self.ecfg.pipeline_depth:
@@ -5020,29 +5331,10 @@ class Engine:
                     if s is not None and s.phase == "decode"]
         if not decoding:
             return False
-        exclude = None
-        eligible = self._spec_eligible()
-        if eligible.any():
-            others = any(not eligible[i] for i in decoding)
-            if not others or self._spec_turn:
-                # spec rounds advance per-slot lengths outside the chain;
-                # catch the mirrors up fully, then run synchronously
-                self._drain_all()
-                self._spec_once(eligible)
-                self._spec_turn = False
-                return True
-            # MIXED traffic: alternate spec rounds (eligible slots) with
-            # normal bursts (the rest)
-            self._spec_turn = True
-            exclude = eligible
         active = self.active_dev.copy()
-        if exclude is not None:
-            active &= ~exclude
         included = []
         infl = self._inflight_vec()   # one FIFO pass for all slots (ISSUE 9)
         for i in decoding:
-            if exclude is not None and exclude[i]:
-                continue
             s = self.slots[i]
             if s.req.max_new_tokens - s.n_decoded - infl[i] <= 0:
                 # in-flight steps already cover this slot's budget: mask it
@@ -5057,12 +5349,21 @@ class Engine:
             included.append(i)
         if not included:
             return False
-        n_steps = self._pick_burst()
+        plan = self._plan_spec(included, infl)
+        W = self.ecfg.n_draft + 1
+        if plan is not None:
+            n_steps, spec_mask = plan
+        else:
+            n_steps, spec_mask = self._pick_burst(infl_vec=infl), None
         if self._paged:
             C = self.ecfg.max_context
             for i in included:
+                # spec-masked slots write up to W rows per round (the
+                # rejected tail is overwritten by the next round)
+                need = (n_steps * W if spec_mask is not None
+                        and spec_mask[i] else n_steps)
                 self._ensure_pages(i, min(C, int(self.lengths[i])
-                                          + infl[i] + n_steps + 2))
+                                          + infl[i] + need + 2))
             self._commit_ptab()
         f = sampling.feature_flags(self.slot_params, self.active_dev)
         flags = (f["use_penalties"], f["use_typical"], f["use_mirostat"])
@@ -5070,7 +5371,8 @@ class Engine:
             # only the two precompiled variants exist; mixed feature sets
             # use the full sampler rather than compiling mid-request
             flags = (True, True, True)
-        fn = self._get_burst_fn(n_steps, flags)
+        fn = (self._get_spec_tick_fn(n_steps, flags) if plan is not None
+              else self._get_burst_fn(n_steps, flags))
         t_d = time.monotonic()
         S = self.ecfg.num_slots
         ov_mask = np.zeros((S,), np.bool_)
@@ -5095,17 +5397,33 @@ class Engine:
                            chain=chain if cold else None,
                            spp=spp, active=active, ovp=ovp)
         with self._annot("decode_burst"):
-            pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
-                self.params, chain[0], self.ck, self.cv, chain[1],
-                chain[2], chain[3], self.bias, self.rng_keys,
-                spp, active, chain[4], ovp,
-            )
+            if plan is None:
+                pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+                    self.params, chain[0], self.ck, self.cv, chain[1],
+                    chain[2], chain[3], self.bias, self.rng_keys,
+                    spp, active, chain[4], ovp,
+                )
+            elif self._spec_mode == "model":
+                (pack, self.ck, self.cv, self.rng_keys, self._chain,
+                 self.dck, self.dcv) = fn(
+                    self.params, chain[0], self.ck, self.cv, chain[1],
+                    chain[2], chain[3], self.bias, self.rng_keys,
+                    spp, active, chain[4], ovp, spec_mask,
+                    self.draft_params, self.dck, self.dcv,
+                )
+            else:
+                pack, self.ck, self.cv, self.rng_keys, self._chain = fn(
+                    self.params, chain[0], self.ck, self.cv, chain[1],
+                    chain[2], chain[3], self.bias, self.rng_keys,
+                    spp, active, chain[4], ovp, spec_mask,
+                )
         self._tmark("dispatch", t_d)
         if self.tracer.enabled:
-            self.tracer.record("decode_dispatch", "engine", t_d,
-                               time.monotonic(),
-                               args={"steps": n_steps,
-                                     "slots": len(included)})
+            self.tracer.record(
+                "decode_dispatch", "engine", t_d, time.monotonic(),
+                args={"steps": n_steps, "slots": len(included),
+                      **({"spec_slots": int(spec_mask.sum()),
+                          "spec_width": W} if plan is not None else {})})
         if self._trace:
             s = self._tstats.setdefault("burst_steps", [0.0, 0])
             s[0] += n_steps
@@ -5117,6 +5435,13 @@ class Engine:
             occ[0] += len(included)
             occ[1] += 1
         b = _Burst(n_steps, burst_slots, pack, t_dispatch=t_d)
+        if plan is not None:
+            b.spec_mask = spec_mask
+            b.spec_width = W
+            st = self._spec_stats
+            st["dispatches"] += 1
+            if any(not spec_mask[i] for i in included):
+                st["mixed_dispatches"] += 1
         self._fifo.append(b)
         self._sync_q.put(b)
         return True
@@ -5139,9 +5464,18 @@ class Engine:
         packed = b.pack_np                  # [2K+1(+2), S] f32
         self._tmark("burst_wait", t0)
         K = b.n_steps
-        b.ids_np = packed[:K].astype(np.int32)
-        b.lps_np = packed[K:2 * K]
-        mu_np = packed[2 * K]
+        if b.spec_width:
+            # spec tick pack: ids/lps are [R*W, S] round-major, then the
+            # [R, S] per-round emit counts, then mu
+            KW = K * b.spec_width
+            b.ids_np = packed[:KW].astype(np.int32)
+            b.lps_np = packed[KW:2 * KW]
+            b.n_out_np = packed[2 * KW:2 * KW + K].astype(np.int32)
+            mu_np = packed[2 * KW + K]
+        else:
+            b.ids_np = packed[:K].astype(np.int32)
+            b.lps_np = packed[K:2 * K]
+            mu_np = packed[2 * K]
         if b.group:
             if b.head is not None:
                 # early-emit split: the first tokens synced with the
@@ -5167,6 +5501,35 @@ class Engine:
                     if self._live(i, snap) and i not in b.skip_slots]
         for i in live_idx:
             self.mu[i] = mu_np[i]
+        if b.spec_width:
+            # fused spec tick: per-slot VARIABLE advance — each round
+            # emitted n_out tokens (spec rows: accepted prefix + bonus;
+            # plain rows: exactly 1 at position 0); the mirrors must
+            # replay the device's ring/length evolution token-by-token
+            Wd = b.spec_width
+            st = self._spec_stats
+            for i in live_idx:
+                ns = b.n_out_np[:, i]
+                tot = int(ns.sum())
+                if tot <= 0:
+                    continue
+                self.cur_tokens[i] = b.ids_np[(K - 1) * Wd
+                                              + int(ns[K - 1]) - 1, i]
+                self.lengths[i] += tot
+                rp = int(self.ring_pos[i])
+                for r in range(K):
+                    for j in range(int(ns[r])):
+                        self.ring[i, rp % sampling.RING_N] = \
+                            b.ids_np[r * Wd + j, i]
+                        rp += 1
+                self.ring_pos[i] = rp
+                if b.spec_mask[i]:
+                    st["rounds"] += K
+                    st["proposed"] += K * (Wd - 1)
+                    st["accepted"] += tot - K
+                    st["tokens"] += tot
+            b.folded = True
+            return
         for i in live_idx:
             self.cur_tokens[i] = b.ids_np[-1, i]
             self.lengths[i] += b.n_steps
@@ -5211,16 +5574,52 @@ class Engine:
             self._hobserve("decode_burst_seconds",
                            max(0.0, t_rdy - b.t_dispatch))
             if self._t_last_burst:
-                # burst-to-burst cadence / steps: the stream-visible ITL
+                # burst-to-burst cadence / steps: the stream-visible ITL.
+                # Spec ticks divide by the MEAN tokens actually emitted
+                # per live slot (accepted + bonus), so acceptance shows
+                # up as ITL improvement, not as phantom long bursts
+                steps = b.n_steps
+                if b.spec_width and b.n_out_np is not None:
+                    per_slot = b.n_out_np.sum(axis=0)
+                    live = per_slot[per_slot > 0]
+                    if live.size:
+                        steps = float(live.mean())
                 self._hobserve("itl_seconds",
                                max(0.0, t_proc - self._t_last_burst)
-                               / max(1, b.n_steps))
+                               / max(1.0, steps))
             self._t_last_burst = t_proc
             if tr.enabled:
                 tr.record("decode_burst_device", "engine",
                           b.t_dispatch, t_rdy,
                           args={"steps": b.n_steps, "slots": len(b.slots),
-                                "fused": bool(b.group)})
+                                "fused": bool(b.group),
+                                "spec": bool(b.spec_width)})
+                if b.spec_width:
+                    # spec_round span, split draft-vs-verify so decomp_ms
+                    # attributes speculation honestly. The split is
+                    # ANALYTIC (the fused program has no host-visible
+                    # internal boundary): the model drafter runs D of the
+                    # round's D+1 sequential forwards, the n-gram match
+                    # is a fixed small slice of the round
+                    nsp = b.n_out_np
+                    spec_idx = [i for i, _s in b.slots if b.spec_mask[i]]
+                    tot = int(sum(int(nsp[:, i].sum()) for i in spec_idx))
+                    share = ((b.spec_width - 1) / b.spec_width
+                             if self._spec_mode == "model" else 0.1)
+                    mid = b.t_dispatch + (t_rdy - b.t_dispatch) * share
+                    tr.record("spec_round", "engine", b.t_dispatch, t_rdy,
+                              args={"mode": self._spec_mode,
+                                    "rounds": b.n_steps,
+                                    "spec_slots": len(spec_idx),
+                                    "proposed": b.n_steps
+                                    * (b.spec_width - 1) * len(spec_idx),
+                                    "accepted": max(
+                                        0, tot - b.n_steps
+                                        * len(spec_idx))})
+                    tr.record("spec_draft", "engine", b.t_dispatch, mid,
+                              args={"analytic": True})
+                    tr.record("spec_verify", "engine", mid, t_rdy,
+                              args={"analytic": True})
                 tr.record("finish_detect", "engine", t_rdy, t_proc)
                 for i, snap in b.slots:
                     if self._live(i, snap) and i not in b.skip_slots:
@@ -5267,16 +5666,38 @@ class Engine:
                     rolled.add(i)
             for i, _snap in b.group:
                 self._process_fork_waiters(i)
-            for j in range(b.n_steps):
-                for i, snap in b.slots:
-                    if i in rolled or i in b.skip_slots \
-                            or not self._live(i, snap):
-                        continue  # finished/shifted/replaced/rolled-back
-                    # the step just wrote this slot's previous token's KV row
-                    snap.committed = min(snap.committed + 1, snap.cache_len)
-                    if not self._emit(i, int(b.ids_np[j, i]),
-                                      float(b.lps_np[j, i])):
-                        rolled.add(i)
+            if b.spec_width:
+                # fused spec tick: round-major emission, each slot emits
+                # its round's n_out tokens (plain rows: 1 at position 0)
+                Wd = b.spec_width
+                for r in range(b.n_steps):
+                    for i, snap in b.slots:
+                        if i in rolled or i in b.skip_slots \
+                                or not self._live(i, snap):
+                            continue
+                        for j in range(int(b.n_out_np[r, i])):
+                            if i in rolled or not self._live(i, snap):
+                                break
+                            snap.committed = min(snap.committed + 1,
+                                                 snap.cache_len)
+                            if not self._emit(
+                                    i, int(b.ids_np[r * Wd + j, i]),
+                                    float(b.lps_np[r * Wd + j, i])):
+                                rolled.add(i)
+                                break
+            else:
+                for j in range(b.n_steps):
+                    for i, snap in b.slots:
+                        if i in rolled or i in b.skip_slots \
+                                or not self._live(i, snap):
+                            continue  # finished/shifted/replaced/rolled-back
+                        # the step just wrote this slot's previous
+                        # token's KV row
+                        snap.committed = min(snap.committed + 1,
+                                             snap.cache_len)
+                        if not self._emit(i, int(b.ids_np[j, i]),
+                                          float(b.lps_np[j, i])):
+                            rolled.add(i)
         finally:
             buf, self._sink_buf = self._sink_buf, None
             self._tmark("emit_loop", t0)

@@ -89,10 +89,10 @@ def _page_crc(k, v) -> int:
 
 class _HostEntry:
     __slots__ = ("key", "parent", "depth", "tick", "k", "v", "nbytes",
-                 "crc")
+                 "crc", "dk", "dv", "dcrc")
 
     def __init__(self, key: bytes, parent: bytes, depth: int, tick: int,
-                 k, v):
+                 k, v, dk=None, dv=None):
         self.key = key
         self.parent = parent
         self.depth = depth
@@ -103,6 +103,16 @@ class _HostEntry:
         self.v = v
         self.nbytes = _leaf_bytes(k) + _leaf_bytes(v)
         self.crc = _page_crc(k, v)
+        # ISSUE 13: optional DRAFT-model planes for the same page id (the
+        # paged draft KV shares the main page table). Independently
+        # CRC'd: a corrupt draft plane decays losslessly to a target-only
+        # entry (speculation re-warms from scratch) instead of forcing a
+        # re-prefill of correct target state.
+        self.dk = dk
+        self.dv = dv
+        self.dcrc = _page_crc(dk, dv) if dk is not None else 0
+        if dk is not None:
+            self.nbytes += _leaf_bytes(dk) + _leaf_bytes(dv)
 
 
 class RestoreStager:
@@ -208,17 +218,27 @@ class HostPageStore:
 
     # ---------- store operations ----------
 
-    def put(self, key: bytes, parent: bytes, depth: int, k, v) -> bool:
+    def put(self, key: bytes, parent: bytes, depth: int, k, v,
+            dk=None, dv=None) -> bool:
         """Insert one offloaded page (device->host handoff). Duplicate
         keys are touched, not replaced — content is identical by hash
-        construction. Evicts LRU-first past the byte budget."""
+        construction (a later put MAY attach draft planes a draft-less
+        entry is missing; the target content itself never changes).
+        Evicts LRU-first past the byte budget."""
         with self._lock:
             self._tick += 1
             e = self._entries.get(key)
             if e is not None:
                 e.tick = self._tick
+                if dk is not None and e.dk is None:
+                    e.dk, e.dv = dk, dv
+                    e.dcrc = _page_crc(dk, dv)
+                    extra = _leaf_bytes(dk) + _leaf_bytes(dv)
+                    e.nbytes += extra
+                    self._bytes += extra
+                    self._evict_to_budget_locked()
                 return False
-            e = _HostEntry(key, parent, depth, self._tick, k, v)
+            e = _HostEntry(key, parent, depth, self._tick, k, v, dk, dv)
             if e.nbytes > self.budget_bytes:
                 return False     # a single page over budget: never admit
             self._entries[key] = e
@@ -256,6 +276,18 @@ class HostPageStore:
                 self._remove_tree_locked(key)
                 self.corrupt_dropped += 1
                 return None
+            if e.dk is not None and _page_crc(e.dk, e.dv) != e.dcrc:
+                # draft planes are an acceleration, not correctness:
+                # decay the entry to target-only (lossless — speculation
+                # just re-warms) instead of dropping the whole subtree
+                log.warning("kv host store: draft CRC mismatch on page "
+                            "depth=%d — dropping draft planes only",
+                            e.depth)
+                extra = _leaf_bytes(e.dk) + _leaf_bytes(e.dv)
+                e.dk = e.dv = None
+                e.dcrc = 0
+                e.nbytes -= extra
+                self._bytes -= extra
             self._tick += 1
             e.tick = self._tick
             return e
@@ -317,7 +349,9 @@ class HostPageStore:
     def save(self, path: str) -> bool:
         """Serialize the store (atomically) for reload at the next engine
         start. Entries are written in LRU order so load() replays the
-        recency ranking."""
+        recency ranking. Draft planes (ISSUE 13) are NOT persisted — the
+        wire format stays target-only; a reloaded entry restores without
+        them and speculation re-warms."""
         with self._lock:
             entries = sorted(self._entries.values(), key=lambda e: e.tick)
         if not entries:

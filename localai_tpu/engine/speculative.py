@@ -1,26 +1,41 @@
-"""Speculative decoding: draft-model propose, target-model verify.
+"""Speculative decoding: propose D tokens cheaply, verify with the target.
 
 Capability parity: the reference plumbs draft-model fields end-to-end
 (reference: backend.proto DraftModel, backend_config.go DraftModel) into
 llama.cpp's speculative sampling. TPU re-design: one ROUND is a single
-compiled program — the draft model autoregressively proposes D tokens
-(lax.scan of decode steps over its own KV cache), then the target model
+compiled program — a DRAFTER proposes D tokens, then the target model
 scores all D+1 positions in ONE batched forward (prefill with
 return_all_logits) and greedy acceptance keeps the matched prefix plus
 the target's correction/bonus token. Greedy speculation is LOSSLESS: the
 emitted stream is bit-identical to plain greedy decoding of the target
-model, whatever the draft proposes — rejected drafts only waste the
+model, whatever the drafter proposes — rejected drafts only waste the
 round's spare compute.
 
-Cache invariant (both models): rows [0, length) hold the accepted
-context, and the CURRENT token (last emitted) is not yet ingested; the
-round ingests it in both models as its first input. Rows written for
+Two drafters (engine knob ``draft``):
+
+  * ``model``: a second, smaller llama-family model autoregressively
+    proposes via a lax.scan of decode steps over its own KV cache
+    (draft_propose).
+  * ``ngram``: prompt-lookup / n-gram SELF-speculation (ngram_propose) —
+    the slot's trailing n-gram is matched against its own prompt+emitted
+    history (the device-side penalty ring), and the continuation after
+    the most recent match is proposed. No second model, no draft KV, so
+    every llama-family greedy request can speculate by default. A miss
+    proposes a repeat of the current token — verification rejects it,
+    so the fallback costs nothing but the round's spare compute.
+
+Cache invariant (target and draft models alike): rows [0, length) hold
+the accepted context, and the CURRENT token (last emitted) is not yet
+ingested; a round ingests it as its first input. Rows written for
 rejected proposals sit above the new length and are masked/overwritten.
 
-The engine uses speculation only when every active slot is greedy and
-ungrammared (stochastic speculative sampling needs rejection-sampling
-acceptance; a documented follow-up) and falls back to normal bursts
-otherwise.
+Since ISSUE 13 speculation is a packed citizen of the engine's fused
+decode tick (engine.py _spec_tick_body): spec-eligible slots take a
+propose+verify round while non-spec neighbors take a plain decode step
+through position 0 of the very same ragged verify forward — one chained
+dispatch, no whole-engine spec/burst alternation. Stochastic speculative
+sampling (rejection-sampling acceptance) remains a documented follow-up;
+sampled slots simply ride the tick as plain-decode rows.
 """
 
 from __future__ import annotations
@@ -31,26 +46,62 @@ import jax.numpy as jnp
 from localai_tpu.models import llama
 
 
-def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
-               tokens, lengths, ck, cv, dck, dcv, active, n_draft: int):
-    """One speculative round for all slots.
+def ngram_propose(tokens, ring, ring_pos, n_draft: int, ngram: int):
+    """Prompt-lookup proposals from the slot's own token history.
 
-    tokens [S]: current (not yet ingested) token per slot; lengths [S];
-    ck/cv target cache; dck/dcv draft cache; active [S] bool.
-    Returns (out [S, D+1] emitted tokens, n_out [S] valid counts,
-    ck, cv, dck, dcv, lengths_new).
+    tokens [S]: current (not yet ingested) token per slot; ring
+    [S, RING_N] / ring_pos [S]: the penalty ring (engine/sampling.py) —
+    prompt-seeded at admission and updated with every emitted token, so
+    it IS the trailing prompt+generation history, already device-side.
+    Returns proposals [S, D] int32.
+
+    The trailing ``ngram``-gram (current token last) is compared against
+    every aligned window of the chronological history; the continuation
+    after the MOST RECENT match is proposed, clipped at the history end
+    (self-overlap is deliberate — repetitive continuations are exactly
+    what prompt-lookup exploits). No valid match (including short
+    histories still holding -1 seed entries) proposes a repeat of the
+    current token, which the verify round rejects — lossless either way.
+    """
+    S, N = ring.shape
+    D, G = n_draft, ngram
+    ar = jnp.arange(N, dtype=jnp.int32)
+    # chronological view, oldest -> newest: the ring writes at
+    # pos % N then advances, so entry (pos + j) % N ages left-to-right
+    # and (pos - 1) % N — chronological index N-1 — is the current token
+    idx = (ring_pos[:, None] + ar[None, :]) % N
+    hist = jnp.take_along_axis(jnp.asarray(ring), idx, axis=1)   # [S, N]
+    trail = hist[:, N - G:]                                      # [S, G]
+    starts = jnp.arange(N - G, dtype=jnp.int32)                  # [P]
+    win = starts[:, None] + jnp.arange(G, dtype=jnp.int32)[None, :]
+    wins = hist[:, win]                                          # [S, P, G]
+    ok = jnp.all(wins == trail[:, None, :], axis=-1)
+    ok &= jnp.all(wins >= 0, axis=-1)              # unwritten seed entries
+    ok &= jnp.all(trail >= 0, axis=-1)[:, None]    # short history: no match
+    p_best = jnp.max(jnp.where(ok, starts[None, :], -1), axis=1)  # [S]
+    has = p_best >= 0
+    cont = jnp.minimum(
+        p_best[:, None] + G + jnp.arange(D, dtype=jnp.int32)[None, :], N - 1)
+    props = jnp.take_along_axis(hist, cont, axis=1)              # [S, D]
+    return jnp.where(has[:, None], props,
+                     jnp.asarray(tokens)[:, None]).astype(jnp.int32)
+
+
+def draft_propose(dparams, dcfg: llama.LlamaConfig, tokens, lengths,
+                  dck, dcv, active, n_draft: int):
+    """Draft-model proposals: D+1 autoregressive greedy decode steps.
+
+    The draft cache ingests current + ALL proposals (D+1 steps, so the
+    last proposal's KV row exists when fully accepted — otherwise the
+    draft cache carries a permanent hole inside the accepted context and
+    acceptance quality decays). Inactive slots write at the OOB row so
+    the scatter drops (contiguous and paged layouts alike).
+    Returns (drafts [S, D], dck, dcv).
     """
     from localai_tpu.ops import kvcache
 
-    S = tokens.shape[0]
-    D = n_draft
-    C = kvcache.shape(ck)[2]
     dC = kvcache.shape(dck)[2]
 
-    # 1. draft proposes D tokens (its cache ingests current + ALL proposals:
-    # D+1 steps so the last proposal's KV row exists when fully accepted —
-    # otherwise the draft cache carries a permanent hole inside the
-    # accepted context and acceptance quality decays)
     def dstep(carry, _):
         tok, dl, dck, dcv = carry
         wl = jnp.where(active, dl, dC)
@@ -59,8 +110,53 @@ def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
         return (nxt, dl + active.astype(jnp.int32), dck, dcv), nxt
 
     (_, _, dck, dcv), proposals = jax.lax.scan(
-        dstep, (tokens, lengths, dck, dcv), None, length=D + 1)
-    drafts = proposals[:D].T                            # [S, D]
+        dstep, (tokens, lengths, dck, dcv), None, length=n_draft + 1)
+    return proposals[:n_draft].T, dck, dcv
+
+
+def accept_greedy(drafts, greedy, active):
+    """Greedy acceptance: longest matched prefix + the target's bonus.
+
+    drafts [S, D] proposals; greedy [S, D+1] the target's greedy picks at
+    every position; active [S] bool. Returns (out [S, D+1] emitted
+    tokens, n_out [S] valid counts = matched prefix + 1 bonus, k [S]
+    accepted-draft counts).
+    """
+    S, D = drafts.shape
+    match = (drafts == greedy[:, :D]).astype(jnp.int32)
+    acc_prefix = jnp.cumprod(match, axis=1)
+    k = jnp.sum(acc_prefix, axis=1)                             # [S]
+    bonus = jnp.take_along_axis(greedy, k[:, None], axis=1)[:, 0]
+    pos = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    out = jnp.where(pos < k[:, None], drafts_pad,
+                    jnp.where(pos == k[:, None], bonus[:, None], 0))
+    n_out = (k + 1) * active.astype(jnp.int32)
+    return out, n_out, k
+
+
+def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
+               tokens, lengths, ck, cv, dck, dcv, active, n_draft: int):
+    """One standalone draft-model speculative round for all slots.
+
+    tokens [S]: current (not yet ingested) token per slot; lengths [S];
+    ck/cv target cache; dck/dcv draft cache; active [S] bool.
+    Returns (out [S, D+1] emitted tokens, out_lp, n_out [S] valid counts,
+    ck, cv, dck, dcv, lengths_new). Kept as the minimal reference round
+    (unit-tested directly); the engine's serving path runs the fused
+    multi-round tick instead (engine.py _spec_tick_body), which composes
+    these same propose/verify/accept pieces per round.
+    """
+    from localai_tpu.ops import kvcache
+
+    S = tokens.shape[0]
+    D = n_draft
+    C = kvcache.shape(ck)[2]
+
+    # 1. drafter proposes D tokens
+    drafts, dck, dcv = draft_propose(dparams, dcfg, tokens, lengths,
+                                     dck, dcv, active, D)
 
     # 2. target scores current + proposals in one forward
     tin = jnp.concatenate([tokens[:, None], drafts], axis=1)   # [S, D+1]
@@ -72,19 +168,10 @@ def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,
     greedy = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)  # [S, D+1]
 
     # 3. greedy acceptance: longest prefix where draft matches target
-    match = (drafts == greedy[:, :D]).astype(jnp.int32)         # [S, D]
-    acc_prefix = jnp.cumprod(match, axis=1)
-    k = jnp.sum(acc_prefix, axis=1)                             # [S] accepted
-    bonus = jnp.take_along_axis(greedy, k[:, None], axis=1)[:, 0]
-    pos = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
-    drafts_pad = jnp.concatenate(
-        [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1)
-    out = jnp.where(pos < k[:, None], drafts_pad,
-                    jnp.where(pos == k[:, None], bonus[:, None], 0))
+    out, n_out, _k = accept_greedy(drafts, greedy, active)
     # matching logprobs for the emitted tokens (target distribution)
     logp_all = jax.nn.log_softmax(all_logits, axis=-1)
     out_lp = jnp.take_along_axis(logp_all, out[:, :, None], axis=2)[:, :, 0]
 
-    n_out = (k + 1) * active.astype(jnp.int32)
     lengths_new = lengths + n_out
     return out, out_lp, n_out, ck, cv, dck, dcv, lengths_new

@@ -6,11 +6,12 @@
 #
 # Fails (rc != 0) if either stage fails. Environment knobs:
 #   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
-#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 640 here —
+#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 720 here —
 #                             the packed phase runs three fuse modes plus
 #                             the >1k-token long-pack gate since ISSUE 11,
-#                             and the SLO burn phase rides along since
-#                             ISSUE 12)
+#                             the SLO burn phase rides along since
+#                             ISSUE 12, and the speculative-decoding
+#                             phase since ISSUE 13)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #
@@ -32,7 +33,7 @@ scripts/run_tier1.sh
 
 echo "== ci: bench smoke =="
 smoke_out=$(mktemp)
-LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-640}" \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-720}" \
     python bench.py --smoke | tee "$smoke_out"
 
 echo "== ci: tracked =="
@@ -115,6 +116,23 @@ if burn is None or not burn > 1 or slo.get("burn_5m_high") != 0 \
     sys.exit(1)
 if line.get("trace_merged") != 1:
     print("FAIL: request id did not survive into a merged two-pid trace")
+    sys.exit(1)
+# speculative decoding (ISSUE 13): model-free n-gram self-speculation
+# must emit MORE than one token per verify dispatch (1.0 = speculation
+# bought nothing) while staying byte-identical to speculation-off
+# greedy — losslessness is the whole contract
+sp = line.get("spec") or {}
+print(f"SPEC_ACCEPT_PER_DISPATCH={line.get('spec_accept_per_dispatch')} "
+      f"SPEC_BYTE_MATCH={line.get('spec_byte_match')} "
+      f"acceptance_rate={sp.get('acceptance_rate')} "
+      f"spec_itl_on_ms={sp.get('itl_on_ms')} "
+      f"spec_itl_off_ms={sp.get('itl_off_ms')} "
+      f"mixed_dispatches={sp.get('mixed_dispatches')}")
+apd = line.get("spec_accept_per_dispatch")
+if apd is None or not apd > 1.0 or line.get("spec_byte_match") is not True:
+    print(f"FAIL: speculative decoding regressed "
+          f"(accept_per_dispatch={apd} must be > 1.0, "
+          f"byte_match={line.get('spec_byte_match')} must be true)")
     sys.exit(1)
 PY
 rm -f "$smoke_out"
