@@ -1180,6 +1180,250 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
     return out
 
 
+def bench_replicas(cfg, S, C, max_new=48):
+    """Engine replica pool scenario (ISSUE 14): ONE pool of two replicas
+    sharing a host KV tier and a cross-replica prefix index, three
+    phases in sequence:
+
+    1. prefix affinity + cross-replica warm restore: cold prompts land
+       on one replica and their retained chains offload to the shared
+       tier under pool pressure; resubmitting a device-warm prompt must
+       route to the SAME replica via the shared index (affinity hit,
+       byte-identical); then the SIBLING — which never saw these
+       prompts — alternates fresh cold prefills with restores of the
+       chains its sibling computed, pulled from the SHARED store, and
+       the warm TTFT must beat the cold full re-prefill (median cold vs
+       best warm after a one-off warm-up run; alternation keeps every
+       warm sample a true host restore, never a device splice);
+    2. live migration: a mid-decode request is migrated to the sibling
+       (pause -> offload to the shared tier -> resume-as-readmission);
+       the client stream never closes and the continuation must equal a
+       fresh pool re-admission of (prompt + tokens emitted before the
+       pause) — the MIGRATE_BYTE_MATCH gate;
+    3. crash recovery: the victim's home replica dies mid-stream (its
+       device KV is lost); the pool harvests the request and a sibling
+       adopts it, restoring the warm prefix from the SHARED host tier;
+       the stream finishes error-free and byte-matches the same fresh
+       re-admission contract — the REPLICA_RECOVERED gate.
+
+    Byte-gate references go through the POOL, not a cold engine, so
+    affinity splices the same retained conditioning rows the migrated /
+    recovered continuation saw (prefill-vs-decode kernel numerics can
+    differ in the last ulps; see bench_priority phase 3 and
+    engine._start_resume)."""
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.pool import EnginePool
+    from localai_tpu.engine.weights import random_params
+    from localai_tpu.services.eventlog import EVENTS
+    from localai_tpu.services.faults import FAULTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(23)
+    C = max(96, C)
+    pg = 8
+    # 1 slot/replica and a device pool exactly one slot deep: retained
+    # chains always evict — and thus offload to the shared host tier —
+    # when the next admission needs the pages
+    ecfg = eng.EngineConfig(num_slots=1, max_context=C,
+                            prefill_buckets=(32, 128), decode_burst=4,
+                            kv_page_size=pg, kv_pool_pages=C // pg,
+                            cache_dtype=jnp.float32)
+
+    def make_req(ids, n):
+        return eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=n, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+
+    def drain(o, first_ev=None):
+        ids, err = [], None
+        ev = first_ev
+        while True:
+            if ev is None:
+                ev = o.get()
+                if ev is None:
+                    break
+            if ev.error is not None:
+                err = ev.error
+            if ev.token_ids:
+                ids.extend(ev.token_ids)
+            elif ev.token_id >= 0:
+                ids.append(ev.token_id)
+            ev = None
+        return ids, err
+
+    # phases 2/3 decode max_new tokens, so their prompt leaves headroom
+    plen = min(max(48, C // 2 - 8), C - max_new - 8)
+    plen -= plen % pg                      # page-aligned: whole-chain reuse
+    # phase 1 only decodes 8 tokens, so its prompts run near-context:
+    # the skipped prefill has to dominate the per-page restore overhead
+    # for the warm-beats-cold compare to measure what it claims
+    plen1 = (C - 24) - (C - 24) % pg
+    out = {"max_new": max_new, "plen": plen, "plen1": plen1}
+    pool = EnginePool.build(cfg, params, _ByteTokenizer(), ecfg,
+                            engines=2, eos_token_ids={cfg.vocab_size - 1})
+    pool.start(precompile=True)
+    try:
+        # ---- phase 1: affinity routing + cross-replica warm restore ----
+        # three cold prompts, submitted back to back: each admission
+        # evicts the previous retained chain (the pool is one slot
+        # deep), which IS the device -> host offload into the shared
+        # store; the last chain stays device-resident
+        def timed_submit(ids, n):
+            r = make_req(ids, n)
+            t0 = time.monotonic()
+            o = pool.submit(r)
+            first = o.get()
+            ttft = time.monotonic() - t0
+            toks, err = drain(o, first_ev=first)
+            return r, ttft, toks, err
+        colds = [rng.integers(0, 255, size=plen1).tolist()
+                 for _ in range(3)]
+        cold_ttfts, cold_ids, home = [], [], None
+        for p in colds:
+            r, ttft, toks, err = timed_submit(p, 8)
+            cold_ttfts.append(ttft)
+            cold_ids.append(toks)
+            home = pool.where(r.request_id)
+        cold_ttft = float(np.median(cold_ttfts))
+        # wait for the evicted chains to land in the shared host tier
+        # and the last chain's release-path insert to hit the index
+        store = pool._shared.store
+        keys = list(pool._engines[home]._pcache.chain_keys(colds[2]))
+        n_chain = len(keys)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if (store.pages >= n_chain and
+                    pool._shared.index.match_depths(keys).get(home, 0) > 0):
+                break
+            time.sleep(0.02)
+        out["host_store_pages"] = store.pages
+        # device-warm resubmission routes BACK to the retaining replica
+        # (twice: the first pays the one-off splice-path compiles)
+        hits0 = pool.affinity_hits
+        ids_warm, err_w = None, None
+        for _ in range(2):
+            r, warm_ttft, ids_warm, err_w = timed_submit(colds[2], 8)
+        out["affinity_hits"] = pool.affinity_hits - hits0
+        out["affinity_same_replica"] = pool.where(r.request_id) == home
+        out["affinity_byte_match"] = (err_w is None
+                                      and ids_warm == cold_ids[2])
+        # cross-replica warm restore, engine-direct on the SIBLING so
+        # both sides of the compare run on an idle pool: the sibling
+        # has never seen these prompts — cold is a full re-prefill of
+        # fresh same-length prompts, warm restores the chains replica
+        # `home` computed from the SHARED host store. (Routing TO the
+        # warm tier is what the affinity/load phases above prove;
+        # pinning `home` busy to force routing here would let the
+        # pin's own decode compete for compute and poison the timing.)
+        def timed_direct(engine, ids, n):
+            r = make_req(ids, n)
+            t0 = time.monotonic()
+            o = engine.submit(r)
+            first = o.get()
+            ttft = time.monotonic() - t0
+            toks, err = drain(o, first_ev=first)
+            return ttft, toks, err
+        sib = pool._engines[1 - home]
+        restored0 = store.stats()["restored_pages"]
+        timed_direct(sib, colds[0], 8)      # warm-up: one-off overheads
+        cold_sib, host_warm = [], []
+        # alternate cold/warm: near-context chains mean the sibling's
+        # pool holds at most one resident chain, so every cold
+        # full-prefill evicts the chain the next warm run restores —
+        # each warm sample is a TRUE host-tier restore, not a device
+        # splice of a still-resident chain
+        for i in range(3):
+            cold_sib.append(timed_direct(sib, rng.integers(
+                0, 255, size=plen1).tolist(), 8)[0])
+            host_warm.append(timed_direct(sib, colds[(i + 1) % 2], 8)[0])
+        host_warm_ttft = min(host_warm)
+        cold_sib_ttft = float(np.median(cold_sib))
+        out["host_restored_pages"] = \
+            store.stats()["restored_pages"] - restored0
+        out["cold_ttft_ms"] = round(cold_ttft * 1e3, 2)
+        out["warm_ttft_ms"] = round(warm_ttft * 1e3, 2)
+        out["cold_sib_ttft_ms"] = round(cold_sib_ttft * 1e3, 2)
+        out["host_warm_ttft_ms"] = round(host_warm_ttft * 1e3, 2)
+        out["warm_beats_cold"] = bool(
+            out["host_restored_pages"] > 0
+            and host_warm_ttft < cold_sib_ttft)
+        out["warm_ttft_speedup"] = round(
+            cold_sib_ttft / max(1e-6, host_warm_ttft), 2)
+
+        # ---- phase 2: live migration mid-decode ----
+        EVENTS.clear()
+        p2 = rng.integers(0, 255, size=plen).tolist()
+        req = make_req(p2, max_new)
+        o = pool.submit(req)
+        first = o.get()
+        src = pool.where(req.request_id)
+        migrated = pool.migrate(req.request_id, reason="rebalance",
+                                timeout_s=30.0)
+        ids, err = drain(o, first_ev=first)
+        migs = [ev for ev in EVENTS.events() if ev["event"] == "migrate"
+                and ev["rid"] == req.request_id]
+        k = migs[0]["n_decoded"] if migs else 0
+        out["migrated"] = bool(migrated and migs)
+        out["migrate_dst"] = pool.where(req.request_id)
+        out["migrate_n_decoded"] = k
+        match = False
+        if (migrated and err is None and len(ids) == max_new
+                and 0 < k < max_new
+                and pool.where(req.request_id) == 1 - src):
+            ref, rerr = drain(pool.submit(make_req(
+                list(p2) + ids[:k], max_new - k)))
+            match = rerr is None and ids[k:] == ref
+        out["migrate_byte_match"] = match
+        out["migrations_rebalance"] = pool._migrations["rebalance"]
+
+        # ---- phase 3: kill the victim's home replica mid-stream ----
+        # warm the shared host tier first: a short run retains the
+        # victim chain on its home, then an unrelated squeeze evicts it
+        # through the normal reclaim path (device -> host offload)
+        p3 = rng.integers(0, 255, size=plen).tolist()
+        r0 = make_req(p3, 4)
+        drain(pool.submit(r0))
+        home = pool.where(r0.request_id)
+        n_chain = len(list(pool._engines[home]._pcache.chain_keys(p3)))
+        drain(pool.submit(make_req(
+            rng.integers(0, 255, size=plen).tolist(),
+            min(60, C - plen - 8))))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and store.pages < n_chain:
+            time.sleep(0.02)
+        EVENTS.clear()
+        victim = make_req(p3, max_new)
+        o = pool.submit(victim)
+        first = o.get()
+        home = pool.where(victim.request_id)
+        FAULTS.arm(f"replica{home}_die", count=1)
+        ids, err = drain(o, first_ev=first)
+        migs = [ev for ev in EVENTS.events() if ev["event"] == "migrate"
+                and ev["rid"] == victim.request_id]
+        k = migs[0]["n_decoded"] if migs else 0
+        m = pool.metrics()
+        out["crash_stream_ok"] = err is None and len(ids) == max_new
+        out["crash_migrations"] = pool._migrations["crash"]
+        out["replicas_alive_after"] = m["pool"]["replicas_alive"]
+        out["crash_n_decoded"] = k
+        cmatch = False
+        if out["crash_stream_ok"] and 0 < k < max_new \
+                and pool.where(victim.request_id) != home:
+            ref, rerr = drain(pool.submit(make_req(
+                list(p3) + ids[:k], max_new - k)))
+            cmatch = rerr is None and ids[k:] == ref
+        out["crash_byte_match"] = cmatch
+        out["recovered"] = bool(out["crash_stream_ok"] and cmatch
+                                and pool._migrations["crash"] >= 1
+                                and m["pool"]["replicas_alive"] == 1)
+    finally:
+        FAULTS.reset()
+        pool.shutdown()
+    return out
+
+
 def bench_slo(cfg, S, C, n_low=6, n_high=4, max_new=8):
     """Per-class SLO burn-rate + violation flight-recorder scenario
     (ISSUE 12), on ONE engine with a deliberately split objective:
@@ -1904,6 +2148,69 @@ def _engine_direct_spec(deadline: float, partial: dict) -> dict:
     return out
 
 
+def _engine_direct_replicas(deadline: float, partial: dict) -> dict:
+    """The engine replica pool scenario (ISSUE 14) as a bench phase:
+    prefix-affinity routing across two replicas, forced live migration
+    with the byte gate, and kill-one-replica crash recovery through the
+    shared host tier — engine-direct in a subprocess on the CPU-safe
+    smoke shape (LOCALAI_BENCH_REPLICAS_PRESET to override)."""
+    import subprocess
+
+    rp_preset = os.environ.get("LOCALAI_BENCH_REPLICAS_PRESET", "smoke")
+    hp = HTTP_PRESETS.get(rp_preset, HTTP_PRESETS["smoke"])
+    remaining = deadline - time.monotonic()
+    if remaining < 30:
+        return {"error": "budget exhausted"}
+    env = dict(os.environ)
+    env.update({
+        "LOCALAI_BENCH_PRESET": rp_preset,
+        "LOCALAI_BENCH_SLOTS": str(hp["slots"]),
+        "LOCALAI_BENCH_CTX": str(hp["ctx"]),
+        "LOCALAI_BENCH_QUANT": hp.get("quant", ""),
+        "LOCALAI_BENCH_BUDGET_S": "0",   # parent watchdog governs
+        "LOCALAI_BENCH_DEADLINE_S": "0",
+        "LOCALAI_JAX_PLATFORM": "",
+    })
+    env.pop("LOCALAI_FAULTS", None)  # the scenario arms its own faults
+    platform = _subprocess_jax_platform(deadline)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    out = {}
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--replicas"],
+            env=env, capture_output=True, text=True,
+            timeout=max(30, min(remaining - 10, 1800)))
+        for ln in res.stdout.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                r = json.loads(ln)
+                out = {"ok": r.get("ok"),
+                       "affinity_hits": r.get("affinity_hits"),
+                       "affinity_byte_match": r.get("affinity_byte_match"),
+                       "cold_ttft_ms": r.get("cold_ttft_ms"),
+                       "warm_ttft_ms": r.get("warm_ttft_ms"),
+                       "host_warm_ttft_ms": r.get("host_warm_ttft_ms"),
+                       "warm_beats_cold": r.get("warm_beats_cold"),
+                       "warm_ttft_speedup": r.get("warm_ttft_speedup"),
+                       "migrate_byte_match": r.get("migrate_byte_match"),
+                       "migrations_rebalance": r.get("migrations_rebalance"),
+                       "crash_migrations": r.get("crash_migrations"),
+                       "crash_byte_match": r.get("crash_byte_match"),
+                       "replicas_alive_after": r.get("replicas_alive_after"),
+                       "recovered": r.get("recovered")}
+        if not out:
+            out = {"error": (f"rc={res.returncode} "
+                             f"stderr={res.stderr[-200:]}")}
+    except Exception as e:
+        out = {"error": f"{type(e).__name__}: {e}"[:200]}
+    partial.update({f"replicas_{k}": v for k, v in out.items()})
+    _emit_phase("replicas", out)
+    return out
+
+
 def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
     """The PR-2 acceptance scenario as a default-bench phase: multi-turn
     conversations under slot churn, prefix cache on vs off, in one
@@ -2094,7 +2401,8 @@ def main():
     if ("--engine" in sys.argv or "--kernel" in sys.argv
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
             or "--chaos" in sys.argv or "--priority" in sys.argv
-            or "--slo" in sys.argv or "--spec" in sys.argv):
+            or "--slo" in sys.argv or "--spec" in sys.argv
+            or "--replicas" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -2238,6 +2546,42 @@ def main():
             }))
             return
 
+        if "--replicas" in sys.argv:
+            # engine replica pool (ISSUE 14): f32 weights so the
+            # migration / crash-recovery byte gates can compare the
+            # continued stream against a fresh pool re-admission
+            import jax.numpy as jnp
+
+            rp = dict(PRESETS[preset])
+            if preset == "smoke":
+                # the smoke model is small enough that a padded-bucket
+                # prefill costs LESS than restoring the same pages from
+                # the host tier, so the warm-vs-cold compare would
+                # measure path overhead, not the skipped prefill. Scale
+                # compute up for this scenario only: prefill FLOPs grow
+                # ~quadratically with hidden size, restore bytes only
+                # linearly, putting the rig in the regime the shared
+                # tier exists for (still CPU-safe).
+                rp.update(hidden_size=384, intermediate_size=1024,
+                          num_layers=4, num_heads=8, num_kv_heads=8,
+                          head_dim=48)
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **rp)
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "1"))
+            C = max(96, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_replicas(cfg, S, C)
+            ok = (r.get("affinity_hits", 0) >= 1
+                  and r.get("affinity_byte_match") is True
+                  and r.get("warm_beats_cold") is True
+                  and r.get("migrate_byte_match") is True
+                  and r.get("recovered") is True)
+            print(json.dumps({
+                "metric": f"replicas_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", "ok": 1 if ok else 0, **r,
+            }))
+            return
+
         if "--slo" in sys.argv:
             # per-class SLO burn + flight recorder (ISSUE 12): a tight
             # low-class TTFT objective must burn and dump, a loose
@@ -2334,6 +2678,11 @@ def main():
         # self-speculation must beat 1.0 accepted-tokens-per-dispatch
         # and stay byte-identical to speculation-off greedy
         spec = _engine_direct_spec(deadline, partial)
+        # engine replica pool (ISSUE 14, scripts/ci.sh
+        # REPLICA_AFFINITY_HITS/MIGRATE_BYTE_MATCH/REPLICA_RECOVERED
+        # line): cross-replica affinity routing, live-migration byte
+        # gate, kill-one-replica recovery via the shared host tier
+        replicas = _engine_direct_replicas(deadline, partial)
         ok = ("paged_tok_s" in layout_cmp
               and packed.get("greedy_match") is True
               and multiturn.get("greedy_match") is True
@@ -2341,7 +2690,8 @@ def main():
               and "host_device_decomp_ms" in decomp
               and "host_device_decomp_ms" in decomp_off
               and slo.get("ok") == 1
-              and spec.get("ok") == 1)
+              and spec.get("ok") == 1
+              and replicas.get("ok") == 1)
         print(json.dumps({
             "metric": "bench_smoke", "value": 1 if ok else 0, "unit": "ok",
             "kv_layout_compare": layout_cmp,
@@ -2378,6 +2728,13 @@ def main():
             "spec": spec,
             "spec_accept_per_dispatch": spec.get("accept_per_dispatch"),
             "spec_byte_match": spec.get("byte_match"),
+            # engine replica pool (ISSUE 14): affinity must hit on the
+            # warm resubmission, migration and crash recovery must stay
+            # byte-identical to a fresh pool re-admission
+            "replicas": replicas,
+            "replica_affinity_hits": replicas.get("affinity_hits"),
+            "migrate_byte_match": replicas.get("migrate_byte_match"),
+            "replica_recovered": replicas.get("recovered"),
         }))
         sys.exit(0 if ok else 1)
 
@@ -2403,6 +2760,7 @@ def main():
     priority_cmp = _engine_direct_priority(deadline, partial)
     slo_cmp = _engine_direct_slo(deadline, partial)
     spec_cmp = _engine_direct_spec(deadline, partial)
+    replicas_cmp = _engine_direct_replicas(deadline, partial)
     presets = os.environ.get("LOCALAI_BENCH_PRESETS", "8b").split(",")
     presets = [p.strip() for p in presets if p.strip()]
     results = {}
@@ -2431,6 +2789,7 @@ def main():
                 "priority": priority_cmp,
                 "slo": slo_cmp,
                 "spec": spec_cmp,
+                "replicas": replicas_cmp,
                 "errors": {p: e[:200] for p, e in errors.items()}}
         print(json.dumps(line))
         return
@@ -2546,6 +2905,7 @@ def main():
         "priority": priority_cmp,
         "slo": slo_cmp,
         "spec": spec_cmp,
+        "replicas": replicas_cmp,
     }
     if engine_direct is not None:
         line["engine_direct_tok_s"] = engine_direct.get("value")

@@ -241,6 +241,10 @@ def _refresh_engine_metrics(state):
               "flight_dumps_suppressed_total",
               *(m for _k, m in _SPEC_COUNTERS),
               "spec_acceptance_rate",
+              "engine_replicas", "replica_queue_depth",
+              "replica_slots_in_flight", "replica_migrations_total",
+              "pool_affinity_hits_total", "pool_affinity_misses_total",
+              "resume_reserve_pages",
               "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
     # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
@@ -312,6 +316,34 @@ def _refresh_engine_metrics(state):
             for cls, n in (sch.get("queued_by_class") or {}).items():
                 METRICS.set_gauge("queue_depth_class", n,
                                   label_str(model=name, priority=cls))
+            # resume-reserve autosize (ISSUE 14 satellite): the
+            # EFFECTIVE reserve — explicit knob, or the preemption-rate
+            # EWMA-derived value when the knob is 0
+            METRICS.set_gauge("resume_reserve_pages",
+                              sch.get("resume_reserve_pages", 0),
+                              label_str(model=name))
+        # engine replica pool (ISSUE 14): pool width, per-replica load,
+        # migration totals by reason. engines=1 exports width 1 and no
+        # per-replica/pool series (plain Engine stats carry no "pool")
+        METRICS.set_gauge("engine_replicas",
+                          stats.get("engine_replicas", 1),
+                          label_str(model=name))
+        for r in (stats.get("replicas") or []):
+            rl = label_str(model=name, replica=str(r.get("replica", 0)))
+            METRICS.set_gauge("replica_queue_depth", r.get("queued", 0), rl)
+            METRICS.set_gauge("replica_slots_in_flight",
+                              r.get("slots_in_flight", 0), rl)
+        pool = stats.get("pool")
+        if pool:
+            for reason, n in (pool.get("migrations") or {}).items():
+                METRICS.set_counter("replica_migrations_total", n,
+                                    label_str(model=name, reason=reason))
+            METRICS.set_counter("pool_affinity_hits_total",
+                                pool.get("affinity_hits", 0),
+                                label_str(model=name))
+            METRICS.set_counter("pool_affinity_misses_total",
+                                pool.get("affinity_misses", 0),
+                                label_str(model=name))
         # speculative decoding (ISSUE 13): per-round proposal/acceptance
         # totals + the derived acceptance rate, skipped when the engine
         # resolved speculation off (non-llama, lockstep, draft=0)

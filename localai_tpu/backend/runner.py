@@ -469,8 +469,21 @@ class EngineServicer(BackendServicer):
 
         self.model_cfg = cfg
         self.model_path = request.model_path or os.path.dirname(model_dir)
-        self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg, mesh=mesh,
-                                 draft=draft, family=family)
+        # engine replica pool (ISSUE 14): engines=N>1 builds an EnginePool
+        # (shared host KV tier + cross-replica prefix index, prefix-affinity
+        # routing, live migration). engines=1 (the default) constructs a
+        # plain Engine — no pool object anywhere on the path, so single-
+        # engine behavior stays bit-for-bit.
+        n_engines = max(1, int(extra.get("engines", 1) or 1))
+        if n_engines > 1:
+            from localai_tpu.engine.pool import EnginePool
+
+            self.engine = EnginePool.build(
+                cfg, params, self.tokenizer, ecfg, engines=n_engines,
+                mesh=mesh, draft=draft, family=family)
+        else:
+            self.engine = eng.Engine(cfg, params, self.tokenizer, ecfg,
+                                     mesh=mesh, draft=draft, family=family)
         # compile the whole serving surface before accepting traffic (a cold
         # compile mid-request stalls every active slot for 20-40s); skippable
         # for tests that only care about wiring

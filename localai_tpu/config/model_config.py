@@ -159,7 +159,11 @@ class ModelConfig:
     # the speculative-decoding knobs (ISSUE 13) draft=auto|model|ngram|0
     # (auto = draft model when loaded, else n-gram self-speculation;
     # 0 disables), n_draft=N (proposal depth per round, 0 disables) and
-    # spec_ngram=N (lookup n-gram length, default 3).
+    # spec_ngram=N (lookup n-gram length, default 3), or the replica-pool
+    # knob (ISSUE 14) engines=N (N>1 serves the model from N engine
+    # replicas behind prefix-affinity routing, sharing ONE host KV tier;
+    # requires preempt=1 — pause/resume is the migration primitive.
+    # engines=1, the default, builds a plain single Engine bit-for-bit).
     # The known knobs are value-validated in validate() so a typo fails
     # at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
@@ -306,6 +310,9 @@ class ModelConfig:
             elif k == "spec_ngram" and not (v.isdigit() and int(v) > 0):
                 problems.append(
                     f"spec_ngram must be a positive integer, got {v!r}")
+            elif k == "engines" and not (v.isdigit() and int(v) > 0):
+                problems.append(
+                    f"engines must be a positive integer, got {v!r}")
             elif k == "peak_tflops":
                 try:
                     if float(v) < 0:
@@ -332,6 +339,21 @@ class ModelConfig:
                 except ValueError:
                     problems.append(
                         f"slo_error_budget must be a number, got {v!r}")
+        # cross-knob: the replica pool migrates via pause/resume, so a
+        # pool without the preemptive scheduler could never rebalance or
+        # crash-recover — fail at scan, not at model load
+        opts = {}
+        for o in self.options or []:
+            s = str(o)
+            if "=" in s:
+                k, v = (p.strip() for p in s.split("=", 1))
+                opts[k] = v
+        if (opts.get("engines", "1").isdigit()
+                and int(opts.get("engines", "1")) > 1
+                and opts.get("preempt", "1").lower() in
+                ("0", "false", "off", "no")):
+            problems.append("engines>1 requires preempt=1 (pause/resume "
+                            "is the pool's migration primitive)")
         return problems
 
     def usecases(self) -> Usecase:

@@ -396,6 +396,16 @@ class _DispatchStall(Exception):
         self.item = item
 
 
+class _ReplicaDead(BaseException):
+    """Chaos-only (ISSUE 14): the ``replica<N>_die`` fault kills this
+    replica's loop thread the way a lost host would — BaseException so
+    _run's ``except Exception`` recovery can NOT save it. Raised at the
+    tick top, where the host mirrors (slots, token histories, emitted
+    counts) are consistent with everything already flushed to the
+    emitter, so the pool's crash recovery rebuilds resume state from an
+    honest snapshot."""
+
+
 class _Burst:
     """A dispatched decode burst awaiting host processing. Its packed
     results are synced by the engine's SYNC WORKER thread (one thread,
@@ -565,9 +575,18 @@ class Engine:
         draft: Optional[tuple] = None,   # (LlamaConfig, params) draft model
         bus=None,                        # parallel/lockstep.LeaderBus
         family=None,                     # model-family module (default llama)
+        replica_id: int = 0,             # position in an EnginePool (ISSUE 14)
+        shared_kv=None,                  # pool.SharedKV: one host tier + index
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # replica-pool membership (ISSUE 14): standalone engines are
+        # replica 0 of a pool of one and OWN their host tier (shutdown
+        # persists it); pool members share ONE HostPageStore the pool
+        # owns, and report device-tier membership to the pool index.
+        self.replica_id = int(replica_id)
+        self._shared_kv = shared_kv
+        self._hstore_owned = shared_kv is None
         # model-family adapter (init_cache / engine_decode / prefill):
         # llama-family by default; models/mamba.py rides the same slot
         # model with a fixed-size (conv, ssm) state in the cache lanes.
@@ -664,22 +683,35 @@ class Engine:
                 # rwkv) — those layouts have no pages to retain
                 from localai_tpu.engine import prefix_cache
 
+                scope = prefix_cache.build_scope(
+                    self._fam_name, model_cfg, pg, self.ecfg.cache_dtype)
+                # pool mode: device-tier membership feeds the shared
+                # cross-replica index (prefix-affinity routing) and the
+                # shared store's mapping refcounts
+                hooks = (shared_kv.prefix_hooks(self.replica_id)
+                         if shared_kv is not None else {})
                 self._pcache = prefix_cache.PrefixPageCache(
-                    prefix_cache.build_scope(self._fam_name, model_cfg, pg,
-                                             self.ecfg.cache_dtype), pg)
+                    scope, pg, **hooks)
                 if self.ecfg.kv_offload:
                     # the host-RAM tier under the pool (the scope doubles
                     # as the persisted file's model/geometry check)
                     from localai_tpu.engine.kv_offload import (
                         HostPageStore, RestoreStager)
 
-                    self._hstore = HostPageStore(
-                        self._pcache.scope, pg, self.ecfg.kv_host_pool_mb)
+                    if shared_kv is not None:
+                        # ONE host tier for the whole pool; the pool owns
+                        # persistence (saved once, not per replica)
+                        self._hstore = shared_kv.host_store(
+                            scope, pg, self.ecfg.kv_host_pool_mb,
+                            self.ecfg.kv_host_store_path)
+                    else:
+                        self._hstore = HostPageStore(
+                            scope, pg, self.ecfg.kv_host_pool_mb)
                     # double-buffered restore staging (ISSUE 9 satellite):
                     # consecutive restore uploads alternate buffer sets so
                     # an in-flight scatter never aliases a refill
                     self._rstager = RestoreStager()
-                    if self.ecfg.kv_host_store_path:
+                    if self._hstore_owned and self.ecfg.kv_host_store_path:
                         n = self._hstore.load(self.ecfg.kv_host_store_path)
                         if n:
                             import logging as _logging
@@ -947,6 +979,26 @@ class Engine:
                 parse_priority_weights(self.ecfg.priority_weights),
                 max_preemptions=self.ecfg.max_preemptions,
                 aging_ms=float(self.ecfg.priority_aging_ms))
+        # --- live migration out of this replica (ISSUE 14) ---
+        # request_id -> handoff callable, drained by the engine loop at
+        # the next tick: the slot preempts (PR-10 pause), its retained
+        # chain force-offloads to the (shared) host tier, and the
+        # ResumeEntry is handed to the pool instead of parked here.
+        self._migrate_req: dict = {}
+        self._migrate_lock = threading.Lock()
+        # replica_die fault name (chaos: pool crash recovery) — checked
+        # at the tick top only while fault injection is armed
+        self._die_fault = f"replica{self.replica_id}_die"
+        # --- resume_reserve_pages autosize (ISSUE 14 satellite; the
+        # open PR-10 follow-up): EWMA of preemptions/min x average pages
+        # retained per preemption -> effective reserve when the explicit
+        # knob is 0. Starts at 0, so engines that never preempt keep
+        # bit-for-bit admission behavior.
+        self._preempt_marks: "deque" = deque(maxlen=256)   # monotonic stamps
+        self._preempt_rate_ewma = 0.0    # preemptions per minute
+        self._preempt_pages_ewma = 0.0   # pages retained per preemption
+        self._reserve_auto = 0
+        self._t_reserve_sample = time.monotonic()
         # --- per-class SLO engine + violation flight recorder (ISSUE 12)
         # Built only when an objective is declared — the finish-path
         # observe() calls are then dict lookups; with no objectives the
@@ -2332,10 +2384,13 @@ class Engine:
         if self._thread:
             self._thread.join(timeout=10)
         self._sync_q.put(None)
-        if self._hstore is not None and self.ecfg.kv_host_store_path:
+        if (self._hstore is not None and self.ecfg.kv_host_store_path
+                and self._hstore_owned):
             # graceful-shutdown persistence: let the worker drain any
             # in-flight offload gathers into the store first, then
-            # serialize it for the next engine of this model
+            # serialize it for the next engine of this model. Pool
+            # replicas never save — the POOL persists the shared store
+            # once (ISSUE 14), not once per replica.
             self._sync_thread.join(timeout=30)
             self._hstore.save(self.ecfg.kv_host_store_path)
         if self._bus is not None:
@@ -2528,6 +2583,8 @@ class Engine:
             "tokens_per_second_active": tok_s,
             "prompt_tokens_reused": self._reused_total,
             "uptime_s": time.monotonic() - self._load_time,
+            "replica_id": self.replica_id,
+            "engine_replicas": 1,    # EnginePool.metrics() overrides
             # ragged packed prefill (module doc): scheduling mode +
             # per-dispatch packing efficiency (pad_tokens / tokens is
             # the bucket-pad waste the packing removed per-slot)
@@ -2638,7 +2695,11 @@ class Engine:
             sch = self._sched.stats()
             sch["preempt"] = True
             sch["max_preemptions"] = self.ecfg.max_preemptions
-            sch["resume_reserve_pages"] = self.ecfg.resume_reserve_pages
+            # the reserve actually applied (explicit knob, or the
+            # preemption-rate autosized value — ISSUE 14 satellite)
+            sch["resume_reserve_pages"] = self.resume_reserve_effective
+            sch["resume_reserve_auto"] = self._reserve_auto
+            sch["preempt_rate_per_min"] = round(self._preempt_rate_ewma, 3)
             queued_by = {c: 0 for c in PRIORITY_CLASSES}
             with self._queue.mutex:
                 for req in self._queue.queue:
@@ -2698,6 +2759,44 @@ class Engine:
             elif self._pool_pressure and free_frac > 0.10:
                 self._pool_pressure = False
         self._wm.sample(**wm)
+        self._autosize_reserve()
+
+    def _autosize_reserve(self):
+        """resume_reserve_pages autosize (ISSUE 14 satellite, the open
+        PR-10 follow-up): when the explicit knob is 0, derive an
+        effective reserve from observed preemption pressure —
+        EWMA(preemptions/min) x EWMA(pages retained per preemption),
+        clamped to a quarter of the pool. Rides the 0.5 s watermark
+        cadence; engines that never preempt stay at 0 (bit-for-bit
+        pre-PR admission)."""
+        if not self._paged or self._sched is None:
+            return
+        now = time.monotonic()
+        dt = now - self._t_reserve_sample
+        if dt < 0.5:
+            return
+        self._t_reserve_sample = now
+        # instantaneous rate over a sliding 60 s window of marks
+        horizon = now - 60.0
+        # marks inside a sliding 60 s window = preemptions per minute
+        inst = float(sum(1 for t in self._preempt_marks if t >= horizon))
+        # EWMA with a ~15 s time constant at the 0.5 s cadence
+        a = min(1.0, dt / 15.0)
+        self._preempt_rate_ewma = ((1 - a) * self._preempt_rate_ewma
+                                   + a * inst)
+        if self.ecfg.resume_reserve_pages > 0:
+            return    # explicit knob wins; EWMA still tracked for metrics
+        cap = max(1, self._pool.num_pages // 4)
+        want = self._preempt_rate_ewma * max(1.0, self._preempt_pages_ewma)
+        self._reserve_auto = min(cap, int(round(want)))
+
+    @property
+    def resume_reserve_effective(self) -> int:
+        """The reserve _admit_sched actually applies: the explicit knob
+        when set, else the preemption-rate autosized value."""
+        if self.ecfg.resume_reserve_pages > 0:
+            return self.ecfg.resume_reserve_pages
+        return self._reserve_auto
 
     def state_snapshot(self) -> dict:
         """Live engine-state JSON for /debug/state (ISSUE 8): slots,
@@ -2920,10 +3019,27 @@ class Engine:
         # path triggers happens right here (ISSUE 8)
         sysobs.register_thread(self._cobs)
         t_wm = 0.0
+        try:
+            self._run_ticks(t_wm)
+        except _ReplicaDead:
+            # chaos: die like a lost host — the thread just ends, with
+            # _stop still False (that asymmetry IS the pool's death
+            # signal) and the host mirrors intact for recovery harvest
+            log.warning("replica %d: loop killed by replica_die fault",
+                        self.replica_id)
+
+    def _run_ticks(self, t_wm: float):
         while not self._stop:
             try:
                 t0 = time.monotonic()
                 t_tick = t0
+                if FAULTS.active and FAULTS.take(self._die_fault) is not None:
+                    raise _ReplicaDead()
+                # live migration out (ISSUE 14): eject requested streams
+                # at the tick top — previous tick fully processed, so
+                # the pause point is a burst boundary like any preempt
+                if self._migrate_req:
+                    self._process_migrations()
                 if t0 - t_wm > 0.5:
                     # watermark fold (ISSUE 8): cheap max() samples so
                     # pool peaks between /metrics scrapes are not lost
@@ -3113,7 +3229,7 @@ class Engine:
             return False
         admitted = False
         leaders: dict = {}
-        reserve = self.ecfg.resume_reserve_pages
+        reserve = self.resume_reserve_effective
         # hard bound on the work loop: every iteration either admits,
         # preempts (at most num_slots times), or breaks
         guard = 2 * self.ecfg.num_slots + 8
@@ -3214,9 +3330,14 @@ class Engine:
                           s.preempts))
         return self._sched.pick_victim(incoming_rank, cands)
 
-    def _preempt_slot(self, slot: int, why: str = "priority") -> bool:
+    def _preempt_slot(self, slot: int, why: str = "priority",
+                      park: bool = True):
         """Pause an active slot at a burst boundary and park its request
-        for resume (ISSUE 10). Committed pages are RETAINED through the
+        for resume (ISSUE 10). With ``park=False`` (live migration,
+        ISSUE 14) the ResumeEntry is RETURNED instead of parked — the
+        caller hands it to a sibling replica, and this engine's
+        preemption counters stay untouched (migration is placement, not
+        capacity pressure). Committed pages are RETAINED through the
         prefix cache exactly like a release/context-shift — under
         continued pool pressure they offload host-side through the
         normal reclaim path — so resume is plain re-admission: the
@@ -3246,8 +3367,20 @@ class Engine:
             held_text=s.held_text, t_start=s.t_start,
             t_first_token=s.t_first_token or None,
             t_prefill_ms=s.t_prefill_ms, mu=float(self.mu[slot]),
-            preempt_count=s.preempts + 1)
-        self._sched.park(entry)
+            preempt_count=s.preempts + (1 if park else 0))
+        if park:
+            self._sched.park(entry)
+            # resume-reserve autosize input (ISSUE 14 satellite): stamp
+            # the preemption and fold retained-pages into its EWMA —
+            # migrations don't count, they are not capacity pressure
+            pg = self._pool.page_size if self._paged else 1
+            pages = committed // max(1, pg)
+            self._preempt_marks.append(time.monotonic())
+            if len(self._preempt_marks) == 1:
+                self._preempt_pages_ewma = float(pages)
+            else:
+                self._preempt_pages_ewma = (
+                    0.7 * self._preempt_pages_ewma + 0.3 * pages)
         self.slots[slot] = None
         self.active_dev[slot] = False
         self.lengths[slot] = 0
@@ -3264,8 +3397,9 @@ class Engine:
         for b in self._fifo:
             if isinstance(b, _Burst):
                 b.skip_slots.add(slot)
-        with self._lc_lock:
-            self._lc["preemptions"] = self._lc.get("preemptions", 0) + 1
+        if park:
+            with self._lc_lock:
+                self._lc["preemptions"] = self._lc.get("preemptions", 0) + 1
         EVENTS.emit("preempt", rid=s.req.request_id, slot=slot, why=why,
                     priority=s.req.priority, n_decoded=s.n_decoded,
                     retained_rows=committed)
@@ -3274,7 +3408,7 @@ class Engine:
                                time.monotonic(), rid=s.req.request_id,
                                args={"why": why,
                                      "retained_rows": committed})
-        return True
+        return True if park else entry
 
     def _start_resume(self, entry: "ResumeEntry"):
         """Re-admit a preempted request (ISSUE 10). Admission IS the
@@ -3306,6 +3440,120 @@ class Engine:
                                args={"reused_rows": s.reused,
                                      "reprefill_rows": len(ids) - s.reused})
         return slot
+
+    # ---- replica-pool surface (ISSUE 14) -------------------------------
+
+    @property
+    def loop_alive(self) -> bool:
+        """True while the engine loop thread is serving. False after
+        shutdown() — or, with _stop still False, after a crash the
+        generic recovery could not catch (the replica_die chaos fault):
+        that asymmetry is the pool health check's death signal."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def request_migration(self, request_id: str, handoff) -> None:
+        """Ask the engine loop to eject ``request_id`` at the next tick
+        top (a burst boundary, like any preemption). ``handoff(payload)``
+        fires on the ENGINE LOOP thread with:
+          ("resume", ResumeEntry, mapped_keys)  — was active or parked;
+            retained pages force-offloaded to the (shared) host tier and
+            mapped under ("migrate", rid) so budget eviction can't race
+            the sibling's restore (the pool unmaps after adoption)
+          ("fresh", GenRequest, [])             — still queued, nothing
+            computed: plain re-submit on the target
+          None                                   — unknown/finished, or
+            the slot is migration-ineligible (grammar/multimodal/fork
+            state does not ride a ResumeEntry)"""
+        with self._migrate_lock:
+            self._migrate_req[request_id] = handoff
+        self._wake.set()
+
+    def adopt_resume(self, entry: "ResumeEntry") -> bool:
+        """Adopt a sibling replica's preempted request (migration-in).
+        The entry parks in THIS engine's resume queue — without bumping
+        its preemption counters — and the normal _admit_sched path
+        re-admits it: the chain lookup splices the same pages back from
+        the shared host tier, or re-prefills the identical history.
+        Thread-safe (list append under the GIL); callable from the pool
+        thread. False when this engine has no scheduler (preempt=0)."""
+        if self._sched is None:
+            return False
+        self._sched.adopt(entry)
+        self._wake.set()
+        return True
+
+    def _process_migrations(self):
+        """Engine-loop half of request_migration (tick top)."""
+        with self._migrate_lock:
+            items = list(self._migrate_req.items())
+            self._migrate_req.clear()
+        import logging
+        log = logging.getLogger(__name__)
+        for rid, handoff in items:
+            try:
+                payload = self._eject_request(rid)
+            except Exception:
+                log.exception("migration eject failed for %s", rid)
+                payload = None
+            try:
+                handoff(payload)
+            except Exception:
+                log.exception("migration handoff failed for %s", rid)
+
+    def _eject_request(self, rid: str):
+        """Remove ``rid`` from this replica wherever it lives (active
+        slot -> pause; queued -> unqueue; parked -> unpark) and return
+        the request_migration payload."""
+        owner = ("migrate", rid)
+        # active slot: PR-10 pause, but hand the entry out instead of
+        # parking it (park=False keeps preemption counters honest)
+        for i, s in enumerate(self.slots):
+            if s is None or s.req.request_id != rid:
+                continue
+            if self._sched is None or not self._preempt_eligible(i, s):
+                return None
+            entry = self._preempt_slot(i, why="migrate", park=False)
+            if entry is True or not entry:
+                return None
+            return ("resume", entry, self._offload_chain(entry.ids, owner))
+        # still queued: nothing computed yet, plain re-route
+        with self._queue.mutex:
+            for r in self._queue.queue:
+                if r.request_id == rid:
+                    self._queue.queue.remove(r)
+                    return ("fresh", r, [])
+        # parked on this replica's resume queue
+        if self._sched is not None:
+            entry = self._sched.remove_parked(rid)
+            if entry is not None:
+                return ("resume", entry,
+                        self._offload_chain(entry.ids, owner))
+        return None
+
+    def _offload_chain(self, ids, owner=None) -> list:
+        """Force-copy the retained device chain for ``ids`` into the
+        host tier WITHOUT dropping the device entries (unlike eviction:
+        the local copy stays warm; the host copy is what a sibling
+        replica restores from). Maps every covered key under ``owner``
+        first, so the async put can never lose a budget-eviction race.
+        Returns the mapped keys (engine-loop thread only: dispatches a
+        device gather)."""
+        if self._pcache is None or self._hstore is None:
+            return []
+        mapped: list = []
+        victims: list = []
+        for key in self._pcache.chain_keys(ids):
+            e = self._pcache._entries.get(key)
+            if e is None:
+                break
+            if owner is not None:
+                self._hstore.map_key(key, owner)
+                mapped.append(key)
+            if not self._hstore.contains(key):
+                victims.append((e.key, e.parent, e.depth, e.page))
+        if victims:
+            self._dispatch_offload(victims)
+        return mapped
 
     def _free_count(self) -> int:
         return sum(1 for s in self.slots if s is None)

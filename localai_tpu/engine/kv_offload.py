@@ -33,6 +33,17 @@ granularity under the existing pool:
 
 Thread safety: the engine loop matches/takes entries while the sync
 worker inserts freshly gathered pages — every public method locks.
+
+SHARED MODE (ISSUE 14): one HostPageStore may back N engine replicas of
+the same model (EnginePool). Each replica keeps its own device tier;
+this store is the one tier they all restore from, so it additionally
+tracks WHICH owners (replica ids, migration tokens) currently map each
+chain key. Budget eviction never removes an entry — or an ancestor of
+an entry, removal cascades down — that some owner still maps: a sibling
+replica's device tier or an in-flight migration may be about to splice
+it back. CRC-corrupt entries are still dropped regardless (bad bytes
+must go; the mapper re-prefills). When every entry is protected the
+byte budget degrades to best-effort rather than evicting mapped state.
 """
 
 from __future__ import annotations
@@ -174,6 +185,11 @@ class HostPageStore:
         self._children: dict[bytes, set] = {}
         self._tick = 0
         self._bytes = 0
+        # shared mode (ISSUE 14): chain key -> set of owner tokens that
+        # still map it (replica ids from device-tier inserts, migration
+        # pins). May name keys with no host entry yet — an owner can map
+        # a key whose offload is still in flight through the sync worker.
+        self._mapped: dict[bytes, set] = {}
         # telemetry (monotonic totals -> localai_kv_offload_*_total)
         self.offloaded_pages = 0
         self.offloaded_bytes = 0
@@ -183,6 +199,7 @@ class HostPageStore:
         self.misses = 0          # tier consulted, chain not present
         self.evicted_pages = 0   # host -> gone (budget eviction)
         self.corrupt_dropped = 0  # CRC mismatch at get(): tree dropped
+        self.evict_blocked = 0   # budget evictions skipped: key mapped
 
     # ---------- introspection ----------
 
@@ -214,7 +231,60 @@ class HostPageStore:
                 "misses": self.misses,
                 "evicted_pages": self.evicted_pages,
                 "corrupt_dropped": self.corrupt_dropped,
+                "mapped_keys": len(self._mapped),
+                "evict_blocked": self.evict_blocked,
             }
+
+    # ---------- shared-mode mapping refcounts (ISSUE 14) ----------
+
+    def map_key(self, key: bytes, owner) -> None:
+        """Record that ``owner`` (a replica id or migration token) maps
+        this chain key: its device tier holds the page, or a migration
+        is about to splice it on another replica. Mapped entries — and
+        their ancestors, since removal cascades down — are exempt from
+        budget eviction until the last owner unmaps."""
+        with self._lock:
+            self._mapped.setdefault(key, set()).add(owner)
+
+    def unmap_key(self, key: bytes, owner) -> None:
+        with self._lock:
+            owners = self._mapped.get(key)
+            if owners is not None:
+                owners.discard(owner)
+                if not owners:
+                    del self._mapped[key]
+
+    def unmap_owner(self, owner) -> int:
+        """Drop every mapping held by ``owner`` (replica device-tier
+        clear, replica death, migration pin release). Returns how many
+        keys the owner was mapping."""
+        n = 0
+        with self._lock:
+            for key in list(self._mapped):
+                owners = self._mapped[key]
+                if owner in owners:
+                    owners.discard(owner)
+                    n += 1
+                    if not owners:
+                        del self._mapped[key]
+        return n
+
+    def mapped_count(self, key: bytes) -> int:
+        with self._lock:
+            owners = self._mapped.get(key)
+            return len(owners) if owners else 0
+
+    def _protected_keys_locked(self) -> set:
+        """Keys budget eviction must skip: every mapped key that has a
+        host entry, plus all its ancestors (evicting an ancestor would
+        cascade the mapped descendant away)."""
+        protected: set = set()
+        for key in self._mapped:
+            k = key
+            while k in self._entries and k not in protected:
+                protected.add(k)
+                k = self._entries[k].parent
+        return protected
 
     # ---------- store operations ----------
 
@@ -309,13 +379,21 @@ class HostPageStore:
     def _evict_to_budget_locked(self):
         if self._bytes <= self.budget_bytes:
             return
+        protected = self._protected_keys_locked() if self._mapped else ()
         victims = sorted(self._entries.values(),
                          key=lambda e: (e.tick, -e.depth))
         for e in victims:
             if self._bytes <= self.budget_bytes:
                 return
-            if e.key in self._entries:
-                self._remove_tree_locked(e.key)
+            if e.key not in self._entries:
+                continue
+            if e.key in protected:
+                # a sibling replica (or in-flight migration) still maps
+                # this entry or a descendant: never evict it under
+                # budget pressure — the budget turns best-effort instead
+                self.evict_blocked += 1
+                continue
+            self._remove_tree_locked(e.key)
 
     def _remove_tree_locked(self, key: bytes) -> int:
         """Remove an entry and every descendant (an orphaned child is
@@ -342,6 +420,7 @@ class HostPageStore:
         with self._lock:
             self._entries.clear()
             self._children.clear()
+            self._mapped.clear()
             self._bytes = 0
 
     # ---------- disk persistence ----------

@@ -36,10 +36,19 @@ Design:
 
 Entries are one page each, so the store is bounded by the pool size;
 there is no separate capacity knob — pool pressure IS the bound.
+
+ISSUE 14 (replica pools): the cache stays single-threaded (engine-loop
+only), but it can now REPORT its membership changes through optional
+``on_insert`` / ``on_remove`` / ``on_clear`` callbacks so an EnginePool
+can maintain one cross-replica PoolPrefixIndex (chain key -> which
+replicas hold it at what depth) and the shared HostPageStore's mapping
+refcounts. With no callbacks installed (the default, engines=1) every
+code path is byte-identical to before.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Iterable, Optional
 
 from localai_tpu.ops import kvcache
@@ -60,12 +69,17 @@ class _Entry:
 class PrefixPageCache:
     """Host-side index of retained pages; the PagePool owns the pages."""
 
-    def __init__(self, scope: bytes, page_size: int):
+    def __init__(self, scope: bytes, page_size: int,
+                 on_insert=None, on_remove=None, on_clear=None):
         self.scope = scope
         self.page_size = page_size
         self._entries: dict[bytes, _Entry] = {}
         self._children: dict[bytes, set] = {}
         self._tick = 0
+        # pool-mode membership hooks (ISSUE 14); None = standalone
+        self._on_insert = on_insert    # (key, depth) -> None
+        self._on_remove = on_remove    # (key,) -> None
+        self._on_clear = on_clear      # () -> None
         # telemetry (absolute, monotonic — exported as counters)
         self.hits = 0            # admissions served from the store
         self.misses = 0          # store consulted, no usable chain
@@ -130,6 +144,8 @@ class PrefixPageCache:
             pool.hold(page)
             self._entries[key] = _Entry(key, parent, page, i, self._tick)
             self._children.setdefault(parent, set()).add(key)
+            if self._on_insert is not None:
+                self._on_insert(key, i)
             added += 1
             parent = key
         self.inserted_pages += added
@@ -193,6 +209,8 @@ class PrefixPageCache:
                     del self._children[e.parent]
             if on_evict is not None:
                 on_evict(e)
+            if self._on_remove is not None:
+                self._on_remove(k)
             pool.drop(e.page)
             n += 1
         return n
@@ -210,6 +228,8 @@ class PrefixPageCache:
         pool.hold(page)
         self._entries[key] = _Entry(key, parent, page, depth, self._tick)
         self._children.setdefault(parent, set()).add(key)
+        if self._on_insert is not None:
+            self._on_insert(key, depth)
         return True
 
     def clear(self):
@@ -218,6 +238,8 @@ class PrefixPageCache:
         holds die with it. Counters survive (telemetry continuity)."""
         self._entries.clear()
         self._children.clear()
+        if self._on_clear is not None:
+            self._on_clear()
 
     # ---------- engine-side accounting helpers ----------
 
@@ -227,6 +249,77 @@ class PrefixPageCache:
 
     def note_miss(self):
         self.misses += 1
+
+
+class PoolPrefixIndex:
+    """Cross-replica chain-hash index for an EnginePool (ISSUE 14).
+
+    Maps chain key -> {replica_id: depth} for every page currently
+    retained in SOME replica's device tier. Fed by the per-replica
+    PrefixPageCache membership callbacks (each fires on its own engine
+    loop thread — all methods lock), queried by the pool's admission
+    router: "which replica holds the longest live chain match for this
+    prompt?" Depths are chain positions (0 = first page), so a replica
+    matching keys [0, d) serves d pages of prefill for free.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._where: dict[bytes, dict[int, int]] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._where)
+
+    def note_insert(self, replica: int, key: bytes, depth: int) -> None:
+        with self._lock:
+            self._where.setdefault(key, {})[replica] = depth
+
+    def note_remove(self, replica: int, key: bytes) -> None:
+        with self._lock:
+            holders = self._where.get(key)
+            if holders is not None:
+                holders.pop(replica, None)
+                if not holders:
+                    del self._where[key]
+
+    def clear_replica(self, replica: int) -> int:
+        """Forget every key a replica held (device reset, replica
+        death). Returns how many keys it was holding."""
+        n = 0
+        with self._lock:
+            for key in list(self._where):
+                holders = self._where[key]
+                if replica in holders:
+                    del holders[replica]
+                    n += 1
+                    if not holders:
+                        del self._where[key]
+        return n
+
+    def match_depths(self, keys) -> dict:
+        """{replica: matched_pages} of CONTIGUOUS root-down chain
+        matches over ``keys``. A replica r appears with value d iff it
+        holds keys[0..d-1]; replicas drop out of the running at their
+        first gap (a hole hides everything past it — pages encode
+        absolute position)."""
+        depths: dict = {}
+        cur: set = set()
+        with self._lock:
+            for i, k in enumerate(keys):
+                holders = self._where.get(k)
+                if not holders:
+                    break
+                cur = set(holders) if i == 0 else (cur & set(holders))
+                if not cur:
+                    break
+                for r in cur:
+                    depths[r] = i + 1
+        return depths
+
+    def replica_pages(self, replica: int) -> int:
+        with self._lock:
+            return sum(1 for h in self._where.values() if replica in h)
 
 
 def build_scope(family: str, cfg, page_size: int, cache_dtype) -> bytes:

@@ -125,6 +125,7 @@ class Scheduler:
         self._resume: List[ResumeEntry] = []
         # counters (exported via engine metrics())
         self.preemptions = 0
+        self.adoptions = 0      # entries migrated IN from a sibling
         self.resumes = 0
         self.resume_reprefills = 0
         self.resume_restore_rows = 0
@@ -280,6 +281,29 @@ class Scheduler:
         self.preemptions += 1
         self._resume.append(entry)
 
+    def adopt(self, entry: ResumeEntry) -> None:
+        """Park a resume entry MIGRATED from a sibling replica (ISSUE
+        14) — identical to ``park`` except the preemption happened (and
+        was counted) on the source engine, so this one's counter must
+        not move. List append is atomic under the GIL, so the pool may
+        call this from its own thread while the engine loop pops."""
+        self.adoptions += 1
+        self._resume.append(entry)
+
+    def remove_parked(self, request_id: str) -> Optional[ResumeEntry]:
+        """Pop the parked entry for ``request_id`` (migration-out of a
+        request that was paused, not active), or None."""
+        for i, e in enumerate(self._resume):
+            if getattr(e.req, "request_id", None) == request_id:
+                return self._resume.pop(i)
+        return None
+
+    def drain_parked(self) -> List[ResumeEntry]:
+        """Remove and return ALL parked entries (replica died: siblings
+        adopt its whole resume queue)."""
+        out, self._resume = self._resume, []
+        return out
+
     def _best_resume_index(self) -> int:
         now = time.monotonic()
         best_i = 0
@@ -317,6 +341,7 @@ class Scheduler:
     def stats(self) -> Dict[str, Any]:
         return {
             "preemptions": self.preemptions,
+            "adoptions": self.adoptions,
             "resumes": self.resumes,
             "resume_reprefills": self.resume_reprefills,
             "resume_restore_rows": self.resume_restore_rows,

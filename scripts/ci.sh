@@ -6,12 +6,13 @@
 #
 # Fails (rc != 0) if either stage fails. Environment knobs:
 #   TIER1_BUDGET_S            tier-1 wall clock (default 870, run_tier1.sh)
-#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 720 here —
+#   LOCALAI_BENCH_BUDGET_S    bench smoke wall clock (default 900 here —
 #                             the packed phase runs three fuse modes plus
 #                             the >1k-token long-pack gate since ISSUE 11,
 #                             the SLO burn phase rides along since
-#                             ISSUE 12, and the speculative-decoding
-#                             phase since ISSUE 13)
+#                             ISSUE 12, the speculative-decoding phase
+#                             since ISSUE 13, and the replica-pool phase
+#                             since ISSUE 14)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #
@@ -23,7 +24,11 @@
 # (SLO_BURN_5M/SLO_VIOLATIONS/TRACE_MERGED tracked line): the tight
 # low-class objective must burn AND land a flight dump on disk, the
 # loose high-class one must stay clean, and one request id must appear
-# under both pids of the merged cross-process trace.
+# under both pids of the merged cross-process trace. Since ISSUE 14 the
+# replica-pool phase rides along too (REPLICA_AFFINITY_HITS/
+# MIGRATE_BYTE_MATCH/REPLICA_RECOVERED tracked line): prefix-affinity
+# routing, the live-migration byte gate, and kill-one-replica recovery
+# through the shared host KV tier.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -33,7 +38,7 @@ scripts/run_tier1.sh
 
 echo "== ci: bench smoke =="
 smoke_out=$(mktemp)
-LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-720}" \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_BENCH_BUDGET_S:-900}" \
     python bench.py --smoke | tee "$smoke_out"
 
 echo "== ci: tracked =="
@@ -133,6 +138,28 @@ if apd is None or not apd > 1.0 or line.get("spec_byte_match") is not True:
     print(f"FAIL: speculative decoding regressed "
           f"(accept_per_dispatch={apd} must be > 1.0, "
           f"byte_match={line.get('spec_byte_match')} must be true)")
+    sys.exit(1)
+# engine replica pool (ISSUE 14): the warm resubmission must route to
+# the replica holding the prefix chain (affinity hit), a forced live
+# migration must continue byte-identically to a fresh pool
+# re-admission, and killing one replica mid-stream must recover onto
+# the sibling through the shared host tier without breaking the stream
+rp = line.get("replicas") or {}
+print(f"REPLICA_AFFINITY_HITS={line.get('replica_affinity_hits')} "
+      f"MIGRATE_BYTE_MATCH={line.get('migrate_byte_match')} "
+      f"REPLICA_RECOVERED={line.get('replica_recovered')} "
+      f"cold_ttft_ms={rp.get('cold_ttft_ms')} "
+      f"host_warm_ttft_ms={rp.get('host_warm_ttft_ms')} "
+      f"warm_beats_cold={rp.get('warm_beats_cold')} "
+      f"crash_byte_match={rp.get('crash_byte_match')} "
+      f"replicas_alive_after={rp.get('replicas_alive_after')}")
+hits = line.get("replica_affinity_hits")
+if (hits is None or not hits >= 1
+        or line.get("migrate_byte_match") is not True
+        or line.get("replica_recovered") is not True):
+    print(f"FAIL: replica pool regressed (affinity_hits={hits} must be "
+          f">= 1, migrate_byte_match={line.get('migrate_byte_match')} and "
+          f"replica_recovered={line.get('replica_recovered')} must be true)")
     sys.exit(1)
 PY
 rm -f "$smoke_out"

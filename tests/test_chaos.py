@@ -372,6 +372,124 @@ def test_host_store_corruption_detected_and_dropped():
     assert store.get(b"k" * 32) is not None
 
 
+# ---- engine replica pool: kill one replica mid-stream (ISSUE 14) ----
+
+
+def _pool_greedy(tok, prompt, n, **kw):
+    from localai_tpu.engine import sampling as smp
+
+    return eng.GenRequest(prompt_ids=tok.encode(prompt),
+                          params=smp.SamplingParamsHost(temperature=0.0),
+                          max_new_tokens=n, ignore_eos=True, **kw)
+
+
+def _pool_collect(out, timeout=60.0):
+    evs = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return evs
+        evs.append(ev)
+
+
+def test_replica_death_mid_stream_sibling_resumes_byte_identical(
+        tiny_llama, byte_tokenizer):
+    """DejaVu's failure model on the replica pool: replica 0's engine
+    loop dies mid-decode (its device KV tier is lost with it). The pool
+    detects the dead loop, harvests the in-flight request, and a
+    SIBLING adopts it — the client stream never errors, the warm prefix
+    chain restores from the SHARED host tier (no full re-prefill for
+    those pages: resume_restore_rows ticks on the sibling), and the
+    continuation is byte-identical to a fresh re-admission of
+    (prompt + tokens emitted before the crash)."""
+    from localai_tpu.engine.pool import EnginePool
+    from localai_tpu.services.eventlog import EVENTS
+
+    cfg, params = tiny_llama
+    # 1 slot/replica and a pool exactly one slot deep: retained chains
+    # always evict (and thus OFFLOAD to the shared host tier) when the
+    # next admission needs the pages
+    ecfg = eng.EngineConfig(num_slots=1, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=8, kv_pool_pages=12)
+    pool = EnginePool.build(cfg, params, byte_tokenizer, ecfg, engines=2)
+    pool.start()
+    try:
+        prompt = "the crash victim's warm prompt"     # spans >2 pages
+        # phase 0: run the prompt on replica 0 (load tie breaks to 0) so
+        # its chain is RETAINED in 0's device tier...
+        r0 = _pool_greedy(byte_tokenizer, prompt, 4)
+        _pool_collect(pool.submit(r0))
+        assert pool.where(r0.request_id) == 0
+        n_chain = len(list(pool._engines[0]._pcache.chain_keys(
+            byte_tokenizer.encode(prompt))))
+        assert n_chain >= 2
+        # ...then squeeze it out with an unrelated prompt: eviction
+        # under pool pressure IS the device->host offload path
+        rq = _pool_greedy(byte_tokenizer, "qqqq unrelated squeeze", 60)
+        _pool_collect(pool.submit(rq))
+        assert pool.where(rq.request_id) == 0
+        store = pool._shared.store
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and store.pages < n_chain:
+            time.sleep(0.02)
+        assert store.pages >= n_chain, store.stats()
+        EVENTS.clear()
+        # phase 1: the victim — same prompt, long stream, lands on 0
+        # again (no device chain anywhere -> load tie), restores its
+        # prefix from the host tier, then the replica dies under it
+        n = 48
+        victim = _pool_greedy(byte_tokenizer, prompt, n)
+        out = pool.submit(victim)
+        assert pool.where(victim.request_id) == 0
+        first = out.get(timeout=60.0)
+        assert first.error is None
+        b1 = pool._engines[1].metrics()["scheduler"]
+        FAULTS.arm("replica0_die", count=1)
+        evs = [first] + _pool_collect(out)
+        # the stream finished WITHOUT an error despite the crash
+        assert all(ev.error is None for ev in evs)
+        ids = eng.event_ids(evs)
+        assert len(ids) == n
+        assert pool.where(victim.request_id) == 1
+        assert pool._migrations["crash"] >= 1
+        downs = [e for e in EVENTS.events() if e["event"] == "replica_down"]
+        assert downs and downs[0]["replica"] == 0
+        migs = [e for e in EVENTS.events() if e["event"] == "migrate"
+                and e["rid"] == victim.request_id]
+        assert migs and migs[0]["reason"] == "crash"
+        k = migs[0]["n_decoded"]
+        assert 0 < k < n
+        # the sibling restored the warm chain from the SHARED host tier
+        # instead of fully re-prefilling it
+        b2 = pool._engines[1].metrics()["scheduler"]
+        assert b2["adoptions"] >= b1["adoptions"] + 1
+        assert b2["resume_restore_rows"] > b1["resume_restore_rows"]
+        # pool bookkeeping: replica 0 is out of rotation...
+        m = pool.metrics()
+        assert m["pool"]["replicas_alive"] == 1
+        assert not m["replicas"][0]["alive"]
+        # ...and new work still flows (to the survivor)
+        after = _pool_greedy(byte_tokenizer, "post-crash traffic", 4)
+        assert all(ev.error is None
+                   for ev in _pool_collect(pool.submit(after)))
+        assert pool.where(after.request_id) == 1
+        # the byte gate, PR-10's resume contract across the crash: the
+        # recovered continuation == a FRESH submission of (prompt + the
+        # k tokens emitted before the crash). The reference goes
+        # through the pool so it splices the survivor's retained chain
+        # — the SAME rows the recovered continuation was conditioned on
+        # (a cold engine's re-prefill can differ in the last ulps from
+        # retained decode-computed rows: the PR-10 numerics caveat)
+        ref = eng.event_ids(list(pool.generate(eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode(prompt) + ids[:k],
+            params=sampling.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=n - k, ignore_eos=True))))
+        assert ids[k:] == ref
+    finally:
+        pool.shutdown()
+
+
 # ---- HTTP surface: readyz + circuit breaker + Retry-After ----
 
 

@@ -1,0 +1,273 @@
+"""Engine replica pool (engine/pool.py, ISSUE 14): cross-replica prefix
+index, prefix-affinity + least-loaded routing, live migration's byte
+gate, resume-reserve autosizing, the engines= knob, and pool metrics.
+
+The migration byte gate is PR-10's resume contract lifted across
+replicas: a migrated continuation must equal a FRESH re-admission of
+(prompt + tokens emitted before the pause) — NOT bit-parity with an
+uninterrupted run (prefill-vs-decode kernel numerics differ). The
+kill-a-replica-mid-stream path lives in test_chaos.py with the rest of
+the fault-injection suite; the shared HostPageStore's concurrency
+invariants live in test_kv_offload.py with the store's own tests.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.engine.pool import EnginePool, SharedKV
+from localai_tpu.engine.prefix_cache import PoolPrefixIndex
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _greedy(tok, prompt: str, n: int = 8, priority: str = "") -> eng.GenRequest:
+    return eng.GenRequest(
+        prompt_ids=tok.encode(prompt),
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n, ignore_eos=True, priority=priority)
+
+
+def _collect(out, timeout: float = 60.0) -> list:
+    events = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return events
+        events.append(ev)
+
+
+# ---- PoolPrefixIndex units ----
+
+
+def test_pool_prefix_index_contiguous_match():
+    ix = PoolPrefixIndex()
+    k = [b"a", b"b", b"c", b"d"]
+    for i, key in enumerate(k):
+        ix.note_insert(0, key, i)
+    for i, key in enumerate(k[:2]):
+        ix.note_insert(1, key, i)
+    assert ix.match_depths(k) == {0: 4, 1: 2}
+    # a gap hides everything past it: replica 1 losing "b" must not
+    # keep matching at depth 2 via "c"
+    ix.note_remove(1, b"b")
+    ix.note_insert(1, b"c", 2)
+    assert ix.match_depths(k) == {0: 4, 1: 1}
+    assert ix.replica_pages(0) == 4
+    assert ix.clear_replica(0) == 4
+    assert ix.match_depths(k) == {1: 1}
+    assert len(ix) == 2  # "a" and "c" still held by replica 1
+
+
+def test_pool_prefix_index_empty_and_unknown():
+    ix = PoolPrefixIndex()
+    assert ix.match_depths([b"x", b"y"]) == {}
+    ix.note_remove(3, b"x")          # removing what was never inserted
+    assert ix.clear_replica(3) == 0  # clearing an unknown replica
+
+
+# ---- SharedKV units ----
+
+
+def test_shared_kv_single_store_instance():
+    from localai_tpu.ops import kvcache
+
+    shared = SharedKV()
+    scope = kvcache.page_scope(4, "unit")
+    s0 = shared.host_store(scope, 4, 16)
+    s1 = shared.host_store(scope, 4, 16)
+    assert s0 is s1  # ONE host tier, however many replicas ask
+
+
+# ---- engines= knob validation ----
+
+
+def test_engines_option_validation():
+    from localai_tpu.config.model_config import ModelConfig
+
+    ok = ModelConfig(name="m", options=["engines=2"])
+    assert not [p for p in ok.validate() if "engines" in p]
+    bad = ModelConfig(name="m", options=["engines=0"])
+    assert any("engines" in p for p in bad.validate())
+    bad2 = ModelConfig(name="m", options=["engines=two"])
+    assert any("engines" in p for p in bad2.validate())
+    # cross-knob: the pool migrates via pause/resume
+    nop = ModelConfig(name="m", options=["engines=2", "preempt=0"])
+    assert any("preempt" in p for p in nop.validate())
+    one = ModelConfig(name="m", options=["engines=1", "preempt=0"])
+    assert not one.validate()
+
+
+def test_pool_build_rejects_no_preempt(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    with pytest.raises(ValueError, match="preempt"):
+        EnginePool.build(cfg, params, byte_tokenizer,
+                         eng.EngineConfig(num_slots=1, max_context=96,
+                                          prefill_buckets=(16, 64),
+                                          preempt=False),
+                         engines=2)
+
+
+# ---- live pool ----
+
+
+@pytest.fixture(scope="module")
+def pool(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=2, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=8)
+    p = EnginePool.build(cfg, params, byte_tokenizer, ecfg, engines=2)
+    p.start()
+    yield p
+    p.shutdown()
+
+
+def test_pool_serves_and_routes_with_affinity(pool, byte_tokenizer):
+    """Cold submission lands somewhere; re-submitting the same prompt
+    routes to the replica whose device tier retained the prefix chain
+    (affinity hit), and both runs are byte-identical greedy output."""
+    prompt = "affinity routing exercises the shared index!"  # > 1 page
+    req1 = _greedy(byte_tokenizer, prompt, 12)
+    evs1 = _collect(pool.submit(req1))
+    assert all(e.error is None for e in evs1)
+    home = pool.where(req1.request_id)
+    assert home is not None
+    # wait for the release-path insert to land in the pool index
+    deadline = time.monotonic() + 5.0
+    pc = pool._engines[home]._pcache
+    keys = list(pc.chain_keys(req1.prompt_ids))
+    assert keys, "prompt must span at least one full page"
+    while time.monotonic() < deadline:
+        if pool._shared.index.match_depths(keys).get(home, 0) > 0:
+            break
+        time.sleep(0.02)
+    hits0 = pool.affinity_hits
+    req2 = _greedy(byte_tokenizer, prompt, 12)
+    evs2 = _collect(pool.submit(req2))
+    assert pool.where(req2.request_id) == home
+    assert pool.affinity_hits == hits0 + 1
+    assert eng.event_ids(evs2) == eng.event_ids(evs1)
+
+
+def test_pool_least_loaded_routing(pool, byte_tokenizer):
+    """With no usable prefix match, a request lands on the replica with
+    the least load; a busy replica loses the tie it would otherwise win
+    by index order."""
+    busy = _greedy(byte_tokenizer, "zzz unrelated long-running work", 48)
+    out_busy = pool.submit(busy)
+    first = out_busy.get(timeout=60.0)
+    assert first.error is None
+    b = pool.where(busy.request_id)
+    probe = _greedy(byte_tokenizer, "qqq a different cold prompt", 4)
+    out = pool.submit(probe)
+    assert pool.where(probe.request_id) == 1 - b
+    _collect(out)
+    _collect(out_busy)
+
+
+def test_pool_migrate_byte_match(pool, byte_tokenizer):
+    """Live migration mid-decode: the stream never closes, the target's
+    continuation equals a FRESH single-engine re-admission of
+    (prompt + tokens emitted before the pause), and the pool counts the
+    rebalance migration."""
+    EVENTS.clear()
+    prompt = "migrate me across replicas please"
+    n = 48
+    req = _greedy(byte_tokenizer, prompt, n)
+    out = pool.submit(req)
+    first = out.get(timeout=60.0)
+    assert first.error is None
+    src = pool.where(req.request_id)
+    mig0 = dict(pool._migrations)
+    assert pool.migrate(req.request_id, reason="rebalance", timeout_s=30)
+    dst = pool.where(req.request_id)
+    assert dst == 1 - src
+    evs = [first] + _collect(out)
+    assert all(e.error is None for e in evs)
+    ids = eng.event_ids(evs)
+    assert len(ids) == n
+    assert pool._migrations["rebalance"] == mig0["rebalance"] + 1
+    pre = [ev for ev in EVENTS.events()
+           if ev["event"] == "preempt" and ev["rid"] == req.request_id
+           and ev.get("why") == "migrate"]
+    assert pre, "migration must pause via the preemption primitive"
+    k = pre[0]["n_decoded"]
+    assert 0 < k < n
+    mig = [ev for ev in EVENTS.events()
+           if ev["event"] == "migrate" and ev["rid"] == req.request_id]
+    assert mig and mig[0]["src"] == src and mig[0]["dst"] == dst
+    # the byte gate: a FRESH submission of (prompt + the k pre-pause
+    # tokens) through the pool — affinity splices the SAME retained
+    # chain the migrated continuation was conditioned on, so the match
+    # is bit-for-bit (the PR-10 caveat: a cold engine's re-prefilled
+    # rows can differ from retained decode-computed rows in the last
+    # ulps, which is why the reference must share the conditioning tier)
+    ref = eng.event_ids(list(pool.generate(eng.GenRequest(
+        prompt_ids=byte_tokenizer.encode(prompt) + ids[:k],
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n - k, ignore_eos=True))))
+    assert ids[k:] == ref
+
+
+def test_pool_metrics_and_snapshot_shape(pool):
+    m = pool.metrics()
+    assert m["engine_replicas"] == 2
+    assert len(m["replicas"]) == 2
+    assert {r["replica"] for r in m["replicas"]} == {0, 1}
+    assert all(r["alive"] for r in m["replicas"])
+    assert m["pool"]["replicas_alive"] == 2
+    assert m["pool"]["routed"] >= 1
+    assert set(m["pool"]["migrations"]) >= {"rebalance", "crash"}
+    # pool-level additive aggregates stay coherent
+    assert m["slots_total"] == sum(r["slots_total"] for r in m["replicas"])
+    snap = pool.state_snapshot()
+    assert snap["engine_replicas"] == 2 and len(snap["replicas"]) == 2
+    tr = pool.trace_events()
+    assert "localai" in tr
+
+
+# ---- resume-reserve autosizing (ISSUE 14 satellite) ----
+
+
+def test_autosize_reserve_tracks_preempt_pressure(tiny_llama,
+                                                  byte_tokenizer):
+    cfg, params = tiny_llama
+    e = eng.Engine(cfg, params, byte_tokenizer,
+                   eng.EngineConfig(num_slots=2, max_context=96,
+                                    prefill_buckets=(16, 64),
+                                    kv_page_size=8))
+    # no preemptions observed -> auto reserve 0 (engines=1 unchanged)
+    assert e.resume_reserve_effective == 0
+    now = time.monotonic()
+    for i in range(6):                      # 6 preempts in the window,
+        e._preempt_marks.append(now - i)    # ~4 pages retained each
+    e._preempt_pages_ewma = 4.0
+    e._t_reserve_sample = now - 20.0        # a stale sample, so dt > 0.5
+    e._autosize_reserve()
+    got = e.resume_reserve_effective
+    assert 0 < got <= e._pool.num_pages // 4
+    # the explicit knob always wins over the autosizer
+    e.ecfg.resume_reserve_pages = 3
+    assert e.resume_reserve_effective == 3
+    e.ecfg.resume_reserve_pages = 0
+    assert e.resume_reserve_effective == got
+    # pressure decays once preemptions stop: repeated idle windows walk
+    # the EWMA (and with it the reserve) back toward zero
+    e._preempt_marks.clear()
+    for _ in range(40):
+        e._t_reserve_sample = time.monotonic() - 20.0
+        e._autosize_reserve()
+    assert e.resume_reserve_effective == 0
+    assert e.metrics()["scheduler"]["resume_reserve_auto"] == 0

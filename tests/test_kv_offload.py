@@ -13,6 +13,7 @@ table — dispatch-only, never a serving-loop sync.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -87,6 +88,143 @@ def test_host_store_budget_lru_eviction_with_cascade():
     present = [k for k in b if s.contains(k)]
     depths = [s.get(k).depth for k in present]
     assert depths == sorted(depths)   # never a child without its ancestors
+
+
+# ---------- shared mode (ISSUE 14: one host tier, N replicas) ----------
+
+
+def test_shared_store_mapped_keys_never_evicted():
+    """A key some replica's device tier still maps — plus its whole
+    ancestor chain (a child without its ancestors is unreachable) — must
+    survive budget eviction; the budget degrades to best-effort and the
+    skip is counted. Unmapping releases the protection."""
+    page_bytes = 2 * _page(0).nbytes
+    budget_mb = 1
+    cap = (budget_mb << 20) // page_bytes
+    s = HostPageStore(_scope(), 4, budget_mb=budget_mb)
+    a = _chain(s, 3, start=0)
+    s.map_key(a[2], owner=0)        # tail mapped -> whole chain protected
+    assert s.mapped_count(a[2]) == 1
+    # fill way past the budget with INDEPENDENT single-page chains (a
+    # single long chain would cascade away in one eviction): A is the
+    # LRU victim every pass, but it is protected
+    for i in range(cap):
+        _chain(s, 1, start=100 + i, val=200)
+    for k in a:
+        assert s.contains(k), "mapped chain (or an ancestor) was evicted"
+    assert s.evict_blocked >= 1
+    assert s.stats()["mapped_keys"] == 1
+    # a second owner keeps the pin alive when the first lets go
+    s.map_key(a[2], owner=1)
+    s.unmap_key(a[2], owner=0)
+    for i in range(8):
+        _chain(s, 1, start=5000 + i, val=90)
+    assert all(s.contains(k) for k in a)
+    # last owner unmaps -> A is ordinary LRU prey again (its ticks are
+    # the oldest in the store, so the next budget pass takes it)
+    s.unmap_key(a[2], owner=1)
+    assert s.stats()["mapped_keys"] == 0
+    for i in range(8):
+        _chain(s, 1, start=6000 + i, val=91)
+    assert not any(s.contains(k) for k in a)
+
+
+def test_shared_store_unmap_owner_drops_all():
+    s = HostPageStore(_scope(), 4, budget_mb=64)
+    a = _chain(s, 2, start=0)
+    b = _chain(s, 2, start=50, val=50)
+    s.map_key(a[1], owner=7)
+    s.map_key(b[0], owner=7)
+    s.map_key(b[0], owner=8)
+    assert s.unmap_owner(7) == 2
+    assert s.stats()["mapped_keys"] == 1     # owner 8 still pins b[0]
+    assert s.unmap_owner(8) == 1
+    assert s.stats()["mapped_keys"] == 0
+    assert s.unmap_owner(7) == 0             # idempotent
+
+
+def test_shared_store_concurrent_put_get_evict_race():
+    """Two 'replica' threads hammer one store with puts/gets under a
+    budget small enough to keep eviction storming, while a third churns
+    map/unmap on a pinned chain. The shared-mode invariants must hold
+    throughout: no exceptions, the pinned chain survives every eviction
+    pass, and the byte budget stays best-effort-bounded."""
+    page_bytes = 2 * _page(0).nbytes
+    s = HostPageStore(_scope(), 4, budget_mb=1)
+    cap = (1 << 20) // page_bytes
+    pinned = _chain(s, 3, start=0)
+    s.map_key(pinned[2], owner="pin")
+    errors = []
+
+    def hammer(tid):
+        try:
+            for round_ in range(6):
+                keys = _chain(s, cap // 3, start=1000 * (tid + 1),
+                              val=10.0 * tid)
+                for k in keys[::7]:
+                    e = s.get(k)        # CRC-verified read or clean miss
+                    if e is not None:
+                        assert e.k is not None
+        except Exception as ex:   # pragma: no cover - failure reporting
+            errors.append(ex)
+
+    def churn():
+        try:
+            for _ in range(200):
+                s.map_key(pinned[1], owner="churn")
+                s.mapped_count(pinned[1])
+                s.unmap_key(pinned[1], owner="churn")
+        except Exception as ex:   # pragma: no cover - failure reporting
+            errors.append(ex)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in (0, 1)]
+    threads.append(threading.Thread(target=churn))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert all(s.contains(k) for k in pinned), "pinned chain was evicted"
+    st = s.stats()
+    assert st["mapped_keys"] == 1            # only the durable pin remains
+    # budget is best-effort: exceeded only while everything left is
+    # protected, which a 3-page pin can never cause at a 1 MB budget
+    assert s.bytes_used <= s.budget_bytes
+
+
+def test_shared_kv_pool_store_loads_and_saves_once(tmp_path, monkeypatch):
+    """N replicas share ONE persisted store: the pool loads the file
+    once (not once per replica) and persists it once at shutdown."""
+    from localai_tpu.engine import kv_offload as kvo
+    from localai_tpu.engine.pool import SharedKV
+
+    path = str(tmp_path / "pool_store.npz")
+    seed = HostPageStore(_scope(), 4, budget_mb=16)
+    keys = _chain(seed, 3)
+    assert seed.save(path)
+    calls = {"load": 0, "save": 0}
+    real_load, real_save = kvo.HostPageStore.load, kvo.HostPageStore.save
+
+    def counting_load(self, p):
+        calls["load"] += 1
+        return real_load(self, p)
+
+    def counting_save(self, p):
+        calls["save"] += 1
+        return real_save(self, p)
+
+    monkeypatch.setattr(kvo.HostPageStore, "load", counting_load)
+    monkeypatch.setattr(kvo.HostPageStore, "save", counting_save)
+    shared = SharedKV()
+    s0 = shared.host_store(_scope(), 4, 16, path)     # replica 0 asks
+    s1 = shared.host_store(_scope(), 4, 16, path)     # replica 1 asks
+    assert s0 is s1 and calls["load"] == 1
+    assert all(s0.contains(k) for k in keys)
+    extra = _chain(s0, 1, start=77, val=7)
+    assert shared.save() and calls["save"] == 1       # pool shutdown
+    fresh = HostPageStore(_scope(), 4, budget_mb=16)
+    assert fresh.load(path) == 4                      # one file, 4 pages
+    assert fresh.contains(extra[0])
 
 
 def test_device_to_host_handoff_on_evict():
