@@ -341,6 +341,27 @@ def bench_http(preset: str, prompt_len: int, max_new: int,
 
 
 
+def _kv_sweep(engine, out=None) -> dict:
+    """End-of-phase KV audit sweep (ISSUE 15): one full auditor pass on
+    the quiesced engine (or EnginePool), folded into the phase dict as
+    flat kv_audit_violations / kv_leaked_pages totals so ci.sh can gate
+    KV_AUDIT_VIOLATIONS=0 and KV_LEAKED_PAGES=0. Accumulates (+=) when
+    a phase runs several engines. Sweep failures are reported, not
+    raised — a broken auditor must not sink the bench numbers."""
+    kv = {"kv_audit_violations": 0, "kv_leaked_pages": 0}
+    try:
+        snap = engine.kv_audit_sweep()
+        kv["kv_audit_violations"] = int(snap.get("violations", 0) or 0)
+        kv["kv_leaked_pages"] = int(snap.get("leaked_pages", 0) or 0)
+    except Exception as e:
+        print(f"kv audit sweep failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    if out is not None:
+        for k, v in kv.items():
+            out[k] = int(out.get(k, 0) or 0) + v
+    return kv
+
+
 def _cold_bucket_probe(engine, ecfg) -> dict:
     """Force one compile AFTER warmup and verify the sysobs pipeline
     catches it: a packed-prefill program at a pack size precompile()'s
@@ -553,6 +574,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
             first = out.get()
     final_metrics = engine.metrics()
     kv_layout = final_metrics.get("kv_layout", "")
+    kv_sweep = _kv_sweep(engine)
     engine.shutdown()
     # cold-bucket probe (ISSUE 8 acceptance): a novel pack size — one
     # precompile() never visits — must be DETECTED as a compile storm:
@@ -592,6 +614,7 @@ def bench_serving(cfg, S, C, prompt_len, max_new, target_tokens, burst):
     out["mfu"] = gp.get("mfu")
     out["goodput_tokens"] = gp.get("goodput_tokens_total")
     out["cold_bucket"] = cold_bucket
+    out.update(kv_sweep)
     if decomp:
         d = np.asarray(decomp)
         out["ttft_decomp_p50_ms"] = {
@@ -727,6 +750,7 @@ def bench_packed_prefill(cfg, S, C, max_new=24, rounds=4):
             while first is not None:
                 first = o.get()
         m = engine.metrics()
+        _kv_sweep(engine, out)
         engine.shutdown()
         p50 = float(np.percentile(ttfts, 50) * 1e3) if ttfts else 0.0
         unl = float(np.median(unloaded) * 1e3) if unloaded else 0.0
@@ -771,6 +795,7 @@ def bench_packed_longpack(cfg, S=4, max_new=8):
 
     outs = {}
     stats = {}
+    ka = {}
     for mode in ("packed", "sequential"):
         ecfg = eng.EngineConfig(
             num_slots=S, max_context=C, prefill_buckets=(128, chunk),
@@ -807,8 +832,10 @@ def bench_packed_longpack(cfg, S=4, max_new=8):
                      "kernel_fallbacks": m["kernel_fallback"],
                      "packed_tokens": m["tokens"],
                      "wall_s": round(wall, 2)}
+        _kv_sweep(e, ka)
         e.shutdown()
     stats["greedy_match"] = outs["packed"] == outs["sequential"]
+    stats.update(ka)
     return stats
 
 
@@ -937,6 +964,7 @@ def bench_chaos(cfg, S, C, max_new=16, flood=12):
         out["lifecycle"] = m.get("lifecycle")
     finally:
         FAULTS.reset()
+        _kv_sweep(engine, out)
         engine.shutdown()
     return out
 
@@ -1046,6 +1074,7 @@ def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
         ttft_on, lows_on = wave(engine)
         sched = engine.metrics().get("scheduler") or {}
     finally:
+        _kv_sweep(engine, out)
         engine.shutdown()
     out["p50_ttft_on_ms"] = round(float(np.percentile(ttft_on, 50)) * 1e3, 2)
     out["preemptions"] = sched.get("preemptions", 0)
@@ -1062,6 +1091,7 @@ def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
     try:
         ttft_off, _ = wave(engine)
     finally:
+        _kv_sweep(engine, out)
         engine.shutdown()
     out["p50_ttft_off_ms"] = round(float(np.percentile(ttft_off, 50)) * 1e3, 2)
     out["ttft_ratio"] = round(
@@ -1097,6 +1127,7 @@ def bench_priority(cfg, S, C, low_new=64, high_new=8, n_high=4):
                      and low_ids[:k] == base[:k] and low_ids[k:] == ref)
         out["resume_byte_match"] = match
     finally:
+        _kv_sweep(engine, out)
         engine.shutdown()
     return out
 
@@ -1129,6 +1160,7 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
     for i in range(n_req):
         p = np.tile(np.roll(pat, i), plen // 8 + 1)[:plen]
         prompts.append(p.tolist())
+    ka = {}
 
     def run_wave(draft):
         ecfg = eng.EngineConfig(
@@ -1159,6 +1191,7 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
             spec = (engine.metrics().get("spec") or {})
             return ids, itls, spec
         finally:
+            _kv_sweep(engine, ka)
             engine.shutdown()
 
     ids_off, itls_off, _ = run_wave("0")
@@ -1177,6 +1210,7 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
            "mixed_dispatches": spec.get("mixed_dispatches", 0)}
     if out["itl_on_ms"] and out["itl_off_ms"]:
         out["itl_speedup"] = round(out["itl_off_ms"] / out["itl_on_ms"], 2)
+    out.update(ka)
     return out
 
 
@@ -1229,7 +1263,7 @@ def bench_replicas(cfg, S, C, max_new=48):
     ecfg = eng.EngineConfig(num_slots=1, max_context=C,
                             prefill_buckets=(32, 128), decode_burst=4,
                             kv_page_size=pg, kv_pool_pages=C // pg,
-                            cache_dtype=jnp.float32)
+                            cache_dtype=jnp.float32, kv_audit="on")
 
     def make_req(ids, n):
         return eng.GenRequest(
@@ -1420,6 +1454,7 @@ def bench_replicas(cfg, S, C, max_new=48):
                                 and m["pool"]["replicas_alive"] == 1)
     finally:
         FAULTS.reset()
+        _kv_sweep(pool, out)
         pool.shutdown()
     return out
 
@@ -1536,6 +1571,7 @@ def bench_slo(cfg, S, C, n_low=6, n_high=4, max_new=8):
                 if (evd.get("args") or {}).get("request_id") == rid0}
         out["trace_merged"] = int(len(pids) >= 2)
     finally:
+        _kv_sweep(engine, out)
         engine.shutdown()
     return out
 
@@ -1637,6 +1673,7 @@ def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
                     histories[c] = ids + toks
             m = engine.metrics()
         finally:
+            _kv_sweep(engine, out)
             engine.shutdown()
         gen_by_mode[mode] = gens
         r = {
@@ -1767,6 +1804,17 @@ def _emit_phase(name: str, payload) -> None:
               file=sys.stderr, flush=True)
 
 
+def _kv_pick(out: dict, *srcs) -> dict:
+    """Fold a subprocess phase's flat KV audit totals (ISSUE 15) into
+    the parent's whitelisted phase dict, accumulating across sources so
+    ci.sh can gate the summed KV_AUDIT_VIOLATIONS / KV_LEAKED_PAGES."""
+    for r in srcs:
+        for k in ("kv_audit_violations", "kv_leaked_pages"):
+            if (r or {}).get(k) is not None:
+                out[k] = int(out.get(k, 0) or 0) + int(r[k] or 0)
+    return out
+
+
 def _subprocess_jax_platform(deadline: float) -> str:
     """JAX_PLATFORMS value for spawned bench subprocesses: the parent's
     explicit setting if any, else "" (= let jax pick the chip) when a
@@ -1839,7 +1887,9 @@ def _engine_direct_layout_compare(deadline: float, partial: dict) -> dict:
             for ln in res.stdout.splitlines():
                 ln = ln.strip()
                 if ln.startswith("{"):
-                    out[f"{layout}_tok_s"] = json.loads(ln).get("value")
+                    r = json.loads(ln)
+                    out[f"{layout}_tok_s"] = r.get("value")
+                    _kv_pick(out, r)
             if f"{layout}_tok_s" not in out:
                 out[f"{layout}_error"] = (f"rc={res.returncode} "
                                           f"stderr={res.stderr[-200:]}")
@@ -1909,6 +1959,7 @@ def _engine_direct_packed(deadline: float, partial: dict) -> dict:
                        "longpack_fallbacks": lp.get("kernel_fallbacks"),
                        "longpack_max_bucket": lp.get("max_pack_bucket"),
                        "longpack_match": lp.get("greedy_match")}
+                _kv_pick(out, r, lp)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -1967,6 +2018,7 @@ def _engine_direct_chaos(deadline: float, partial: dict) -> dict:
                        "stall_dump": r.get("stall_dump"),
                        "recovered": r.get("recovered"),
                        "survivors_identical": r.get("survivors_identical")}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2022,6 +2074,7 @@ def _engine_direct_priority(deadline: float, partial: dict) -> dict:
                        "resumes": r.get("resumes"),
                        "low_complete": r.get("low_complete"),
                        "resume_byte_match": r.get("resume_byte_match")}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2080,6 +2133,7 @@ def _engine_direct_slo(deadline: float, partial: dict) -> dict:
                        "flight_dumps": r.get("flight_dumps"),
                        "flight_dump_low": r.get("flight_dump_low"),
                        "trace_merged": r.get("trace_merged")}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2138,6 +2192,7 @@ def _engine_direct_spec(deadline: float, partial: dict) -> dict:
                        "rounds": r.get("rounds"),
                        "dispatches": r.get("dispatches"),
                        "mixed_dispatches": r.get("mixed_dispatches")}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2201,6 +2256,7 @@ def _engine_direct_replicas(deadline: float, partial: dict) -> dict:
                        "crash_byte_match": r.get("crash_byte_match"),
                        "replicas_alive_after": r.get("replicas_alive_after"),
                        "recovered": r.get("recovered")}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2254,6 +2310,7 @@ def _engine_direct_multiturn(deadline: float, partial: dict) -> dict:
                            "p50_ttft_warm_ms", 0.0), 1),
                        "warm_ms_off": round(r.get("cache_off", {}).get(
                            "p50_ttft_warm_ms", 0.0), 1)}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2308,6 +2365,7 @@ def _engine_direct_offload(deadline: float, partial: dict) -> dict:
                            "p50_ttft_warm_ms", 0.0), 1),
                        "warm_ms_off": round(r.get("offload_off", {}).get(
                            "p50_ttft_warm_ms", 0.0), 1)}
+                _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2376,6 +2434,7 @@ def _engine_direct_decomp(deadline: float, partial: dict,
                         "mfu": r.get("mfu"),
                         "cold_bucket": r.get("cold_bucket"),
                     }
+                    _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
                              f"stderr={res.stderr[-200:]}")}
@@ -2648,6 +2707,9 @@ def main():
             "peak_pool_pages": r.get("peak_pool_pages"),
             "mfu": r.get("mfu"),
             "cold_bucket": r.get("cold_bucket"),
+            # end-of-phase KV audit sweep (ISSUE 15): both must be 0
+            "kv_audit_violations": r.get("kv_audit_violations"),
+            "kv_leaked_pages": r.get("kv_leaked_pages"),
         }))
         return
 
@@ -2735,6 +2797,13 @@ def main():
             "replica_affinity_hits": replicas.get("affinity_hits"),
             "migrate_byte_match": replicas.get("migrate_byte_match"),
             "replica_recovered": replicas.get("recovered"),
+            # KV lifecycle auditor (ISSUE 15, scripts/ci.sh
+            # KV_AUDIT_VIOLATIONS/KV_LEAKED_PAGES line): every phase
+            # above ends with a full audit sweep; the summed totals
+            # across all of them must be 0/0
+            **_kv_pick({"kv_audit_violations": 0, "kv_leaked_pages": 0},
+                       layout_cmp, packed, multiturn, offload, decomp,
+                       decomp_off, slo, spec, replicas),
         }))
         sys.exit(0 if ok else 1)
 

@@ -58,6 +58,7 @@ def register(app: web.Application):
     # merged structured event log
     r.add_get("/debug/state", debug_state)
     r.add_get("/debug/events", debug_events)
+    r.add_get("/debug/kv", debug_kv)
     # gallery (reference: routes/localai.go:14-44)
     r.add_post("/models/apply", models_apply)
     r.add_post("/models/delete/{name}", models_delete)
@@ -210,6 +211,10 @@ _SLO_WINDOWS = (("burn_5m", "5m"), ("burn_1h", "1h"))
 _SPEC_COUNTERS = (("rounds", "spec_rounds_total"),
                   ("proposed", "spec_proposed_total"),
                   ("accepted", "spec_accepted_total"))
+# KV lifecycle auditor (ISSUE 15): scan/violation/leak/ledger totals,
+# from engine metrics()["kv_audit"] (pool-aggregated for engines>1)
+_KV_AUDIT_COUNTERS = ("checks", "violations", "leaked_pages",
+                      "ledger_events")
 
 
 def _refresh_engine_metrics(state):
@@ -241,6 +246,7 @@ def _refresh_engine_metrics(state):
               "flight_dumps_suppressed_total",
               *(m for _k, m in _SPEC_COUNTERS),
               "spec_acceptance_rate",
+              *(f"kv_audit_{k}_total" for k in _KV_AUDIT_COUNTERS),
               "engine_replicas", "replica_queue_depth",
               "replica_slots_in_flight", "replica_migrations_total",
               "pool_affinity_hits_total", "pool_affinity_misses_total",
@@ -463,6 +469,11 @@ def _refresh_engine_metrics(state):
             for skey, mkey in _OFFLOAD_COUNTERS:
                 METRICS.set_counter(f"kv_offload_{mkey}_total",
                                     off.get(skey, 0), label_str(model=name))
+        ka = stats.get("kv_audit")
+        if ka:
+            for key in _KV_AUDIT_COUNTERS:
+                METRICS.set_counter(f"kv_audit_{key}_total",
+                                    ka.get(key, 0), label_str(model=name))
 
 
 async def metrics(request):
@@ -619,6 +630,20 @@ async def debug_events(request):
         return api_error("last must be an integer", 400)
     events = await state.run_blocking(_collect_events, state, last)
     return web.json_response({"events": events, "count": len(events)})
+
+
+async def debug_kv(request):
+    """KV lifecycle view per loaded model (ISSUE 15): tier map,
+    per-chain genealogy, fragmentation layout, audit counters + last
+    violations and the ledger tail. Rides the "kv" key of each
+    backend's GetState; models with kv_audit=off (or no pages) answer
+    the {"mode": "off"} shape, and an EnginePool answers the merged
+    multi-replica view."""
+    state = get_state(request)
+    payloads = await state.run_blocking(_backend_state_payloads, state)
+    return web.json_response(
+        {"models": {name: p.get("kv") or {"mode": "off"}
+                    for name, p in payloads.items()}})
 
 
 async def debug_profile(request):

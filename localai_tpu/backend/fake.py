@@ -159,6 +159,51 @@ class FakeServicer(BackendServicer):
     def GetMetrics(self, request, context):
         return pb.MetricsResponse(slots_total=1, slots_active=0)
 
+    def _kv_payload(self) -> dict:
+        """The GetState "kv" key (ISSUE 15): honors the model's
+        kv_audit= option ({"mode": "off"} shape) and answers the
+        EnginePool merged multi-replica view when engines=N>1 was
+        requested — shape mirrors engine.kv_debug()/pool.kv_debug()."""
+        opts = {}
+        raw = self.loaded.options if self.loaded is not None else ""
+        for s in str(raw).split(","):    # proto options is one k=v,... string
+            if "=" in s:
+                k, v = s.split("=", 1)
+                opts[k.strip()] = v.strip()
+        mode = opts.get("kv_audit", "on")
+        if mode == "off":
+            return {"mode": "off", "replica": 0}
+
+        def replica(i: int) -> dict:
+            return {
+                "mode": mode, "replica": i,
+                "pool": {"pages_total": 8, "page_size": 16, "free": 7,
+                         "active": 1, "retained": 0, "shared": 0,
+                         "oversubscription": 0.0,
+                         "fragmentation": {"holes": 0, "ratio": 0.0},
+                         "pages_per_slot": [1]},
+                "chains": [{"key": "00" * 8, "parent": "00" * 8,
+                            "page": 0, "depth": 0, "tick": 1}],
+                "audit": {"mode": mode, "checks": 1, "violations": 0,
+                          "leaked_pages": 0, "ledger_events": 1,
+                          "ledger": {"events_total": 1, "live_pages": 1,
+                                     "live_holds": 0,
+                                     "counts": {"alloc": 1}},
+                          "last_violations": []},
+                "ledger_tail": [{"seq": 1, "op": "alloc", "page": 0,
+                                 "slot": "0", "key": "", "rid": ""}],
+                "host": {"pages": 0, "bytes": 0},
+            }
+
+        n = int(opts.get("engines", "1") or 1)
+        if n > 1:
+            return {"engine_replicas": n,
+                    "replicas": [replica(i) for i in range(n)],
+                    "pool_index_keys": 0,
+                    "shared_host": {"pages": 0, "bytes": 0,
+                                    "mapped_keys": 0}}
+        return replica(0)
+
     def GetState(self, request, context):
         # minimal engine-state + event-ring snapshot (the /debug/state
         # and /debug/events merge paths need a backend that answers;
@@ -178,6 +223,7 @@ class FakeServicer(BackendServicer):
                       "weight_bytes": 0},
             "events": [{"ts": time.time(), "event": "admit", "seq": 1,
                         "rid": "fake0000"}],
+            "kv": self._kv_payload(),
         }).encode("utf-8"))
 
     def GetTrace(self, request, context):

@@ -328,6 +328,11 @@ class EngineServicer(BackendServicer):
                 extra.get("kv_host_pool_mb", 0) or 0)) > 0 else {}),
             **({"kv_host_store_path": hsp} if (hsp := self._host_store_path(
                 extra, request)) else {}),
+            # KV lifecycle auditor (ISSUE 15): off = zero-cost no-op,
+            # on = report-only scans (default), strict = raise
+            **({"kv_audit": ka} if (ka := str(
+                extra.get("kv_audit", "") or "")) in
+               ("off", "on", "strict") else {}),
             # ragged packed prefill (this PR): prefill_packed=0 opts
             # back into the per-slot bucketed path bit-for-bit;
             # prefill_token_budget caps packed prompt tokens per
@@ -739,6 +744,9 @@ class EngineServicer(BackendServicer):
             payload = json.dumps({
                 "state": self.engine.state_snapshot(),
                 "events": EVENTS.events(),
+                # KV lifecycle view (ISSUE 15): tier map + genealogy +
+                # ledger tail for the core's /debug/kv endpoint
+                "kv": self.engine.kv_debug(),
             }, default=str)
         except Exception as e:
             context.abort(grpc.StatusCode.INTERNAL,

@@ -303,6 +303,9 @@ class ModelConfig:
             elif k == "comm_overlap" and v not in ("auto", "0", "1"):
                 problems.append(
                     f"comm_overlap must be auto|0|1, got {v!r}")
+            elif k == "kv_audit" and v not in ("off", "on", "strict"):
+                problems.append(
+                    f"kv_audit must be off|on|strict, got {v!r}")
             elif k == "draft" and v.lower() not in (
                     "auto", "model", "ngram", "0", "off", "none", "false"):
                 problems.append(

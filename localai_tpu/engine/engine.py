@@ -300,6 +300,13 @@ class EngineConfig:
     # fraction in window) / budget, so burn > 1 means the class misses
     # its SLO if the rate holds. 0.01 = a 99% objective.
     slo_error_budget: float = 0.01
+    # --- KV lifecycle ledger + invariant auditor (ISSUE 15) ---
+    # "off" = zero-cost no-op (no auditor object, no ledger, every hook
+    # dissolves into one `is not None` check — like trace=0); "on" =
+    # continuous report-only scans on the housekeeping cadence (default:
+    # counters + kv_audit_violation events + flight dumps); "strict" =
+    # violations raise KVAuditError, for tests and chaos rigs.
+    kv_audit: str = "on"
 
 
 @dataclasses.dataclass
@@ -1020,6 +1027,30 @@ class Engine:
         # last device allocator sample (bytes_in_use/peak/limit); {} on
         # backends without memory_stats() (CPU) — see _sample_watermarks
         self._device_mem: dict = {}
+        # --- KV lifecycle ledger + online invariant auditor (ISSUE 15)
+        # kv_audit=off (or a non-paged layout) constructs NOTHING: every
+        # hook in paging/prefix_cache/kv_offload gates on a single
+        # `audit is not None`, so the off path is the pre-PR hot path.
+        self._kv_audit = None
+        if self._paged and self.ecfg.kv_audit != "off":
+            from localai_tpu.services.kv_audit import KVAuditor
+
+            aud = KVAuditor(mode=self.ecfg.kv_audit,
+                            replica=self.replica_id,
+                            seed=self.replica_id)
+            aud.on_violation = self._on_kv_violation
+            self._pool.audit = aud
+            if self._pcache is not None:
+                self._pcache.audit = aud
+            if self._hstore is not None and (self._hstore_owned
+                                             or self._hstore.audit is None):
+                # owned store: this replica's ledger records its tier
+                # transitions and its housekeeping scans it. Shared store
+                # (pool mode): the first replica's ledger takes the
+                # store-level records; the POOL housekeeping scans it so
+                # shared violations are counted once, not per replica.
+                self._hstore.audit = aud
+            self._kv_audit = aud
 
     def _sync_worker(self):
         """ALL device->host syncs run here, one at a time, in dispatch
@@ -1121,6 +1152,82 @@ class Engine:
             __import__("logging").getLogger(__name__).exception(
                 "flight dump failed")
             return ""
+
+    def _on_kv_violation(self, v: dict):
+        """KVAuditor callback (ISSUE 15): one structured event per
+        violation + a flight dump with the ledger tail attached, so the
+        last ~64 page transitions that led to the broken invariant are
+        on disk next to the trace/state evidence. Rate limiting lives in
+        the recorder; this must never raise into the audit pass."""
+        try:
+            EVENTS.emit("kv_audit_violation",
+                        **{k: (x if isinstance(x, (str, int, float))
+                               else str(x)) for k, x in v.items()})
+            self._flight_dump("kv_audit:" + str(v.get("check", "?")),
+                              tag="kv_audit", kv_violation=v,
+                              kv_ledger_tail=(
+                                  self._kv_audit.ledger.tail(64)
+                                  if self._kv_audit is not None else []))
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def _kv_audit_tick(self, drained: bool = False) -> list:
+        """One online audit pass (ISSUE 15), riding the engine-loop
+        housekeeping cadence so the pool's host mirrors are never
+        mid-mutation. Detached pages never survive a tick boundary
+        (alloc_detached/unref_detached pair within single calls on this
+        thread), so no extras need declaring. Strict mode lets the
+        KVAuditError propagate — in the live loop that lands in the
+        generic step-failure recovery, in tests it fails the test."""
+        aud = self._kv_audit
+        if aud is None:
+            return []
+        return aud.run(
+            self._pool, pcache=self._pcache,
+            hstore=self._hstore if self._hstore_owned else None,
+            drained=drained)
+
+    def kv_audit_sweep(self, drained: bool = False) -> dict:
+        """On-demand full audit pass + snapshot (bench phase ends, CI
+        gates, tests). The caller must be quiesced — nothing in flight —
+        since the scan reads the host mirrors without the engine loop's
+        serialization."""
+        if self._kv_audit is None:
+            return {"mode": "off", "checks": 0, "violations": 0,
+                    "leaked_pages": 0, "ledger_events": 0}
+        self._kv_audit_tick(drained=drained)
+        return self._kv_audit.snapshot()
+
+    def kv_debug(self) -> dict:
+        """/debug/kv payload (ISSUE 15): tier map, per-chain genealogy,
+        fragmentation layout, audit counters + last violations, and the
+        ledger tail. ``{"mode": "off"}`` shape when auditing is off or
+        the layout has no pages."""
+        if self._kv_audit is None:
+            return {"mode": "off", "replica": self.replica_id}
+        pool = self._pool
+        out = {
+            "mode": self._kv_audit.mode,
+            "replica": self.replica_id,
+            "pool": {
+                "pages_total": pool.num_pages,
+                "page_size": pool.page_size,
+                "free": pool.free_pages,
+                "active": pool.active_pages,
+                "retained": pool.retained_pages,
+                "shared": int((pool.refs > 1).sum()),
+                "oversubscription": round(pool.oversubscription, 4),
+                "fragmentation": pool.fragmentation(),
+                "pages_per_slot": [int(n) for n in pool.owned],
+            },
+            "chains": (self._pcache.genealogy(64)
+                       if self._pcache is not None else []),
+            "audit": self._kv_audit.snapshot(),
+            "ledger_tail": self._kv_audit.ledger.tail(64),
+        }
+        if self._hstore is not None:
+            out["host"] = self._hstore.stats()
+        return out
 
     def _slo_finish(self, s, ndec: int, t_done: float, ttft_ms: float,
                     queue_wait_ms: float):
@@ -2393,6 +2500,30 @@ class Engine:
             # once (ISSUE 14), not once per replica.
             self._sync_thread.join(timeout=30)
             self._hstore.save(self.ecfg.kv_host_store_path)
+        if (self._kv_audit is not None and self.num_active == 0
+                and self._queue.qsize() == 0):
+            # post-drain leak freedom (ISSUE 15): a drained engine must
+            # balance to zero — evict the retention tier (dropping its
+            # holds), then prove all pages free, all holds gone, and the
+            # ledger agreeing. Only meaningful when nothing was cut off
+            # mid-flight; strict mode raises out of shutdown by design.
+            from localai_tpu.services.kv_audit import KVAuditError
+
+            try:
+                for i, s in enumerate(self.slots):
+                    if s is None and self._pool.owned[i]:
+                        # freed-slot prefix retention is legal live state;
+                        # drop it so the drained pool balances to zero
+                        self._pool.release(i, 0)
+                        self._cache_tokens[i] = []
+                if self._pcache is not None:
+                    self._pcache.evict(self._pool, self._pool.num_pages)
+                self._kv_audit_tick(drained=True)
+            except KVAuditError:
+                raise
+            except Exception:
+                __import__("logging").getLogger(__name__).exception(
+                    "post-drain kv audit failed")
         if self._bus is not None:
             self._bus.close()
         if self._trace and self._tstats:
@@ -2445,6 +2576,12 @@ class Engine:
                 # HOST tier survives — its numpy copies don't reference
                 # the dead pool, so offloaded chains stay restorable.
                 self._pcache.clear()
+            if self._kv_audit is not None:
+                # rebind the fresh pool and zero the ledger's running
+                # balances — the reset is itself a ledger event (ISSUE
+                # 15); totals and the ring survive for post-mortems
+                self._pool.audit = self._kv_audit
+                self._kv_audit.ledger.rebase()
         self.ck, self.cv = self.family.init_cache(
             self.cfg, S, self.ecfg.max_context, self.ecfg.cache_dtype,
             **({"page_size": self._pool.page_size,
@@ -2629,6 +2766,10 @@ class Engine:
                 # host tier: state=offloaded pool gauge + transfer totals
                 out["kv_pages_offloaded"] = self._hstore.pages
                 out["kv_offload"] = self._hstore.stats()
+            if self._kv_audit is not None:
+                # lifecycle auditor (ISSUE 15): checks/violations/leaked
+                # pages/ledger events -> localai_kv_audit_*_total
+                out["kv_audit"] = self._kv_audit.snapshot()
         else:
             out["kv_layout"] = "contiguous"
         with self._decomp_lock:
@@ -3045,6 +3186,12 @@ class Engine:
                     # pool peaks between /metrics scrapes are not lost
                     t_wm = t0
                     self._sample_watermarks()
+                    if self._kv_audit is not None:
+                        # online KV invariant audit (ISSUE 15): same
+                        # cadence, same thread — the mirrors are between
+                        # ticks, so the O(num_pages) scans see a
+                        # consistent pool
+                        self._kv_audit_tick()
                 # emitter-detected stop finishes land as notes (ISSUE 9);
                 # apply before admission so the freed slots are admittable
                 # this very tick

@@ -200,6 +200,10 @@ class HostPageStore:
         self.evicted_pages = 0   # host -> gone (budget eviction)
         self.corrupt_dropped = 0  # CRC mismatch at get(): tree dropped
         self.evict_blocked = 0   # budget evictions skipped: key mapped
+        # lifecycle ledger/auditor (ISSUE 15): attached by the owning
+        # engine (owned store) or the EnginePool's SharedKV (shared
+        # store); None = zero-cost no-op
+        self.audit = None
 
     # ---------- introspection ----------
 
@@ -316,6 +320,8 @@ class HostPageStore:
             self._bytes += e.nbytes
             self.offloaded_pages += 1
             self.offloaded_bytes += e.nbytes
+            if self.audit is not None:
+                self.audit.ledger.record("offload", key=key)
             self._evict_to_budget_locked()
             return True
 
@@ -371,6 +377,8 @@ class HostPageStore:
             self.restores += 1
             self.hits += 1
             self.restored_pages += int(n_pages)
+            if self.audit is not None:
+                self.audit.ledger.record("restore")
 
     def note_miss(self):
         with self._lock:
@@ -413,8 +421,91 @@ class HostPageStore:
                     del self._children[e.parent]
             self._bytes -= e.nbytes
             self.evicted_pages += 1
+            if self.audit is not None:
+                self.audit.ledger.record("host_evict", key=k)
             n += 1
         return n
+
+    def audit_scan(self, sample_crc: int = 4, rng=None) -> list:
+        """Invariant scan for the KV auditor (ISSUE 15). Families:
+
+        * host_bytes — the running ``_bytes`` total matches the summed
+          entry sizes, and each entry's recorded nbytes matches its
+          plane shapes (no double counting across tiers: an entry is
+          counted once, at its recorded size, device residency never
+          touches ``_bytes``).
+        * host_children — the parent->children map and the entries'
+          parent links agree in both directions (a broken cascade would
+          strand unreachable entries against the byte budget). Absent
+          parents are legal: offload can land a child whose parent was
+          evicted, and load() replays entries without requiring them.
+        * host_crc — recompute the stored CRC of up to ``sample_crc``
+          randomly sampled entries (bit-rot in retained host pages).
+          Sibling-mapped chains are preferred in the sample since their
+          corruption is the cross-replica hazard; eviction of a mapped
+          chain itself is prevented structurally at the budget seam
+          (``_protected_keys_locked``) and shows up here as a dangling
+          map only while an offload is legitimately in flight, so it is
+          not a hard violation.
+
+        Dict violations ``{"check", "detail"}``; empty list = clean."""
+        out = []
+        with self._lock:
+            total = 0
+            for key, e in self._entries.items():
+                nb = _leaf_bytes(e.k) + _leaf_bytes(e.v)
+                if e.dk is not None:
+                    nb += _leaf_bytes(e.dk) + _leaf_bytes(e.dv)
+                if nb != e.nbytes:
+                    out.append({"check": "host_bytes",
+                                "detail": f"entry {key[:8].hex()} nbytes "
+                                          f"{e.nbytes} != plane sum {nb}"})
+                total += e.nbytes
+                kids = self._children.get(e.parent)
+                if kids is None or key not in kids:
+                    out.append({"check": "host_children",
+                                "detail": f"entry {key[:8].hex()} missing "
+                                          f"from parent "
+                                          f"{e.parent[:8].hex()} kid set"})
+            if total != self._bytes:
+                out.append({"check": "host_bytes",
+                            "detail": f"byte accounting drift: running "
+                                      f"{self._bytes} != summed {total} "
+                                      f"over {len(self._entries)} entries"})
+            for parent, kids in self._children.items():
+                for c in kids:
+                    e = self._entries.get(c)
+                    if e is None:
+                        out.append({"check": "host_children",
+                                    "detail": f"kid set of "
+                                              f"{parent[:8].hex()} names "
+                                              f"absent entry "
+                                              f"{c[:8].hex()}"})
+                    elif e.parent != parent:
+                        out.append({"check": "host_children",
+                                    "detail": f"entry {c[:8].hex()} parent "
+                                              f"link disagrees with kid "
+                                              f"set of {parent[:8].hex()}"})
+            ns = min(int(sample_crc), len(self._entries))
+            if ns > 0:
+                keys = [k for k in self._mapped if k in self._entries]
+                rest = [k for k in self._entries if k not in self._mapped]
+                if rng is not None and len(rest) > ns:
+                    idx = rng.choice(len(rest), size=ns, replace=False)
+                    rest = [rest[int(i)] for i in idx]
+                for key in (keys + rest)[:ns]:
+                    e = self._entries[key]
+                    if _page_crc(e.k, e.v) != e.crc:
+                        out.append({"check": "host_crc",
+                                    "detail": f"retained entry "
+                                              f"{key[:8].hex()} failed CRC "
+                                              f"spot-check"})
+                    elif e.dk is not None and _page_crc(e.dk, e.dv) != e.dcrc:
+                        out.append({"check": "host_crc",
+                                    "detail": f"draft planes of "
+                                              f"{key[:8].hex()} failed CRC "
+                                              f"spot-check"})
+        return out
 
     def clear(self):
         with self._lock:

@@ -54,6 +54,8 @@ from collections import deque
 
 import numpy as np
 
+from localai_tpu.services.kv_audit import KVLifecycleError
+
 
 class PoolExhausted(RuntimeError):
     """No free page; the engine reclaims retained prefixes and retries."""
@@ -81,6 +83,21 @@ class PagePool:
         self.owned = np.zeros((num_slots,), np.int32)  # table entries in use
         self._free = deque(range(self.num_pages))
         self.dirty = True      # device table snapshot is stale
+        # lifecycle ledger/auditor (ISSUE 15): a services.kv_audit
+        # KVAuditor, attached by the engine when kv_audit != off. Every
+        # hook below gates on one `is not None` check so the off mode
+        # stays a zero-cost no-op on the hot path.
+        self.audit = None
+
+    def _fail(self, op: str, detail: str, page: int = -1, slot=None):
+        """Structured lifecycle error (ISSUE 15 satellite): reports
+        through the attached auditor, then raises — unconditionally, so
+        the rule survives ``python -O`` (the bare asserts it replaces
+        did not)."""
+        err = KVLifecycleError(op, detail, page=page, slot=slot)
+        if self.audit is not None:
+            self.audit.lifecycle_violation(err)
+        raise err
 
     # ---------- accounting ----------
 
@@ -153,6 +170,8 @@ class PagePool:
                 f"{self.page_size} rows)")
         p = self._free.popleft()
         self.refs[p] = 1
+        if self.audit is not None:
+            self.audit.ledger.record("alloc", page=p)
         return p
 
     def alloc_detached(self) -> int:
@@ -172,9 +191,13 @@ class PagePool:
         return out
 
     def unref_detached(self, page: int):
+        if self.refs[page] <= 0:
+            self._fail("free", "unref of an already-free page", page=page)
         self.refs[page] -= 1
         if self.refs[page] == 0:
             self._free.append(page)
+            if self.audit is not None:
+                self.audit.ledger.record("free", page=page)
 
     def ensure(self, slot: int, rows: int) -> bool:
         """Allocate pages so the slot can hold ``rows`` logical rows
@@ -192,6 +215,8 @@ class PagePool:
     def release(self, slot: int, keep_rows: int = 0):
         """Drop the slot's pages beyond those covering keep_rows."""
         keep = min(self.pages_for(keep_rows), self.max_pages)
+        if self.audit is not None and self.owned[slot] > keep:
+            self.audit.ledger.record("release", slot=slot)
         while self.owned[slot] > keep:
             self.owned[slot] -= 1
             i = int(self.owned[slot])
@@ -206,7 +231,9 @@ class PagePool:
         rows[0:rows]; refcounts bump, nothing is copied. dst must own no
         pages. Returns the rows actually shared (a page multiple)."""
         n = min(int(rows) // self.page_size, int(self.owned[src]))
-        assert self.owned[dst] == 0, "share() into a non-empty slot"
+        if self.owned[dst] != 0:
+            self._fail("share", "share() into a non-empty slot",
+                       slot=(src, dst))
         for i in range(n):
             p = int(self.ptab[src, i])
             self.ptab[dst, i] = p
@@ -214,6 +241,9 @@ class PagePool:
         self.owned[dst] = n
         if n:
             self.dirty = True
+            if self.audit is not None:
+                self.audit.ledger.record(
+                    "share", page=int(self.ptab[src, 0]), slot=(src, dst))
         return n * self.page_size
 
     def hold(self, page: int):
@@ -221,14 +251,20 @@ class PagePool:
         rows) alive after every slot table lets go. Must only be placed
         on a page that is currently referenced (refs > 0) — a free page
         has no content worth retaining."""
-        assert self.refs[page] > 0, "hold() on an unreferenced page"
+        if self.refs[page] <= 0:
+            self._fail("hold", "hold() on an unreferenced page", page=page)
         self.refs[page] += 1
         self.held[page] += 1
+        if self.audit is not None:
+            self.audit.ledger.record("hold", page=page)
 
     def drop(self, page: int):
         """Release a hold() reference (cache eviction / entry dedup)."""
-        assert self.held[page] > 0, "drop() without a matching hold()"
+        if self.held[page] <= 0:
+            self._fail("drop", "drop() without a matching hold()", page=page)
         self.held[page] -= 1
+        if self.audit is not None:
+            self.audit.ledger.record("drop", page=page)
         self.unref_detached(page)
 
     def splice(self, dst: int, pages) -> int:
@@ -236,26 +272,39 @@ class PagePool:
         (the prefix cache's chain match) and bump refcounts — share()'s
         sibling for pages whose owning slot no longer exists. dst must
         own no pages. Returns the rows spliced (a page multiple)."""
-        assert self.owned[dst] == 0, "splice() into a non-empty slot"
+        if self.owned[dst] != 0:
+            self._fail("splice", "splice() into a non-empty slot", slot=dst)
         n = min(len(pages), self.max_pages)
         for i in range(n):
             p = int(pages[i])
-            assert self.refs[p] > 0, "splice() of a freed page"
+            if self.refs[p] <= 0:
+                self._fail("splice", "splice() of a freed page",
+                           page=p, slot=dst)
             self.ptab[dst, i] = p
             self.refs[p] += 1
         self.owned[dst] = n
         if n:
             self.dirty = True
+            if self.audit is not None:
+                self.audit.ledger.record("splice", page=int(pages[0]),
+                                         slot=dst)
         return n * self.page_size
 
     def adopt(self, slot: int, page: int):
         """Append a detached (freshly cloned) page to the slot's table —
         the commit half of a boundary-page clone."""
         i = int(self.owned[slot])
-        assert i < self.max_pages
+        if i >= self.max_pages:
+            self._fail("adopt", "adopt() into a full table",
+                       page=page, slot=slot)
+        if self.refs[page] <= 0:
+            self._fail("adopt", "adopt() of a freed page",
+                       page=page, slot=slot)
         self.ptab[slot, i] = page
         self.owned[slot] = i + 1
         self.dirty = True
+        if self.audit is not None:
+            self.audit.ledger.record("adopt", page=page, slot=slot)
 
     def cow_page(self, slot: int, row: int) -> int:
         """Table index of the page containing ``row`` IF the slot owns it
@@ -270,5 +319,7 @@ class PagePool:
         """Swap a (cloned) page into the slot's table (COW commit)."""
         old = int(self.ptab[slot, page_idx])
         self.ptab[slot, page_idx] = new_page
+        if self.audit is not None:
+            self.audit.ledger.record("clone", page=new_page, slot=slot)
         self.unref_detached(old)
         self.dirty = True

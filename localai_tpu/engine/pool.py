@@ -163,6 +163,10 @@ class EnginePool:
             self._weights = (4, 2, 1)
         self._hk_stop = threading.Event()
         self._hk_thread: Optional[threading.Thread] = None
+        # shared-store KV audit fold (ISSUE 15): the POOL scans the one
+        # shared host tier on the housekeeping cadence — replicas only
+        # scan stores they own, so shared violations count once
+        self._t_kv_audit = time.monotonic()
 
     # ---------- construction ----------
 
@@ -403,6 +407,9 @@ class EnginePool:
                 self._engines[src].adopt_resume(entry)
                 return False
             self._note_where(request_id, target)
+        aud = self._engines[target]._kv_audit
+        if aud is not None:
+            aud.ledger.record("migrate", slot=(src, target), rid=request_id)
         self._migrations[reason] = self._migrations.get(reason, 0) + 1
         EVENTS.emit("migrate", rid=request_id, src=src, dst=target,
                     reason=reason, kind=kind,
@@ -432,6 +439,9 @@ class EnginePool:
             self._pin(rid, keys)
         if not tgt.adopt_resume(entry):
             return False
+        if tgt._kv_audit is not None:
+            tgt._kv_audit.ledger.record("adopt", slot=(src, target),
+                                        rid=rid)
         self._note_where(rid, target)
         self._migrations[reason] = self._migrations.get(reason, 0) + 1
         EVENTS.emit("migrate", rid=rid, src=src, dst=target,
@@ -529,8 +539,24 @@ class EnginePool:
                     if not e.loop_alive and not e._stop:
                         self._recover_replica(i)
                 self._rebalance_queued()
+                t0 = time.monotonic()
+                if t0 - self._t_kv_audit > 0.5:
+                    self._t_kv_audit = t0
+                    self._audit_shared()
             except Exception:
                 log.exception("engine pool housekeeping failed")
+
+    def _audit_shared(self):
+        """Invariant scan of the SHARED host tier (ISSUE 15): byte
+        accounting vs summed entry sizes, parent/child map consistency,
+        sampled CRC of retained entries. Reports through the auditor the
+        first replica attached to the store (its ledger already records
+        the store-level transitions), so counters, events and flight
+        dumps ride the same path as device-tier violations."""
+        store = self._shared.store
+        aud = store.audit if store is not None else None
+        if aud is not None:
+            aud.scan_shared(store)
 
     def _rebalance_queued(self):
         """When one replica has work QUEUED behind full slots while a
@@ -596,6 +622,58 @@ class EnginePool:
             "migrations": dict(self._migrations),
             "index_keys": len(self._shared.index),
         }
+        # lifecycle auditor (ISSUE 15): counters summed pool-wide (the
+        # shared-store scans report through the attached auditor, so
+        # they're inside one replica's snapshot already)
+        kas = [m.get("kv_audit") for m in ms if m.get("kv_audit")]
+        if kas:
+            out["kv_audit"] = {
+                "mode": kas[0].get("mode", "on"),
+                "checks": sum(k.get("checks", 0) for k in kas),
+                "violations": sum(k.get("violations", 0) for k in kas),
+                "leaked_pages": sum(k.get("leaked_pages", 0) for k in kas),
+                "ledger_events": sum(k.get("ledger_events", 0)
+                                     for k in kas),
+                "last_violations": [v for k in kas
+                                    for v in k.get("last_violations",
+                                                   [])][-16:],
+            }
+        return out
+
+    def kv_audit_sweep(self, drained: bool = False) -> dict:
+        """Pool-wide on-demand audit: shared host tier first (counters
+        land on the attached replica's auditor), then every LIVE
+        replica's full pass. Dead replicas are skipped — their device
+        mirrors froze wherever the crash left them and their pages were
+        recovered onto siblings, which the siblings' scans cover."""
+        store = self._shared.store
+        aud = store.audit if store is not None else None
+        if aud is not None:
+            aud.scan_shared(store)
+        out = {"mode": "off", "checks": 0, "violations": 0,
+               "leaked_pages": 0, "ledger_events": 0}
+        for i, e in enumerate(self._engines):
+            if self._dead[i]:
+                continue
+            snap = e.kv_audit_sweep(drained=drained)
+            if snap.get("mode") != "off":
+                out["mode"] = snap["mode"]
+                for k in ("checks", "violations", "leaked_pages",
+                          "ledger_events"):
+                    out[k] += snap.get(k, 0)
+        return out
+
+    def kv_debug(self) -> dict:
+        """/debug/kv merged view across replicas + the shared host tier
+        (ISSUE 15)."""
+        out = {
+            "engine_replicas": len(self._engines),
+            "replicas": [e.kv_debug() for e in self._engines],
+            "pool_index_keys": len(self._shared.index),
+        }
+        store = self._shared.store
+        if store is not None:
+            out["shared_host"] = store.stats()
         return out
 
     def state_snapshot(self) -> dict:

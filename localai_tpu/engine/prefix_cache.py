@@ -52,6 +52,7 @@ import threading
 from typing import Iterable, Optional
 
 from localai_tpu.ops import kvcache
+from localai_tpu.services.faults import FAULTS
 
 
 class _Entry:
@@ -86,6 +87,9 @@ class PrefixPageCache:
         self.hit_rows = 0        # prompt rows reused via the store
         self.inserted_pages = 0
         self.evicted_pages = 0
+        # lifecycle ledger/auditor (ISSUE 15): attached by the engine
+        # when kv_audit != off; None = zero-cost no-op
+        self.audit = None
 
     # ---------- introspection ----------
 
@@ -105,6 +109,21 @@ class PrefixPageCache:
             "inserted_pages": self.inserted_pages,
             "evicted_pages": self.evicted_pages,
         }
+
+    def pages(self) -> list:
+        """Physical pages currently held (one per entry) — the
+        auditor's leak-freedom scan counts these as accounted-for."""
+        return [e.page for e in self._entries.values()]
+
+    def genealogy(self, limit: int = 64) -> list:
+        """Per-chain genealogy for /debug/kv (ISSUE 15): the newest
+        ``limit`` entries as {key, parent, page, depth, tick}, keys
+        abbreviated to 8 bytes hex."""
+        items = sorted(self._entries.values(),
+                       key=lambda e: (e.tick, e.depth))[-int(limit):]
+        return [{"key": e.key[:8].hex(), "parent": e.parent[:8].hex(),
+                 "page": e.page, "depth": e.depth, "tick": e.tick}
+                for e in items]
 
     # ---------- the hash chain ----------
 
@@ -146,6 +165,9 @@ class PrefixPageCache:
             self._children.setdefault(parent, set()).add(key)
             if self._on_insert is not None:
                 self._on_insert(key, i)
+            if self.audit is not None:
+                self.audit.ledger.record("retain", page=page, slot=slot,
+                                         key=key)
             added += 1
             parent = key
         self.inserted_pages += added
@@ -211,7 +233,14 @@ class PrefixPageCache:
                 on_evict(e)
             if self._on_remove is not None:
                 self._on_remove(k)
-            pool.drop(e.page)
+            if self.audit is not None:
+                self.audit.ledger.record("evict", page=e.page, key=k)
+            # kv_leak fault (ISSUE 15): suppress exactly one retention
+            # drop at the production eviction seam — the injected
+            # refcount leak the online auditor must catch (the page
+            # stays referenced but reachable from no table or cache)
+            if not (FAULTS.active and FAULTS.take("kv_leak")):
+                pool.drop(e.page)
             n += 1
         return n
 
@@ -230,6 +259,8 @@ class PrefixPageCache:
         self._children.setdefault(parent, set()).add(key)
         if self._on_insert is not None:
             self._on_insert(key, depth)
+        if self.audit is not None:
+            self.audit.ledger.record("retain", page=page, key=key)
         return True
 
     def clear(self):

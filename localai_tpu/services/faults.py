@@ -30,6 +30,12 @@ Injection points (grep for ``FAULTS.take``):
                                  the stored page (the checksum must catch it)
 ``emitter_wedge_ms=N``           engine/emitter.py worker loop: sleep N ms on
                                  one item (wedged-emitter watchdog coverage)
+``kv_leak``                      engine/prefix_cache.py ``_remove_tree``:
+                                 suppress one retention ``drop()`` at the
+                                 eviction seam — a refcount leak the online
+                                 KV auditor must detect (ISSUE 15)
+``replicaN_die``                 engine loop tick top: raise, killing replica
+                                 N's loop (pool crash recovery)
 ==========================  =================================================
 """
 

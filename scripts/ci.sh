@@ -28,7 +28,11 @@
 # replica-pool phase rides along too (REPLICA_AFFINITY_HITS/
 # MIGRATE_BYTE_MATCH/REPLICA_RECOVERED tracked line): prefix-affinity
 # routing, the live-migration byte gate, and kill-one-replica recovery
-# through the shared host KV tier.
+# through the shared host KV tier. Since ISSUE 15 every phase ends with
+# a KV lifecycle audit sweep and the KV_AUDIT_VIOLATIONS=0 /
+# KV_LEAKED_PAGES=0 tracked lines gate the smoke, chaos, and priority
+# stages — a nonzero count is a leaked page or a cross-tier accounting
+# break, never noise.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -161,6 +165,17 @@ if (hits is None or not hits >= 1
           f">= 1, migrate_byte_match={line.get('migrate_byte_match')} and "
           f"replica_recovered={line.get('replica_recovered')} must be true)")
     sys.exit(1)
+# KV lifecycle auditor (ISSUE 15): every smoke phase — including the
+# replica-pool one, which runs with kv_audit=on across both replicas
+# and the shared host tier — ends with a full audit sweep; the summed
+# totals must be exactly zero. A nonzero count is a real leaked page or
+# a cross-tier accounting break, never noise.
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+if kv_v != 0 or kv_l != 0:
+    print(f"FAIL: KV audit sweep caught a lifecycle break "
+          f"(violations={kv_v}, leaked_pages={kv_l}, both must be 0)")
+    sys.exit(1)
 PY
 rm -f "$smoke_out"
 
@@ -188,7 +203,11 @@ print(f"CHAOS_RECOVERED={line.get('recovered')} "
       f"shed_p95_ms={line.get('shed_p95_ms')} "
       f"stall_dump={line.get('stall_dump')} "
       f"survivors_identical={line.get('survivors_identical')}")
-sys.exit(0 if line.get("value") == 1 else 1)
+# the chaos engine sweeps its KV audit after faults are cleared: shed,
+# stall-abort, and recovery must all return every page (ISSUE 15)
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
 PY
 rm -f "$chaos_out"
 
@@ -219,7 +238,10 @@ print(f"PRIO_TTFT_RATIO={line.get('ttft_ratio')} "
       f"p50_ttft_on_ms={line.get('p50_ttft_on_ms')} "
       f"p50_ttft_off_ms={line.get('p50_ttft_off_ms')} "
       f"low_complete={line.get('low_complete')}")
-sys.exit(0 if line.get("ok") == 1 else 1)
+# preempt/resume page recycling must audit clean on all three engines
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+sys.exit(0 if line.get("ok") == 1 and kv_v == 0 and kv_l == 0 else 1)
 PY
 rm -f "$prio_out"
 

@@ -372,6 +372,49 @@ def test_host_store_corruption_detected_and_dropped():
     assert store.get(b"k" * 32) is not None
 
 
+def test_kv_leak_fault_detected_within_one_audit_pass(
+        tiny_llama, byte_tokenizer, tmp_path):
+    """ISSUE 15: an injected refcount leak at the prefix-cache eviction
+    seam must be caught by the NEXT audit pass — as a structured leak
+    violation, a kv_audit_violation event, and a flight dump carrying
+    the ledger tail."""
+    from localai_tpu.services.eventlog import EVENTS
+
+    cfg, params = tiny_llama
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=1, max_context=96, prefill_buckets=(16, 64),
+        kv_page_size=8, kv_audit="on", stall_dump_dir=str(tmp_path)))
+    try:
+        events = _manual_run(e, _greedy(byte_tokenizer, "leak victim!", 10))
+        assert events[-1].error is None
+        assert e.kv_audit_sweep()["violations"] == 0   # clean before fault
+        EVENTS.clear()
+
+        FAULTS.arm("kv_leak", count=1)       # suppress exactly one drop()
+        e._pool.release(0, 0)                # drop the slot's retention...
+        e._cache_tokens[0] = []
+        e._pcache.evict(e._pool, e._pool.num_pages)   # ...hit the seam
+        out = e._kv_audit_tick()             # ONE housekeeping pass
+        leaks = [v for v in out if v["check"] == "leak"]
+        assert leaks and leaks[0]["leaked_pages"] >= 1
+
+        ka = e.metrics()["kv_audit"]
+        assert ka["violations"] >= 1 and ka["leaked_pages"] >= 1
+        evs = [x for x in EVENTS.events()
+               if x["event"] == "kv_audit_violation"]
+        assert evs and evs[0]["check"] == "leak"
+        dumps = glob.glob(str(tmp_path / "localai-flight-kv_audit-*.json"))
+        assert len(dumps) == 1
+        with open(dumps[0]) as f:
+            rec = json.load(f)
+        assert rec["kv_violation"]["check"] == "leak"
+        assert rec["kv_ledger_tail"]         # the last page transitions
+        assert {"trace", "state", "events"} <= set(rec)
+    finally:
+        FAULTS.reset()
+        e.shutdown()     # report-only mode: drain check logs, never raises
+
+
 # ---- engine replica pool: kill one replica mid-stream (ISSUE 14) ----
 
 

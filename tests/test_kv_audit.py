@@ -1,0 +1,543 @@
+"""KV lifecycle ledger + online invariant auditor (ISSUE 15).
+
+Covers services/kv_audit.py and its hooks: ledger counters/balances and
+the bounded ring, the structured lifecycle errors that replaced
+paging.py's bare asserts (including a ``python -O`` regression — the
+asserts they replaced compiled away there), orphan-page leak detection
+through the ``kv_leak`` fault seam, host-store invariant scans against
+deliberately tampered state, and a seeded randomized lifecycle fuzz over
+the raw primitives (pool + prefix cache + host store) and over a real
+``engines=2`` pool — strict mode after every step, ledger balance and
+post-drain leak freedom at the end.
+
+Engine-level detection latency (violation within one housekeeping pass,
+event + flight dump) lives in test_chaos.py with the fault suite; the
+/debug/kv HTTP surface lives in test_sysobs.py.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from localai_tpu.engine.kv_offload import HostPageStore
+from localai_tpu.engine.paging import PagePool, PoolExhausted
+from localai_tpu.engine.prefix_cache import PrefixPageCache
+from localai_tpu.ops import kvcache
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.kv_audit import (
+    KVAuditError,
+    KVAuditor,
+    KVLedger,
+    KVLifecycleError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _strict(pool, pcache=None, hstore=None, replica: int = 0) -> KVAuditor:
+    aud = KVAuditor(mode="strict", replica=replica)
+    pool.audit = aud
+    if pcache is not None:
+        pcache.audit = aud
+    if hstore is not None:
+        hstore.audit = aud
+    return aud
+
+
+# ---- ledger units ----
+
+
+def test_ledger_counts_balances_and_tail():
+    led = KVLedger(size=128)
+    for p in range(5):
+        led.record("alloc", page=p, slot=0)
+    led.record("hold", page=4, key=b"\xaa" * 32, rid="r1")
+    led.record("drop", page=4)
+    led.record("free", page=4)
+    assert led.seq == 8
+    snap = led.snapshot()
+    assert snap["events_total"] == 8
+    assert snap["live_pages"] == 4      # 5 alloc - 1 free
+    assert snap["live_holds"] == 0      # 1 hold - 1 drop
+    assert snap["counts"]["alloc"] == 5
+    assert snap["counts"]["hold"] == 1
+    tail = led.tail(3)
+    assert [t["op"] for t in tail] == ["hold", "drop", "free"]
+    assert tail[0]["key"] == "aa" * 8 and tail[0]["rid"] == "r1"
+    assert tail[-1]["seq"] == 8
+
+
+def test_ledger_ring_bounded_totals_survive():
+    led = KVLedger(size=64)
+    for p in range(500):
+        led.record("alloc", page=p)
+    assert len(led.tail(10_000)) == 64          # ring is bounded...
+    assert led.snapshot()["events_total"] == 500  # ...totals are not
+    assert led.tail(1)[0]["page"] == 499
+    led.rebase()
+    snap = led.snapshot()
+    assert snap["live_pages"] == 0 and snap["live_holds"] == 0
+    assert snap["counts"]["reset"] == 1
+    assert led.tail(1)[0]["op"] == "reset"
+
+
+def test_auditor_rejects_off_mode():
+    # off never constructs an auditor — the engine skips construction
+    # entirely, so an explicit "off" KVAuditor is a wiring bug
+    with pytest.raises(ValueError, match="off"):
+        KVAuditor(mode="off")
+
+
+# ---- structured lifecycle errors (the bare-assert replacement) ----
+
+
+def _expect_lifecycle(aud, op):
+    v = aud.last_violations[-1]
+    assert v["check"] == "lifecycle" and v["op"] == op
+    assert aud.ledger.counts.get("violation", 0) >= 1
+
+
+def test_hold_on_free_page_structured():
+    pool = PagePool(2, 64, 16, 4)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.hold(0)
+    assert ei.value.op == "hold" and ei.value.page == 0
+    assert "unreferenced" in str(ei.value)
+    assert aud.violations == 1
+    _expect_lifecycle(aud, "hold")
+
+
+def test_drop_without_hold_structured():
+    pool = PagePool(2, 64, 16, 4)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    pool.ensure(0, 16)
+    page = int(pool.ptab[0, 0])
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.drop(page)
+    assert ei.value.op == "drop" and ei.value.page == page
+    _expect_lifecycle(aud, "drop")
+    # the failed drop must not have touched the refcount
+    assert int(pool.refs[page]) == 1
+
+
+def test_unref_already_free_structured():
+    pool = PagePool(2, 64, 16, 4)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.unref_detached(3)
+    assert ei.value.op == "free" and ei.value.page == 3
+    _expect_lifecycle(aud, "free")
+
+
+def test_share_into_non_empty_slot_structured():
+    pool = PagePool(2, 64, 16, 8)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    pool.ensure(0, 16)
+    pool.ensure(1, 16)
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.share(0, 1, 16)
+    assert ei.value.op == "share" and ei.value.slot == (0, 1)
+    _expect_lifecycle(aud, "share")
+
+
+def test_splice_guards_structured():
+    pool = PagePool(2, 64, 16, 8)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.splice(1, [5])                  # page 5 was never allocated
+    assert ei.value.op == "splice" and ei.value.page == 5
+    pool.ensure(1, 16)
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.splice(1, [0])                  # slot 1 is not empty
+    assert ei.value.op == "splice" and ei.value.slot == 1
+    assert aud.violations == 2
+
+
+def test_adopt_guards_structured():
+    pool = PagePool(1, 16, 16, 4)            # max_pages = 1 per slot
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.adopt(0, 2)                     # page 2 is free
+    assert ei.value.op == "adopt" and ei.value.page == 2
+    pool.ensure(0, 16)
+    p = pool.alloc_detached()
+    with pytest.raises(KVLifecycleError) as ei:
+        pool.adopt(0, p)                     # table already full
+    assert ei.value.op == "adopt" and ei.value.slot == 0
+    pool.unref_detached(p)
+    assert aud.violations == 2
+
+
+def test_lifecycle_guard_survives_python_O():
+    # the bare asserts this replaced compiled away under -O; the
+    # structured raise must not. paging.py imports no jax, so the
+    # subprocess is cheap.
+    code = (
+        "from localai_tpu.engine.paging import PagePool\n"
+        "from localai_tpu.services.kv_audit import KVLifecycleError\n"
+        "if __debug__:\n"
+        "    raise SystemExit(2)   # -O did not take effect\n"
+        "p = PagePool(1, 64, 16, 4)\n"
+        "try:\n"
+        "    p.hold(0)\n"
+        "except KVLifecycleError:\n"
+        "    raise SystemExit(0)\n"
+        "raise SystemExit(1)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-O", "-c", code],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+
+
+# ---- pool scans: clean path and orphan-leak detection ----
+
+
+def _scope() -> bytes:
+    return kvcache.page_scope(16, "kv-audit-unit")
+
+
+def test_clean_lifecycle_audits_clean_and_drains():
+    pool = PagePool(1, 64, 16, 8)
+    pc = PrefixPageCache(_scope(), 16)
+    aud = _strict(pool, pc)
+    pool.ensure(0, 32)
+    toks = list(range(32))
+    assert pc.insert(pool, 0, toks) == 2
+    assert aud.run(pool, pcache=pc) == []
+    pool.release(0)
+    assert aud.run(pool, pcache=pc) == []     # retained pages accounted
+    assert pool.retained_pages == 2
+    pc.evict(pool, pool.num_pages)
+    assert aud.run(pool, pcache=pc, drained=True) == []
+    snap = aud.snapshot()
+    assert snap["violations"] == 0 and snap["leaked_pages"] == 0
+    assert snap["ledger"]["live_pages"] == 0
+    assert snap["ledger"]["live_holds"] == 0
+    assert snap["checks"] == 3
+
+
+def test_kv_leak_fault_produces_orphan_and_strict_raises():
+    pool = PagePool(1, 64, 16, 8)
+    pc = PrefixPageCache(_scope(), 16)
+    aud = _strict(pool, pc)
+    pool.ensure(0, 32)
+    pc.insert(pool, 0, list(range(32)))
+    pool.release(0)
+    assert aud.run(pool, pcache=pc) == []
+
+    FAULTS.arm("kv_leak", "1", 1)             # suppress exactly one drop
+    pc.evict(pool, pool.num_pages)
+    with pytest.raises(KVAuditError, match="leak"):
+        aud.run(pool, pcache=pc)
+    assert aud.leaked_pages == 1
+    assert aud.violations >= 1
+    v = [x for x in aud.last_violations if x["check"] == "leak"]
+    assert v and v[0]["leaked_pages"] == 1 and v[0]["replica"] == 0
+    # the ledger itself stayed balanced — the leak is an ORPHAN (page
+    # reachable from no table/cache), not a bookkeeping drift, which is
+    # exactly why the reachability scan exists
+    assert aud.ledger.live_pages == pool.pages_in_use == 1
+    assert aud.ledger.live_holds == int(pool.held.sum()) == 1
+
+
+def test_report_only_mode_counts_without_raising():
+    pool = PagePool(1, 64, 16, 8)
+    pc = PrefixPageCache(_scope(), 16)
+    aud = KVAuditor(mode="on")
+    pool.audit = aud
+    pc.audit = aud
+    seen = []
+    aud.on_violation = seen.append
+    pool.ensure(0, 16)
+    pc.insert(pool, 0, list(range(16)))
+    pool.release(0)
+    FAULTS.arm("kv_leak", "1", 1)
+    pc.evict(pool, pool.num_pages)
+    out = aud.run(pool, pcache=pc)            # no raise in report-only
+    assert [v["check"] for v in out] == ["leak"]
+    assert seen == out                        # callback saw each violation
+    assert aud.snapshot()["leaked_pages"] == 1
+
+
+# ---- host-store scans against tampered state ----
+
+
+def _page(v: float, shape=(2, 4, 2, 8)) -> np.ndarray:
+    return np.full(shape, v, np.float32)
+
+
+def _chain(store: HostPageStore, n: int, start: int = 0, parent=None,
+           val: float = 0.0) -> list:
+    keys = []
+    p = parent if parent is not None else kvcache.PAGE_HASH_ROOT
+    for i in range(n):
+        key = kvcache.page_chain_hash(p, [start + i] * 4, store.scope)
+        store.put(key, p, i, _page(val + i), _page(val + i + 100))
+        keys.append(key)
+        p = key
+    return keys
+
+
+def test_host_scan_clean_then_byte_drift():
+    store = HostPageStore(_scope(), 16, budget_mb=64)
+    _chain(store, 4)
+    assert store.audit_scan(sample_crc=8) == []
+    store._bytes += 123                       # simulate accounting drift
+    out = store.audit_scan(sample_crc=0)
+    assert [v["check"] for v in out] == ["host_bytes"]
+    assert "drift" in out[0]["detail"]
+
+
+def test_host_scan_crc_spot_check_catches_bit_rot():
+    store = HostPageStore(_scope(), 16, budget_mb=64)
+    keys = _chain(store, 3)
+    e = store._entries[keys[1]]
+    e.k[0, 0, 0, 0] += 1.0                    # in-place bit rot
+    out = store.audit_scan(sample_crc=len(store))   # sample covers all
+    assert any(v["check"] == "host_crc" for v in out)
+
+
+def test_host_scan_children_desync():
+    store = HostPageStore(_scope(), 16, budget_mb=64)
+    keys = _chain(store, 3)
+    store._children[keys[0]].discard(keys[1])  # break the kid-set link
+    out = store.audit_scan(sample_crc=0)
+    assert any(v["check"] == "host_children" for v in out)
+
+
+def test_scan_shared_tags_pool_wide_and_strict_raises():
+    store = HostPageStore(_scope(), 16, budget_mb=64)
+    _chain(store, 2)
+    aud = KVAuditor(mode="strict", replica=3)
+    store.audit = aud
+    assert aud.scan_shared(store) == []
+    store._bytes += 7
+    with pytest.raises(KVAuditError, match="host_bytes"):
+        aud.scan_shared(store)
+    # a shared-tier fault has no single replica to blame
+    assert aud.last_violations[-1]["replica"] == -1
+    assert aud.checks == 2
+
+
+# ---- seeded randomized lifecycle fuzz over the raw primitives ----
+
+
+def test_lifecycle_fuzz_primitives_strict():
+    rng = random.Random(0xC0FFEE)
+    pg = 16
+    pool = PagePool(3, 96, pg, 12)            # oversubscribed 1.5x
+    pc = PrefixPageCache(_scope(), pg)
+    store = HostPageStore(_scope(), pg, budget_mb=1)
+    aud = _strict(pool, pc, store)
+    slot_toks: dict = {s: [] for s in range(3)}
+    corpus: list = []
+    host_keys: list = []
+    big = (2, pg, 2, 128)                     # 128 KiB/entry: budget evicts
+
+    def fill_toks(slot):
+        t = slot_toks[slot]
+        need = int(pool.owned[slot]) * pg
+        while len(t) < need:
+            t.append(rng.randrange(256))
+        del t[need:]
+
+    def op_grow():
+        slot = rng.randrange(3)
+        if int(pool.owned[slot]) >= pool.max_pages:
+            return
+        want = rng.randint(int(pool.owned[slot]) + 1, pool.max_pages)
+        try:
+            pool.ensure(slot, want * pg)
+        except PoolExhausted:
+            pc.evict(pool, 2)
+        fill_toks(slot)
+
+    def op_insert():
+        slots = [s for s in range(3) if pool.owned[s] > 0]
+        if slots:
+            slot = rng.choice(slots)
+            pc.insert(pool, slot, slot_toks[slot])
+            corpus.append(tuple(slot_toks[slot]))
+
+    def op_release():
+        slot = rng.randrange(3)
+        keep = rng.randint(0, int(pool.owned[slot]))
+        pool.release(slot, keep * pg)
+        fill_toks(slot)
+
+    def op_share():
+        srcs = [s for s in range(3) if pool.owned[s] > 0]
+        dsts = [s for s in range(3) if pool.owned[s] == 0]
+        if srcs and dsts:
+            src, dst = rng.choice(srcs), rng.choice(dsts)
+            rows = pool.share(src, dst, int(pool.owned[src]) * pg)
+            slot_toks[dst] = slot_toks[src][:rows]
+
+    def op_match_splice():
+        dsts = [s for s in range(3) if pool.owned[s] == 0]
+        if corpus and dsts:
+            toks = list(rng.choice(corpus))
+            pages = pc.match(toks, pool.max_pages)
+            if pages:
+                dst = rng.choice(dsts)
+                rows = pool.splice(dst, pages)
+                slot_toks[dst] = toks[:rows]
+
+    def op_cow_clone():
+        for slot in rng.sample(range(3), 3):
+            n = int(pool.owned[slot])
+            shared = [i for i in range(n)
+                      if pool.page_refs(slot, i) > 1]
+            if shared:
+                try:
+                    p = pool.alloc_detached()
+                except PoolExhausted:
+                    pc.evict(pool, 2)
+                    return
+                pool.replace(slot, rng.choice(shared), p)
+                return
+
+    def op_adopt():
+        slots = [s for s in range(3)
+                 if 0 < pool.owned[s] < pool.max_pages]
+        if slots:
+            slot = rng.choice(slots)
+            try:
+                p = pool.alloc_detached()
+            except PoolExhausted:
+                pc.evict(pool, 2)
+                return
+            pool.adopt(slot, p)
+            fill_toks(slot)
+
+    def op_evict():
+        pc.evict(pool, rng.randint(1, 4))
+
+    def op_offload():
+        start = rng.randrange(1000)
+        p = kvcache.PAGE_HASH_ROOT
+        for i in range(rng.randint(1, 3)):
+            key = kvcache.page_chain_hash(p, [start + i] * 4, store.scope)
+            store.put(key, p, i, _page(float(i), big), _page(1.0, big))
+            host_keys.append(key)
+            p = key
+
+    def op_restore():
+        if host_keys:
+            if store.get(rng.choice(host_keys)) is not None:
+                store.note_restore(1)
+            else:
+                store.note_miss()
+
+    ops = [op_grow, op_grow, op_insert, op_insert, op_release, op_share,
+           op_match_splice, op_cow_clone, op_adopt, op_evict,
+           op_offload, op_restore]
+    for _ in range(250):
+        rng.choice(ops)()
+        aud.run(pool, pcache=pc, hstore=store)   # strict: raises on drift
+
+    for slot in range(3):
+        pool.release(slot)
+    pc.evict(pool, pool.num_pages)
+    assert aud.run(pool, pcache=pc, hstore=store, drained=True) == []
+    snap = aud.snapshot()
+    assert snap["violations"] == 0 and snap["leaked_pages"] == 0
+    assert snap["ledger"]["live_pages"] == 0
+    assert snap["ledger"]["live_holds"] == 0
+    assert snap["checks"] == 251
+    assert snap["ledger_events"] > 250
+
+
+# ---- engine + engines=2 pool integration (strict end to end) ----
+
+
+@pytest.mark.slow
+def test_engine_strict_workload_audits_clean(tiny_llama, byte_tokenizer):
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+
+    cfg, params = tiny_llama
+    e = eng.Engine(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=96, prefill_buckets=(16, 64),
+        decode_burst=4, kv_page_size=8, kv_audit="strict"))
+    e.start()
+    try:
+        rng = random.Random(7)
+        prefixes = ["the quick brown fox ", "a man a plan ", "lorem ipsum "]
+        outs = []
+        for i in range(6):
+            prompt = rng.choice(prefixes) + "x" * rng.randint(0, 12)
+            outs.append(e.submit(eng.GenRequest(
+                prompt_ids=byte_tokenizer.encode(prompt),
+                params=sampling.SamplingParamsHost(temperature=0.0),
+                max_new_tokens=6, ignore_eos=True)))
+        for out in outs:
+            while out.get(timeout=60.0) is not None:
+                pass
+        snap = e.kv_audit_sweep()             # strict: raises on violation
+        assert snap["mode"] == "strict"
+        assert snap["violations"] == 0 and snap["leaked_pages"] == 0
+        assert snap["checks"] >= 1 and snap["ledger_events"] > 0
+        dbg = e.kv_debug()
+        assert dbg["mode"] == "strict"
+        assert dbg["pool"]["pages_total"] == e._pool.num_pages
+        assert isinstance(dbg["ledger_tail"], list) and dbg["ledger_tail"]
+    finally:
+        e.shutdown()                          # strict post-drain check runs
+
+
+@pytest.mark.slow
+def test_pool_engines2_strict_workload_audits_clean(
+        tiny_llama, byte_tokenizer):
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.pool import EnginePool
+
+    cfg, params = tiny_llama
+    p = EnginePool.build(cfg, params, byte_tokenizer, eng.EngineConfig(
+        num_slots=2, max_context=96, prefill_buckets=(16, 64),
+        decode_burst=4, kv_page_size=8, kv_audit="strict"), engines=2)
+    p.start()
+    try:
+        rng = random.Random(11)
+        prefixes = ["shared prefix alpha ", "shared prefix beta "]
+        outs = []
+        for i in range(6):
+            prompt = rng.choice(prefixes) + str(i)
+            outs.append(p.submit(eng.GenRequest(
+                prompt_ids=byte_tokenizer.encode(prompt),
+                params=sampling.SamplingParamsHost(temperature=0.0),
+                max_new_tokens=6, ignore_eos=True)))
+        for out in outs:
+            while out.get(timeout=60.0) is not None:
+                pass
+        snap = p.kv_audit_sweep()             # shared scan + both replicas
+        assert snap["mode"] == "strict"
+        assert snap["violations"] == 0 and snap["leaked_pages"] == 0
+        assert snap["checks"] >= 2            # at least one per replica
+        dbg = p.kv_debug()
+        assert dbg["engine_replicas"] == 2 and len(dbg["replicas"]) == 2
+        assert {r["replica"] for r in dbg["replicas"]} == {0, 1}
+        assert "shared_host" in dbg
+    finally:
+        p.shutdown()

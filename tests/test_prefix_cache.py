@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from localai_tpu.engine import engine as eng
 from localai_tpu.engine import sampling
-from localai_tpu.engine.paging import PagePool, PoolExhausted
+from localai_tpu.engine.paging import (KVLifecycleError, PagePool,
+                                       PoolExhausted)
 from localai_tpu.engine.prefix_cache import PrefixPageCache, build_scope
 from localai_tpu.models import llama
 from localai_tpu.ops import kvcache
@@ -134,7 +135,7 @@ def test_evict_lru_first_with_cascade():
 
 def test_hold_on_free_page_is_rejected():
     pool = PagePool(num_slots=1, max_context=16, page_size=4)
-    with pytest.raises(AssertionError):
+    with pytest.raises(KVLifecycleError):
         pool.hold(0)
 
 

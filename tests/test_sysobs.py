@@ -293,7 +293,16 @@ def server():
     loader = ModelLoader(health_attempts=100, health_interval_s=0.1)
     loader.register_embedded("fake", FakeServicer)
     configs = {"tiny": ModelConfig(name="tiny", backend="fake",
-                                   model="tiny")}
+                                   model="tiny"),
+               # /debug/kv shape variants (ISSUE 15): audited-off and
+               # merged multi-replica views, loaded on demand by the
+               # kv endpoint tests
+               "tinyoff": ModelConfig(name="tinyoff", backend="fake",
+                                      model="tiny",
+                                      options=["kv_audit=off"]),
+               "tinypool": ModelConfig(name="tinypool", backend="fake",
+                                       model="tiny",
+                                       options=["engines=2"])}
     caps = Capabilities(app_config, loader, configs)
     app = build_app(caps, app_config)
 
@@ -374,6 +383,41 @@ def test_debug_events_endpoint_merges_and_tags(server):
     # ?last trims to the most recent N
     r2 = httpx.get(f"{server.base}/debug/events", params={"last": 1})
     assert len(r2.json()["events"]) == 1
+
+
+def test_debug_kv_endpoint(server):
+    r = httpx.get(f"{server.base}/debug/kv")
+    assert r.status_code == 200
+    kv = r.json()["models"]["tiny"]
+    assert kv["mode"] == "on"
+    assert kv["pool"]["pages_total"] == 8
+    assert kv["pool"]["free"] + kv["pool"]["active"] + kv["pool"][
+        "retained"] == kv["pool"]["pages_total"]
+    aud = kv["audit"]
+    assert aud["violations"] == 0 and aud["last_violations"] == []
+    assert aud["ledger"]["counts"]["alloc"] >= 1
+    assert kv["ledger_tail"][0]["op"] == "alloc"
+    assert kv["chains"][0]["depth"] == 0
+    assert "host" in kv
+
+
+def test_debug_kv_endpoint_off_and_multi_replica_shapes(server):
+    for name in ("tinyoff", "tinypool"):
+        r = httpx.post(f"{server.base}/v1/chat/completions", json={
+            "model": name,
+            "messages": [{"role": "user", "content": "hello"}],
+        }, timeout=60)
+        assert r.status_code == 200, r.text
+    models = httpx.get(f"{server.base}/debug/kv").json()["models"]
+    # kv_audit=off: no auditor, no ledger — just the mode marker
+    off = models["tinyoff"]
+    assert off["mode"] == "off" and "ledger_tail" not in off
+    # engines=2: the pool's merged view, one entry per replica
+    tp = models["tinypool"]
+    assert tp["engine_replicas"] == 2
+    assert [r["replica"] for r in tp["replicas"]] == [0, 1]
+    assert all(r["audit"]["violations"] == 0 for r in tp["replicas"])
+    assert "shared_host" in tp and "pool_index_keys" in tp
 
 
 # -------------------------------------------------------------- exemplars
