@@ -1700,6 +1700,198 @@ def bench_multiturn(cfg, S, C, n_conv, n_turns, sys_len, user_len, max_new,
     return out
 
 
+def bench_longcontext(cfg, S, C, max_new=32):
+    """Long-context serving tier (ISSUE 16 acceptance): TTFT + ITL vs
+    context length on the snap-back window engine, whose on-device KV is
+    a bounded working set (kv_window_pages) with the cold middle demoted
+    to the host tier, plus the decode-time prefetch-ahead pipeline.
+
+    Three phases, one engine each where needed:
+
+      1. cold sweep — one greedy request per context length (CI scale:
+         fractions of C; set LOCALAI_BENCH_LC_LENS=4096,...,131072 on a
+         real chip) through the WINDOWED engine, recording TTFT and the
+         inter-token-latency distribution. The acceptance claim is the
+         ITL p99 staying flat as context grows — the window caps the
+         attention working set, so decode cost stops scaling with
+         context.
+      2. unwindowed reference — the same sweep through a plain paged
+         engine sized to fit everything (possible at CI scale; the whole
+         point is that it is NOT possible at 128k), for the TTFT/ITL
+         comparison, plus the byte gate: a prompt short enough to fit
+         INSIDE the window must produce byte-identical greedy output on
+         both engines (the window machinery must be invisible until the
+         policy actually engages).
+      3. prefetch warm turn — both slots are pinned by decode blockers,
+         then the longest conversation's follow-up turn is queued behind
+         them: the prefetch tick must restore its sink + tail-window
+         links from the host tier DURING the blockers' bursts, so the
+         admission finds them resident (PREFETCH_HIT > 0) and never
+         pays a synchronous restore it predicted (PREFETCH_LATE == 0).
+
+    Ends with the ISSUE-15 audit sweep over the deep chains the sweep
+    left behind: demote / compress / prefetch are first-class ledger
+    ops, so KV_AUDIT_VIOLATIONS / KV_LEAKED_PAGES must both be 0."""
+    import jax.numpy as jnp
+
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.weights import random_params
+
+    pgs = 16
+    W = int(os.environ.get("LOCALAI_BENCH_LC_WINDOW", "4"))
+    sink = int(os.environ.get("LOCALAI_BENCH_LC_SINK", "1"))
+    ahead = int(os.environ.get("LOCALAI_BENCH_LC_AHEAD", "2"))
+    lens_env = os.environ.get("LOCALAI_BENCH_LC_LENS", "")
+    if lens_env:
+        lens = [int(x) for x in lens_env.split(",") if x.strip()]
+    else:
+        lens = [C // 8, C // 4, C // 2, (3 * C) // 4]
+    lens = sorted({min(n, C - max_new - 8) for n in lens if n >= pgs})
+    budget_rows = (sink + W) * pgs
+    out = {"window_pages": W, "sink_pages": sink, "prefetch_ahead": ahead,
+           "page_size": pgs, "window_rows": budget_rows, "ctx_lens": lens,
+           "kv_audit_violations": 0, "kv_leaked_pages": 0}
+
+    def _run(engine, ids, mn):
+        req = eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=mn, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+        t0 = time.monotonic()
+        q = engine.submit(req)
+        ttft, last, toks, itls = None, None, [], []
+        while True:
+            ev = q.get()
+            if ev is None:
+                break
+            now = time.monotonic()
+            if ev.error:
+                raise RuntimeError(ev.error)
+            new = ev.token_ids or ([ev.token_id] if ev.token_id >= 0
+                                   else [])
+            if new:
+                if ttft is None:
+                    ttft = now - t0
+                elif last is not None:
+                    # events carry whole bursts: spread the gap over the
+                    # burst so the samples approximate per-token ITL
+                    itls.extend([(now - last) / len(new)] * len(new))
+                last = now
+                toks.extend(new)
+        return ttft, toks, itls
+
+    def _sweep_engine(windowed):
+        ecfg = eng.EngineConfig(
+            num_slots=S, max_context=C, prefill_buckets=(32, 64),
+            prefill_chunk=64, decode_burst=4,
+            cache_dtype=jnp.float32,
+            kv_layout="paged", kv_page_size=pgs,
+            # windowed: a pool a fraction of the sweep's full working
+            # set — the window is what makes the long prompts fit.
+            # unwindowed reference: sized to hold everything (only
+            # possible because CI scale is small)
+            kv_pool_pages=(S * (sink + W + 8) + 24 if windowed
+                           else S * (C // pgs) + 8),
+            kv_audit="on",
+            **(dict(kv_window_pages=W, kv_sink_pages=sink,
+                    kv_window_policy="demote", kv_prefetch_ahead=ahead,
+                    kv_offload=True)
+               if windowed else dict(kv_offload=False)))
+        engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
+                            eos_token_ids={cfg.vocab_size - 1})
+        engine.start(precompile=False)
+        return engine
+
+    params = random_params(
+        cfg, quantize=os.environ.get("LOCALAI_BENCH_QUANT", ""))
+    rng = np.random.default_rng(11)
+    prompts = {n: rng.integers(0, 255, size=n).tolist() for n in lens}
+    # short-prompt byte gate: must fit the working set INCLUDING the
+    # generated tokens and the window-advance look-ahead margin
+    # (decode_burst * (n_draft + 1) + 2), so the window never engages
+    mn_short = 12
+    short_len = max(pgs, budget_rows - mn_short - 32)
+    short_ids = rng.integers(0, 255, size=short_len).tolist()
+    warm_len = budget_rows + 2 * pgs   # jit warmup that DOES window
+    blk_ids = [rng.integers(0, 255, size=24).tolist() for _ in range(S)]
+
+    gen_by_mode = {}
+    for mode in ("windowed", "unwindowed"):
+        engine = _sweep_engine(windowed=(mode == "windowed"))
+        per_len = {}
+        try:
+            # jit warmup: one short prompt for the plain paths plus one
+            # past the window budget so the win-piece prefill / windowed
+            # decode programs compile OUTSIDE the timed sweep
+            _run(engine, rng.integers(0, 255, size=pgs).tolist(), 4)
+            _run(engine, rng.integers(0, 255, size=warm_len).tolist(), 12)
+            for n in lens:
+                ttft, toks, itls = _run(engine, prompts[n], max_new)
+                itls = itls or [0.0]
+                per_len[str(n)] = {
+                    "ttft_ms": round((ttft or 0.0) * 1e3, 1),
+                    "itl_p50_ms": round(
+                        float(np.percentile(itls, 50)) * 1e3, 2),
+                    "itl_p99_ms": round(
+                        float(np.percentile(itls, 99)) * 1e3, 2),
+                    "windowed": bool(n + max_new > budget_rows
+                                     and mode == "windowed"),
+                }
+            _, gen_by_mode[mode], _ = _run(engine, short_ids, mn_short)
+            if mode == "windowed":
+                # phase 3: warm follow-up turn behind decode blockers —
+                # its host-tier links must be prefetched DURING the
+                # blockers' bursts, ahead of its admission
+                longest = lens[-1]
+                warm_ids = (prompts[longest]
+                            + rng.integers(0, 255, size=8).tolist())
+                bqs = [engine.submit(eng.GenRequest(
+                    prompt_ids=ids, max_new_tokens=48, ignore_eos=True,
+                    params=sampling.SamplingParamsHost(temperature=0.0)))
+                    for ids in blk_ids]
+                t0 = time.monotonic()
+                wq = engine.submit(eng.GenRequest(
+                    prompt_ids=warm_ids, max_new_tokens=8,
+                    ignore_eos=True,
+                    params=sampling.SamplingParamsHost(temperature=0.0)))
+                warm_ttft = None
+                # drain the warm stream FIRST (blocked on wq.get its
+                # first-token timestamp is arrival time); the blocker
+                # queues just buffer meanwhile
+                for q in [wq] + bqs:
+                    while True:
+                        ev = q.get()
+                        if ev is None:
+                            break
+                        if ev.error:
+                            raise RuntimeError(ev.error)
+                        if q is wq and warm_ttft is None and (
+                                ev.token_ids or ev.token_id >= 0):
+                            warm_ttft = time.monotonic() - t0
+                out["warm_turn_ttft_ms"] = round(
+                    (warm_ttft or 0.0) * 1e3, 1)
+                m = engine.metrics()
+                off = m.get("kv_offload") or {}
+                for k in ("prefetch_issued", "prefetch_hits",
+                          "prefetch_late", "prefetch_wasted",
+                          "offloaded_pages", "restored_pages"):
+                    out[k] = off.get(k)
+                dbg = engine.kv_debug()
+                out["prefetch_staged_after"] = (
+                    dbg.get("prefetch") or {}).get("staged_pages")
+        finally:
+            _kv_sweep(engine, out)
+            engine.shutdown()
+        out[f"{mode}_by_len"] = per_len
+    wl = out["windowed_by_len"]
+    p99s = [wl[str(n)]["itl_p99_ms"] for n in lens]
+    out["itl_p99_ratio"] = (round(p99s[-1] / p99s[0], 3)
+                            if p99s and p99s[0] else None)
+    out["short_byte_match"] = (
+        gen_by_mode["windowed"] == gen_by_mode["unwindowed"])
+    return out
+
+
 def bench_kernel(cfg, S, C, steps, inner):
     """Bare decode-burst loop: model + sampler, no engine thread."""
     import jax
@@ -2461,7 +2653,7 @@ def main():
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
             or "--chaos" in sys.argv or "--priority" in sys.argv
             or "--slo" in sys.argv or "--spec" in sys.argv
-            or "--replicas" in sys.argv):
+            or "--replicas" in sys.argv or "--longcontext" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -2666,6 +2858,29 @@ def main():
             print(json.dumps({
                 "metric": f"slo_{preset}", "value": 1 if ok else 0,
                 "unit": "ok", **r,
+            }))
+            return
+
+        if "--longcontext" in sys.argv:
+            # long-context serving tier (ISSUE 16): f32 weights so the
+            # short-prompt byte gate (window machinery invisible until
+            # the policy engages) compares deterministically across the
+            # windowed / unwindowed engines
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(256, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 512)
+            r = bench_longcontext(cfg, S, C)
+            ok = (r.get("prefetch_late") == 0
+                  and (r.get("prefetch_hits") or 0) >= 1
+                  and r.get("short_byte_match") is True
+                  and (r.get("offloaded_pages") or 0) >= 1)
+            print(json.dumps({
+                "metric": f"longcontext_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", "ok": 1 if ok else 0, **r,
             }))
             return
 

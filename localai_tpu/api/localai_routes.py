@@ -155,6 +155,14 @@ _OFFLOAD_COUNTERS = (("offloaded_pages", "pages"),
                      ("misses", "misses"),
                      ("evicted_pages", "evicted_pages"),
                      ("restored_pages", "restored_pages"))
+# prefetch-ahead pipeline totals (ISSUE 16; engine/kv_offload.py stats
+# key -> localai_kv_prefetch_<metric>_total): pages restored ahead of
+# need, pages the admission claimed (hits), sync restores the pipeline
+# predicted but lost (late), and expired/raided speculation (wasted)
+_PREFETCH_COUNTERS = (("prefetch_issued", "issued"),
+                      ("prefetch_hits", "hits"),
+                      ("prefetch_late", "late"),
+                      ("prefetch_wasted", "wasted"))
 # per-request TTFT decomposition (engine.py _ttft_decomp rolling window,
 # p50 over the last 512 finished requests) — loaded-TTFT regressions
 # show up here without running bench: queue_wait (admission backlog),
@@ -235,6 +243,8 @@ def _refresh_engine_metrics(state):
               "prefill_kernel_fallback_total",
               *(f"prefix_cache_{k}_total" for k in _PCACHE_COUNTERS),
               *(f"kv_offload_{m}_total" for _k, m in _OFFLOAD_COUNTERS),
+              *(f"kv_prefetch_{m}_total" for _k, m in _PREFETCH_COUNTERS),
+              "kv_prefetch_inflight",
               *(m for _k, m in _LIFECYCLE_COUNTERS),
               *(m for _k, m in _SCHED_COUNTERS),
               "queue_depth_class", "resume_queue_depth",
@@ -469,6 +479,12 @@ def _refresh_engine_metrics(state):
             for skey, mkey in _OFFLOAD_COUNTERS:
                 METRICS.set_counter(f"kv_offload_{mkey}_total",
                                     off.get(skey, 0), label_str(model=name))
+            for skey, mkey in _PREFETCH_COUNTERS:
+                METRICS.set_counter(f"kv_prefetch_{mkey}_total",
+                                    off.get(skey, 0), label_str(model=name))
+            METRICS.set_gauge("kv_prefetch_inflight",
+                              off.get("prefetch_inflight", 0),
+                              label_str(model=name))
         ka = stats.get("kv_audit")
         if ka:
             for key in _KV_AUDIT_COUNTERS:

@@ -333,6 +333,24 @@ class EngineServicer(BackendServicer):
             **({"kv_audit": ka} if (ka := str(
                 extra.get("kv_audit", "") or "")) in
                ("off", "on", "strict") else {}),
+            # long-context serving tier (ISSUE 16): kv_window_pages
+            # bounds the on-device working set (0 = off, the default);
+            # kv_sink_pages pins attention-sink head pages on device;
+            # kv_window_policy picks what happens to cold middle pages
+            # (demote to host / drop); kv_prefetch_ahead sets the
+            # decode-time restore pipeline depth (explicit 0 disables
+            # prefetch, so isdigit passes it through)
+            **({"kv_window_pages": wp} if (wp := int(
+                extra.get("kv_window_pages", 0) or 0)) > 0 else {}),
+            **({"kv_sink_pages": int(v)} if (v := str(
+                extra.get("kv_sink_pages", "")).strip()).isdigit()
+               else {}),
+            **({"kv_window_policy": wpol} if (wpol := str(
+                extra.get("kv_window_policy", "") or "")) in
+               ("demote", "drop") else {}),
+            **({"kv_prefetch_ahead": int(v)} if (v := str(
+                extra.get("kv_prefetch_ahead", "")).strip()).isdigit()
+               else {}),
             # ragged packed prefill (this PR): prefill_packed=0 opts
             # back into the per-slot bucketed path bit-for-bit;
             # prefill_token_budget caps packed prompt tokens per

@@ -134,7 +134,12 @@ class ModelConfig:
     # kv_prefix_cache_min_rows=N (reuse threshold, default 16),
     # kv_offload=0|1 (host-RAM page offload tier, default on),
     # kv_host_pool_mb=N (host tier byte budget), kv_host_store=path
-    # (persist offloaded chains across restarts), or the ragged
+    # (persist offloaded chains across restarts), the long-context
+    # window knobs kv_window_pages=N (bounded on-device working set,
+    # 0 = off), kv_sink_pages=N (attention-sink head pages pinned on
+    # device), kv_window_policy=demote|drop (cold middle pages demote
+    # to host or drop) and kv_prefetch_ahead=N (decode-time restore
+    # pipeline depth, 0 = off), or the ragged
     # packed-prefill knobs prefill_packed=0|1 (default on; 0 restores
     # per-slot bucketed prefill), prefill_token_budget=N (max packed
     # prompt tokens per scheduler tick, 0 = engine auto) and
@@ -270,7 +275,12 @@ class ModelConfig:
                        "priority_aging_ms",
                        # speculative decoding (ISSUE 13); explicit
                        # n_draft=0 disables speculation
-                       "n_draft") and not v.isdigit():
+                       "n_draft",
+                       # long-context serving tier (ISSUE 16); 0 = window
+                       # off / prefetch off, sink defaults to 1 page
+                       "kv_window_pages",
+                       "kv_sink_pages",
+                       "kv_prefetch_ahead") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
@@ -306,6 +316,9 @@ class ModelConfig:
             elif k == "kv_audit" and v not in ("off", "on", "strict"):
                 problems.append(
                     f"kv_audit must be off|on|strict, got {v!r}")
+            elif k == "kv_window_policy" and v not in ("demote", "drop"):
+                problems.append(
+                    f"kv_window_policy must be demote|drop, got {v!r}")
             elif k == "draft" and v.lower() not in (
                     "auto", "model", "ngram", "0", "off", "none", "false"):
                 problems.append(

@@ -173,6 +173,31 @@ class EngineConfig:
     # init (version/scope-checked; a mismatched or corrupt file is
     # ignored). "" = no persistence.
     kv_host_store_path: str = ""
+    # --- long-context serving tier (ISSUE 16) ---
+    # snap-back sliding window (SnapStream, arXiv:2511.03092): bound the
+    # on-device KV working set to kv_sink_pages attention-sink head
+    # pages + this many tail pages; the cold middle demotes to the host
+    # tier page by page as decode advances (or drops, see
+    # kv_window_policy), so context length is limited by host RAM, not
+    # HBM. Paged layout + prefix cache only; 0 = off (bit-for-bit the
+    # unwindowed path). Positions stay ABSOLUTE via pos_offset — the
+    # window compacts cache rows, never RoPE positions.
+    kv_window_pages: int = 0
+    # attention-sink head pages pinned on device while a window is
+    # active (StreamingLLM-style: the first tokens anchor attention)
+    kv_sink_pages: int = 1
+    # what happens to the demoted cold middle: "demote" offloads it to
+    # the host tier (restorable — the default), "drop" discards it
+    # under an explicit compression policy, recorded as a first-class
+    # "compress" ledger op so kv_audit=strict stays clean
+    kv_window_policy: str = "demote"
+    # decode-time prefetch-ahead pipeline (PRESERVE, arXiv:2501.08192):
+    # the scheduler scans queued requests each tick and issues
+    # double-buffered host->device restores for the chain links their
+    # admission will need, AHEAD of the admission — at most this many
+    # restore batches in flight. 0 disables (restores happen
+    # synchronously at admission, the pre-PR behavior).
+    kv_prefetch_ahead: int = 2
     # speculative decoding: draft proposals per round (0 disables even
     # when a draft model is loaded); greedy slots only
     n_draft: int = 4
@@ -528,6 +553,22 @@ class _PendingOffload:
                 dv=page(dv_np, i) if dv_np is not None else None)
 
 
+class _PendingPrefetch(_PendingOffload):
+    """A prefetch-ahead restore batch in the sync worker (ISSUE 16).
+
+    The scatter itself was already dispatched by the engine loop (device
+    order protects the upload against later work); this item exists so
+    the sync worker observes the upload's completion in dispatch order
+    and retires the store's inflight gauge. It reuses the offload
+    branch's terminal handling (run + continue, exempt from fault
+    injection) — ``metas``/``store`` keep their slots, ``k_rows`` holds
+    a tiny device handle dependent on the scatter to sync against."""
+
+    def run(self):
+        np.asarray(self.k_rows)      # blocks until the scatter executed
+        self.store.note_prefetch_done()
+
+
 class _Slot:
     __slots__ = (
         "req", "detok", "generated", "held_text", "prompt_len",
@@ -535,6 +576,7 @@ class _Slot:
         "grammar", "gstate", "bias_base", "cur_penalty",
         "phase", "pending", "written", "reused", "cache_len", "committed",
         "mm_pos", "mm_vec", "spec_ok", "ga_blocks", "prio", "preempts",
+        "win_off", "chain_keys",
     )
 
     def __init__(self, req: GenRequest, detok, prompt_len: int):
@@ -561,6 +603,16 @@ class _Slot:
         self.cache_len = 0      # rows occupied in the slot's KV cache
         self.committed = 0      # rows whose KV write has actually executed
         self.ga_blocks = 0      # self-extend: position blocks compressed
+        # snap-back window (ISSUE 16): absolute rows already demoted off
+        # the device (a page multiple). All row coordinates above
+        # (written/committed/cache_len + engine lengths) are COMPACT —
+        # absolute position = compact + win_off, carried to the device
+        # through pos_offset. 0 = unwindowed, every path bit-for-bit.
+        self.win_off = 0
+        # chain keys of the slot's absolute FULL pages, extended lazily
+        # from _cache_tokens as pages fill — (key, parent, depth) per
+        # page, so demotion can offload without rehashing from the root
+        self.chain_keys: list = []
         # priority scheduling (ISSUE 10): class rank (0 = high) and how
         # many times this REQUEST has been preempted (survives resume)
         self.prio = PRIORITY_RANK.get(req.priority, 1)
@@ -650,14 +702,18 @@ class Engine:
         if self.ecfg.kv_layout == "paged" and bus is not None:
             raise ValueError("kv_layout=paged is unsupported in multi-host "
                              "lockstep mode (host-local page tables)")
-        if self.ecfg.kv_layout == "paged" and self.ecfg.ga_n > 1:
-            raise ValueError("kv_layout=paged is incompatible with "
-                             "self-extend (ga_n > 1): grouped-attention "
-                             "compression re-rotates cached keys in place, "
-                             "which page sharing cannot isolate")
-        self._paged = self._fam_llama and self.ecfg.ga_n <= 1 and (
+        # self-extend composes with the paged layout since ISSUE 16: the
+        # in-place key re-rotation is confined to rows past the
+        # compressed region (never the shared/retained pages, whose
+        # delta-0 rewrite is value-identical), cross-slot sharing is
+        # gated off under ga, and the prefix/host scopes fold ga_n/ga_w
+        # in so compressed rows only ever match under the same mapping.
+        # "auto" still degrades to contiguous under ga (the historical
+        # default); opt in with an explicit kv_layout=paged.
+        self._paged = self._fam_llama and (
             self.ecfg.kv_layout == "paged"
-            or (self.ecfg.kv_layout == "auto" and bus is None))
+            or (self.ecfg.kv_layout == "auto" and bus is None
+                and self.ecfg.ga_n <= 1))
         self._pool = None
         self._pcache = None
         self._hstore = None
@@ -686,12 +742,19 @@ class Engine:
             self._pool = PagePool(S, C, pg, self._pool_pages)
             if self.ecfg.kv_prefix_cache:
                 # cross-release page retention; NEVER built for the
-                # contiguous fallbacks (lockstep / self-extend / mamba /
-                # rwkv) — those layouts have no pages to retain
+                # contiguous fallbacks (lockstep / mamba / rwkv) — those
+                # layouts have no pages to retain
                 from localai_tpu.engine import prefix_cache
 
                 scope = prefix_cache.build_scope(
                     self._fam_name, model_cfg, pg, self.ecfg.cache_dtype)
+                if self.ecfg.ga_n > 1:
+                    # self-extend rows are position-COMPRESSED: fold the
+                    # grouping geometry into the scope so they can only
+                    # ever match (device tier, host tier, persisted
+                    # store) under the identical ga_n/ga_w mapping
+                    scope = scope + b"|ga:%d:%d" % (self.ecfg.ga_n,
+                                                    self.ecfg.ga_w)
                 # pool mode: device-tier membership feeds the shared
                 # cross-replica index (prefix-affinity routing) and the
                 # shared store's mapping refcounts
@@ -727,6 +790,42 @@ class Engine:
                                 "kv host store: reloaded %d offloaded "
                                 "pages from %s", n,
                                 self.ecfg.kv_host_store_path)
+        # --- long-context tier (ISSUE 16): snap-back window + prefetch ---
+        self._win_pages = 0
+        self._win_sink = max(0, int(self.ecfg.kv_sink_pages))
+        self._prefetch = None
+        if self.ecfg.kv_window_pages > 0:
+            W = int(self.ecfg.kv_window_pages)
+            if not self._paged or self._pcache is None:
+                raise ValueError(
+                    "kv_window_pages requires the paged KV layout with the "
+                    "prefix cache enabled (kv_prefix_cache=1)")
+            if self.ecfg.kv_window_policy not in ("demote", "drop"):
+                raise ValueError(
+                    "kv_window_policy must be demote|drop, got "
+                    f"{self.ecfg.kv_window_policy!r}")
+            if (self.ecfg.kv_window_policy == "demote"
+                    and self._hstore is None):
+                raise ValueError(
+                    "kv_window_policy=demote requires the host tier "
+                    "(kv_offload=1); use kv_window_policy=drop to run a "
+                    "window without host RAM")
+            if (self._win_sink + W + 2) * pg > C:
+                raise ValueError(
+                    f"kv window does not fit: (sink {self._win_sink} + "
+                    f"window {W} + 2) pages of {pg} rows exceeds "
+                    f"max_context {C}")
+            if self.ecfg.ga_n > 1:
+                raise ValueError(
+                    "kv_window_pages does not compose with self-extend "
+                    "(ga_n > 1): both mechanisms own the slot's RoPE "
+                    "position offset")
+            self._win_pages = W
+        if (self._paged and self._hstore is not None
+                and self.ecfg.kv_prefetch_ahead > 0):
+            from localai_tpu.engine.kv_offload import PrefetchPipeline
+
+            self._prefetch = PrefetchPipeline()
         # device-resident state: big (KV cache), rarely-mutated (bias), or
         # not host-mirrorable (PRNG keys). Everything per-slot and small
         # lives as HOST numpy — admissions/releases are then free in-place
@@ -751,6 +850,11 @@ class Engine:
         self.cur_tokens = np.zeros((S,), np.int32)
         self.active_dev = np.zeros((S,), np.bool_)
         self.pos_offset = np.zeros((S,), np.int32)  # self-extend offsets
+        # snap-back window (ISSUE 16): compact rows each slot demoted
+        # since the last dispatch — subtracted from the device chain's
+        # lengths via override-pack row 6, zeroed after every pack
+        self._win_delta = np.zeros((S,), np.int32)
+        self._adm_win_off = 0   # window offset chosen by _paged_admission
         self._bias_dirty = np.zeros((S,), np.bool_)
         self._shard_state()
 
@@ -969,7 +1073,7 @@ class Engine:
         # reusable host-side staging for per-dispatch overrides and packed
         # segment tables: round-robin pools deep enough that no buffer is
         # rewritten while its async device transfer may still be reading
-        self._ov_pool = [np.empty((6 + sampling.RING_N, S), np.float32)
+        self._ov_pool = [np.empty((7 + sampling.RING_N, S), np.float32)
                          for _ in range(max(6, self.ecfg.pipeline_depth + 4))]
         self._ov_pool_idx = 0
         self._seg_pools: dict = {}   # bucket -> round-robin list of arrays
@@ -1174,18 +1278,22 @@ class Engine:
     def _kv_audit_tick(self, drained: bool = False) -> list:
         """One online audit pass (ISSUE 15), riding the engine-loop
         housekeeping cadence so the pool's host mirrors are never
-        mid-mutation. Detached pages never survive a tick boundary
-        (alloc_detached/unref_detached pair within single calls on this
-        thread), so no extras need declaring. Strict mode lets the
-        KVAuditError propagate — in the live loop that lands in the
-        generic step-failure recovery, in tests it fails the test."""
+        mid-mutation. The only detached pages that survive a tick
+        boundary are the prefetch pipeline's (ISSUE 16) — declared as
+        extras so the leak scan can tell them from orphans; every other
+        alloc_detached/unref_detached pairs within single calls on this
+        thread. Strict mode lets the KVAuditError propagate — in the
+        live loop that lands in the generic step-failure recovery, in
+        tests it fails the test."""
         aud = self._kv_audit
         if aud is None:
             return []
+        extras = ([rec[0] for rec in self._prefetch.pages.values()]
+                  if self._prefetch is not None else None)
         return aud.run(
             self._pool, pcache=self._pcache,
             hstore=self._hstore if self._hstore_owned else None,
-            drained=drained)
+            extra_pages=extras, drained=drained)
 
     def kv_audit_sweep(self, drained: bool = False) -> dict:
         """On-demand full audit pass + snapshot (bench phase ends, CI
@@ -1227,6 +1335,19 @@ class Engine:
         }
         if self._hstore is not None:
             out["host"] = self._hstore.stats()
+        if self._win_pages:
+            out["window"] = {
+                "pages": self._win_pages,
+                "sink_pages": self._win_sink,
+                "policy": self.ecfg.kv_window_policy,
+                "win_off_rows": [
+                    (s.win_off if s is not None else 0) for s in self.slots],
+            }
+        if self._prefetch is not None:
+            out["prefetch"] = {
+                "staged_pages": len(self._prefetch),
+                "seen_rids": len(self._prefetch.seen_rids),
+            }
         return out
 
     def _slo_finish(self, s, ndec: int, t_done: float, ttft_ms: float,
@@ -1384,6 +1505,18 @@ class Engine:
             if s is None and i not in protect and self._pool.owned[i]:
                 self._pool.release(i, 0)
                 self._cache_tokens[i] = []
+        if (self._prefetch is not None and len(self._prefetch)
+                and self._pool.free_pages < need_free):
+            # pool pressure outranks speculation: raid the prefetch
+            # pipeline's staged pages BEFORE evicting retained chains —
+            # staged pages are merely predicted-useful (their content
+            # still lives in the host tier), retained chains are
+            # known-useful. Counted WASTED: the prediction lost to load.
+            drained = self._prefetch.drain()
+            for _key, rec in drained:
+                self._pool.unref_detached(rec[0])
+            if drained and self._hstore is not None:
+                self._hstore.note_prefetch_wasted(len(drained))
         if self._pcache is not None:
             victims = []
             on_evict = None
@@ -1549,27 +1682,15 @@ class Engine:
             self.tracer.record("offload_dispatch", "engine", t0,
                                time.monotonic(), args={"pages": n})
 
-    def _restore_offloaded(self, slot: int, host_hits: list) -> int:
-        """Upload offloaded pages into freshly allocated device rows and
-        splice them onto the slot's table — DISPATCH-THEN-SPLICE: the
-        host->device copy is issued as one async jit call (it overlaps
-        whatever decode bursts are already in flight; by device program
-        order it completes before the slot's prefill reads the rows),
-        the table edit is pure host work, and the serving loop never
-        syncs. Partial allocation under pool pressure degrades to a
-        shorter restored chain (still contiguous from the root).
-        Returns the number of pages actually restored."""
+    def _upload_pages(self, pages: list, host_hits: list):
+        """Dispatch the async host->device scatter copying ``host_hits``
+        (host-tier entries) into ``pages`` (allocated device page ids,
+        same order/length), draft planes included — the shared upload
+        half of _restore_offloaded, the windowed admission, and the
+        prefetch tick. Pure dispatch: no table edits, no host syncs; by
+        device program order the copy completes before any later
+        dispatch reads the rows."""
         pool = self._pool
-        pages = pool.alloc_many(len(host_hits))
-        if len(pages) < len(host_hits):
-            self._reclaim_pages(slot, len(host_hits) - len(pages))
-            pages.extend(pool.alloc_many(len(host_hits) - len(pages)))
-        host_hits = host_hits[:len(pages)]
-        if not host_hits:
-            for p in pages:
-                pool.unref_detached(p)
-            return 0
-        t0 = time.monotonic()
         n = len(host_hits)
         B = 1
         while B < n:
@@ -1609,6 +1730,30 @@ class Engine:
             with self._annot("kv_restore_scatter_draft"):
                 self.dck, self.dcv = self._get_restore_scatter_fn(B2)(
                     self.dck, self.dcv, didx, dks, dvs)
+
+    def _restore_offloaded(self, slot: int, host_hits: list) -> int:
+        """Upload offloaded pages into freshly allocated device rows and
+        splice them onto the slot's table — DISPATCH-THEN-SPLICE: the
+        host->device copy is issued as one async jit call (it overlaps
+        whatever decode bursts are already in flight; by device program
+        order it completes before the slot's prefill reads the rows),
+        the table edit is pure host work, and the serving loop never
+        syncs. Partial allocation under pool pressure degrades to a
+        shorter restored chain (still contiguous from the root).
+        Returns the number of pages actually restored."""
+        pool = self._pool
+        pages = pool.alloc_many(len(host_hits))
+        if len(pages) < len(host_hits):
+            self._reclaim_pages(slot, len(host_hits) - len(pages))
+            pages.extend(pool.alloc_many(len(host_hits) - len(pages)))
+        host_hits = host_hits[:len(pages)]
+        if not host_hits:
+            for p in pages:
+                pool.unref_detached(p)
+            return 0
+        t0 = time.monotonic()
+        n = len(host_hits)
+        self._upload_pages(pages, host_hits)
         for e, p in zip(host_hits, pages[:n]):
             pool.adopt(slot, p)
             # restored pages re-enter the device tier immediately: the
@@ -1642,7 +1787,314 @@ class Engine:
             shared = rows
         return shared
 
-    def _paged_admission(self, slot: int, ids: list, common: int) -> int:
+    def _prefetch_tick(self):
+        """Decode-time prefetch-ahead (ISSUE 16, tentpole): scan the
+        admission queue's head, predict which HOST-TIER chain links each
+        request's admission will restore, and upload them into detached
+        device pages NOW — overlapped with the decode bursts already in
+        flight — so the admission finds the rows resident and the
+        synchronous restore cost drops off TTFT (PRESERVE,
+        arXiv:2501.08192). Window-aware: with the snap-back window armed
+        only the sink + tail-window links are fetched, so speculation
+        never pulls the cold middle a windowed admission would skip.
+        Never evicts truth for speculation: fetches stop at the pool's
+        free headroom and a failed alloc simply ends the pass."""
+        pf = self._prefetch
+        pf.tick += 1
+        expired = pf.expire()
+        if expired:
+            for _key, rec in expired:
+                self._pool.unref_detached(rec[0])
+            self._hstore.note_prefetch_wasted(len(expired))
+        ahead = max(1, int(self.ecfg.kv_prefetch_ahead))
+        with self._queue.mutex:
+            reqs = list(self._queue.queue)[:ahead]
+        if not reqs:
+            return
+        pool = self._pool
+        pg = pool.page_size
+        C = self.ecfg.max_context
+        budget = 4 * ahead * max(1, self._win_pages or 8)  # pages/tick
+        for req in reqs:
+            if budget <= 0:
+                break
+            rid = req.request_id
+            if rid in pf.seen_rids or req.mm_vectors is not None:
+                continue
+            pf.seen_rids.add(rid)
+            ids = list(req.prompt_ids)
+            # mirror _start_request's head truncation — keys past it
+            # would be fetched for a prompt that will never admit them
+            max_prompt = C - 1 - min(req.max_new_tokens, C // 4)
+            if len(ids) > max_prompt:
+                ids = ids[-max_prompt:]
+            n_links = (len(ids) - 1) // pg
+            if n_links <= 0:
+                continue
+            keys = []
+            for i, key in enumerate(self._pcache.chain_keys(ids)):
+                if i >= n_links:
+                    break
+                keys.append(key)
+            d = 0                      # device-resident chain depth
+            while d < len(keys) and self._pcache.contains(keys[d]):
+                d += 1
+            n_avail = d
+            while n_avail < len(keys) and (
+                    keys[n_avail] in pf.pages
+                    or self._hstore.contains(keys[n_avail])):
+                n_avail += 1
+            if n_avail <= d:
+                continue
+            sink, W = self._win_sink, self._win_pages
+            if W and n_avail > sink + W:
+                wanted = list(range(sink)) + list(range(n_avail - W,
+                                                        n_avail))
+            else:
+                wanted = list(range(n_avail))
+            fetch = [i for i in wanted
+                     if i >= d and keys[i] not in pf.pages][:budget]
+            if not fetch:
+                continue
+            if pool.free_pages < len(fetch) + 4:
+                break                  # headroom guard: truth first
+            ents = []
+            for i in fetch:
+                e = self._hstore.get(keys[i])
+                if e is None:
+                    break              # hole opened since the probe
+                ents.append(e)
+            if not ents:
+                continue
+            pages = pool.alloc_many(len(ents))
+            if len(pages) < len(ents):
+                # speculation never reclaims: give back and stop
+                for p in pages:
+                    pool.unref_detached(p)
+                break
+            self._upload_pages(pages, ents)
+            for e, p in zip(ents, pages):
+                pf.register(e.key, e.parent, p, e.depth)
+            self._hstore.note_prefetch_issued(len(ents))
+            budget -= len(ents)
+            # completion probe rides the sync worker in dispatch order:
+            # a scalar slice of the post-scatter cache blocks exactly
+            # until this batch's upload executed, then retires the
+            # store's inflight gauge — the /debug/kv restore depth
+            leaf = jax.tree.leaves(self.ck)[0]
+            self._sync_q.put(_PendingPrefetch(
+                [], leaf[(0,) * leaf.ndim], None, self._hstore))
+
+    def _abs_chain_keys(self, slot: int, s, upto_page: int) -> list:
+        """Absolute chain keys for the slot's first ``upto_page`` full
+        pages, extended incrementally from its absolute token history
+        and cached on the slot (ISSUE 16). A windowed slot's compact
+        table no longer maps 1:1 onto its token stream, so window
+        advance / release derive offload keys from the ABSOLUTE stream
+        — O(new pages) per call, not O(context) per advance."""
+        keys = s.chain_keys
+        toks = self._cache_tokens[slot]
+        pg = self._pool.page_size
+        upto_page = min(upto_page, len(toks) // pg)
+        if len(keys) < upto_page:
+            parent = keys[-1] if keys else kvcache.PAGE_HASH_ROOT
+            scope = self._pcache.scope
+            for i in range(len(keys), upto_page):
+                parent = kvcache.page_chain_hash(
+                    parent, toks[i * pg:(i + 1) * pg], scope)
+                keys.append(parent)
+        return keys
+
+    def _advance_window(self, i: int, upcoming: int):
+        """Snap-back window advance (ISSUE 16): before dispatching work
+        that would push slot i's compact rows past the bounded working
+        set ((sink + window) pages), demote the oldest non-sink FULL
+        committed pages out of the table. Policy "demote" first
+        offloads their content to the host tier (the async gather is
+        dispatched BEFORE pool.demote can recycle the pages — device
+        program order protects the copy, same as _reclaim_pages);
+        policy "drop" records an explicit ledger "compress" op instead,
+        so the auditor sees the rows leave by policy, not by leak.
+        Compact coordinates then re-base: lengths/committed/written
+        shrink by the demoted rows while pos_offset/win_off grow by the
+        same amount — RoPE positions stay ABSOLUTE — and _win_delta
+        carries the length rebase into an in-flight decode chain
+        without forcing an override."""
+        s = self.slots[i]
+        if (not self._win_pages or not self._paged or s is None
+                or s.mm_pos is not None or self.ecfg.ga_n > 1):
+            # ga rotation owns pos_offset; the window never composes
+            # with it (windowed admission is already ga-gated too)
+            return
+        pool = self._pool
+        pg = pool.page_size
+        sink = self._win_sink
+        rows = max(int(self.lengths[i]), s.written) + max(0, upcoming)
+        budget = (sink + self._win_pages) * pg
+        if rows <= budget:
+            return
+        k = pool.pages_for(rows - budget)
+        # only fully COMMITTED pages may leave (uncommitted speculative
+        # rows must stay rollback-able), and never the sinks
+        k = min(k, s.committed // pg - sink)
+        if k <= 0:
+            return
+        start_abs = s.win_off // pg + sink
+        if self.ecfg.kv_window_policy == "demote":
+            victims = []
+            keys = self._abs_chain_keys(i, s, start_abs + k)
+            for t in range(min(k, len(keys) - start_abs)):
+                ap = start_abs + t
+                if self._hstore.contains(keys[ap]):
+                    continue
+                parent = keys[ap - 1] if ap > 0 else kvcache.PAGE_HASH_ROOT
+                victims.append((keys[ap], parent, ap,
+                                int(pool.ptab[i, sink + t])))
+            if victims:
+                self._dispatch_offload(victims)
+        elif pool.audit is not None:
+            # drop policy: the middle rows are compressed away — a
+            # first-class lifecycle op, not a leak
+            pool.audit.ledger.record("compress", slot=i)
+        pool.demote(i, sink, k)
+        delta = k * pg
+        self.lengths[i] -= delta
+        self.pos_offset[i] += delta
+        s.win_off += delta
+        s.committed -= delta
+        s.written -= delta
+        s.cache_len = max(0, s.cache_len - delta)
+        if self._chain is not None:
+            self._win_delta[i] += delta
+
+    def _windowed_admission(self, slot: int, ids: list, cap: int,
+                            cached_pages: list, rid: str = ""):
+        """Snap-back window at (re-)admission (ISSUE 16): when the
+        two-tier chain covers more of the prompt than the bounded
+        on-device working set (sink + window pages), splice/restore ONLY
+        the attention-sink head and the tail window. The cold middle
+        never touches the device — it stays retained device-side or in
+        the host tier — and the slot's compact row coordinates re-base
+        by ``win_off`` = the skipped middle rows (positions stay
+        absolute via pos_offset). Returns the compact reused row count
+        (stashing self._adm_win_off for _start_request), or None to fall
+        through to the unwindowed admission path."""
+        pool = self._pool
+        pg = pool.page_size
+        sink, W = self._win_sink, self._win_pages
+        d = len(cached_pages)
+        # phase 1: availability over the whole chain with cheap
+        # membership probes only — no LRU touch, no CRC on the middle
+        # links the selection will skip (a 128k chain must not pay a
+        # full-store CRC walk per admission)
+        keys = []
+        for i, key in enumerate(self._pcache.chain_keys(ids)):
+            if i >= cap // pg:
+                break           # always leave >= 1 token to prefill
+            keys.append(key)
+        n_avail = d
+        while n_avail < len(keys):
+            key = keys[n_avail]
+            if ((self._prefetch is not None
+                 and key in self._prefetch.pages)
+                    or self._hstore.contains(key)):
+                n_avail += 1
+            else:
+                break
+        n_avail = min(n_avail, len(keys))
+        if n_avail <= sink + W:
+            return None         # fits the working set: no window needed
+        while True:
+            sel = list(range(sink)) + list(range(n_avail - W, n_avail))
+            # device-resident selected links are always a PREFIX of the
+            # compact order (the device tier is prefix-closed, so the
+            # links it holds are exactly [0, d))
+            splice_pages = [cached_pages[i] for i in sel if i < d]
+            rest = [i for i in sel if i >= d]
+            fetched = []        # (abs link, key, prefetch rec | entry)
+            failed_at = -1
+            for i in rest:
+                key = keys[i]
+                rec = (self._prefetch.claim(key)
+                       if self._prefetch is not None else None)
+                if rec is not None:
+                    fetched.append((i, key, rec))
+                    continue
+                e = self._hstore.get(key)
+                if e is None:
+                    failed_at = i
+                    break
+                fetched.append((i, key, e))
+            if failed_at < 0:
+                break
+            # a link vanished between probe and get (budget eviction,
+            # CRC drop): shrink availability to the hole and reselect;
+            # claimed prefetch pages go back on the shelf first
+            for i, key, rec in fetched:
+                if isinstance(rec, list):
+                    self._prefetch.register(key, rec[1], rec[0], rec[2])
+            n_avail = failed_at
+            if n_avail <= sink + W:
+                return None
+        ents = [r for _i, _k, r in fetched if not isinstance(r, list)]
+        pages = pool.alloc_many(len(ents))
+        if len(pages) < len(ents):
+            self._reclaim_pages(slot, len(ents) - len(pages))
+            pages.extend(pool.alloc_many(len(ents) - len(pages)))
+        if len(pages) < len(ents):
+            # a partial window would leave holes mid-table — give the
+            # pages back and let the unwindowed path degrade gracefully
+            for p in pages:
+                pool.unref_detached(p)
+            for i, key, rec in fetched:
+                if isinstance(rec, list):
+                    self._prefetch.register(key, rec[1], rec[0], rec[2])
+            return None
+        pool.release(slot, 0)
+        pool.splice(slot, splice_pages)
+        if ents:
+            self._upload_pages(pages, ents)
+        pi = 0
+        n_pre = 0
+        for i, key, rec in fetched:
+            if isinstance(rec, list):
+                page = rec[0]       # prefetched: rows already on device
+                n_pre += 1
+            else:
+                page = pages[pi]
+                pi += 1
+            pool.adopt(slot, page)
+            if i < sink:
+                # device-tier re-entry only for links that keep the tier
+                # prefix-closed (the contiguous sink continuation of the
+                # device chain); tail-window pages ride the table alone
+                # and free with it
+                self._pcache.attach(
+                    pool, key,
+                    rec[1] if isinstance(rec, list) else rec.parent,
+                    page, i)
+        if n_pre:
+            self._hstore.note_prefetch_hit(n_pre)
+        if ents:
+            self._hstore.note_restore(len(ents))
+            if (self._prefetch is not None
+                    and rid in self._prefetch.seen_rids):
+                # the pipeline scanned this request but the admission
+                # still restored synchronously: the prefetch was LATE
+                self._hstore.note_prefetch_late(len(ents))
+        middle = n_avail - sink - W
+        self._adm_win_off = middle * pg
+        if pool.audit is not None:
+            # first-class ledger op: the middle of the chain was
+            # window-compressed out of the on-device working set
+            pool.audit.ledger.record("compress", slot=slot)
+        compact = (sink + W) * pg
+        self._cow_guard(slot, compact)
+        self._pcache.note_hit(compact)
+        return compact
+
+    def _paged_admission(self, slot: int, ids: list, common: int,
+                         rid: str = "") -> int:
         """Paged prefix reuse at admission. Returns the reusable row
         count. Four tiers, best (longest usable prefix) wins:
           1. the slot's OWN retained rows (common — free, pages already
@@ -1660,8 +2112,11 @@ class Engine:
         Tiers 2 and 3 share the min-rows guard (kv_prefix_cache_min_rows)
         so a 1-page BOS match never forces the slow continued-prefill
         path, and either way the first page this request will write is
-        COW-guarded."""
+        COW-guarded. With the snap-back window armed (ISSUE 16) a chain
+        longer than the working set takes _windowed_admission instead,
+        which sets self._adm_win_off; this method always resets it."""
         pool = self._pool
+        self._adm_win_off = 0
         min_rows = max(1, self.ecfg.kv_prefix_cache_min_rows)
         cap = len(ids) - 1              # always leave >= 1 token to prefill
         best_src, best_rows = -1, 0
@@ -1675,6 +2130,12 @@ class Engine:
                 toks = self._cache_tokens[j]
                 limit = len(toks) if sj is None else min(sj.committed,
                                                          sj.prompt_len)
+                if sj is not None and sj.win_off > 0:
+                    # a windowed live source only retains its sink pages
+                    # as a contiguous absolute prefix — everything past
+                    # them sits at compact (shifted) rows share() must
+                    # never alias
+                    limit = min(limit, self._win_sink * pool.page_size)
                 limit = min(limit, cap, pool.slot_rows_capacity(j))
                 n = 0
                 for a, b in zip(toks[:limit], ids):
@@ -1683,30 +2144,72 @@ class Engine:
                     n += 1
                 if n > best_rows:
                     best_src, best_rows = j, n
-        if self._pcache is not None and self.ecfg.ga_n <= 1:
+        if self._pcache is not None:
             cached_pages = self._pcache.match(ids, pool.max_pages)
+            if (self._win_pages and self._hstore is not None
+                    and self.ecfg.ga_n <= 1):
+                win = self._windowed_admission(slot, ids, cap,
+                                               cached_pages, rid=rid)
+                if win is not None:
+                    return win
+            if self.ecfg.ga_n > 1:
+                # self-extend composition (ISSUE 16 satellite): only rows
+                # inside the COMPRESSED region of the new request are
+                # byte-reusable — a compressed row's grouped position
+                # depends solely on its absolute index, never on the
+                # block count, so rows both sides have compressed agree
+                # exactly while the raw tail does not. The scope already
+                # pins ga_n/ga_w; the release path inserts only
+                # fully-compressed pages under the same rule.
+                cap = min(cap, self._ga_c(len(ids)) * self.ecfg.ga_w)
             host_hits = []
+            pre_keys = []
             if self._hstore is not None:
                 # TWO-TIER chain walk: the device tier is prefix-closed
                 # (eviction cascades subtrees), so the host tier can only
                 # CONTINUE the chain past the device pages — same key
-                # sequence, links [d, h) served from offloaded copies
+                # sequence, links [d, h) served from offloaded copies.
+                # Prefetched links (ISSUE 16) are claimed first while
+                # they are the CONTIGUOUS continuation — their rows are
+                # already on device, so they cost an adopt, not a
+                # restore.
                 want = min(pool.max_pages, cap // pool.page_size + 1)
                 for i, key in enumerate(self._pcache.chain_keys(ids)):
                     if i < len(cached_pages):
                         continue
-                    if len(cached_pages) + len(host_hits) >= want:
+                    if (len(cached_pages) + len(pre_keys)
+                            + len(host_hits) >= want):
                         break
+                    if (self._prefetch is not None and not host_hits
+                            and key in self._prefetch.pages):
+                        pre_keys.append(key)
+                        continue
                     e = self._hstore.get(key)
                     if e is None:
                         break
                     host_hits.append(e)
             cached_rows = min(
-                (len(cached_pages) + len(host_hits)) * pool.page_size, cap)
+                (len(cached_pages) + len(pre_keys) + len(host_hits))
+                * pool.page_size, cap)
             if cached_rows >= min_rows and cached_rows > max(common,
                                                             best_rows):
                 pool.release(slot, 0)
                 pool.splice(slot, cached_pages)
+                n_pre = 0
+                for key in pre_keys:
+                    rec = self._prefetch.claim(key)
+                    if rec is None:     # claimed away mid-admission
+                        break
+                    # the pipeline's detached reference transfers to the
+                    # table; attach re-enters the device tier (the chain
+                    # stays prefix-closed — these links continue it)
+                    pool.adopt(slot, rec[0])
+                    self._pcache.attach(pool, key, rec[1], rec[0], rec[2])
+                    n_pre += 1
+                if n_pre:
+                    self._hstore.note_prefetch_hit(n_pre)
+                if n_pre < len(pre_keys):
+                    host_hits = []      # chain has a hole past the claim
                 restored = 0
                 if host_hits:
                     # dispatch-then-splice (see _restore_offloaded): the
@@ -1714,9 +2217,14 @@ class Engine:
                     # restore under pool pressure shortens the reuse,
                     # never fails the admission
                     restored = self._restore_offloaded(slot, host_hits)
-                    cached_rows = min(
-                        (len(cached_pages) + restored) * pool.page_size,
-                        cap)
+                    if (self._prefetch is not None
+                            and rid in self._prefetch.seen_rids):
+                        # scanned by the pipeline, restored sync anyway:
+                        # the prefetch lost the race — LATE
+                        self._hstore.note_prefetch_late(restored)
+                cached_rows = min(
+                    (len(cached_pages) + n_pre + restored)
+                    * pool.page_size, cap)
                 if cached_rows == 0:
                     # pathological: nothing spliced and nothing restored
                     self._pcache.note_miss()
@@ -1728,7 +2236,7 @@ class Engine:
                 self._cow_guard(slot, cached_rows)
                 self._pcache.note_hit(cached_rows)
                 return cached_rows
-            if self._hstore is not None and not host_hits \
+            if self._hstore is not None and not host_hits and not pre_keys \
                     and len(ids) // pool.page_size > len(cached_pages):
                 # the host tier was consulted past the device chain and
                 # had nothing usable — the restore-miss path: plain
@@ -1746,18 +2254,24 @@ class Engine:
     # ---------- jitted step bodies ----------
 
     def _compose_overrides(self, tokens, lengths, ring, ring_pos, mu, ov_pack):
-        """Merge host override rows (ONE packed [6+RING_N, S] f32 upload:
-        mask, tokens, lengths, ring_pos, mu, pos_offset, ring.T) into the
-        chain state. pos_offset (self-extend) is NOT override-gated — it is
-        current host truth every dispatch."""
+        """Merge host override rows (ONE packed [7+RING_N, S] f32 upload:
+        mask, tokens, lengths, ring_pos, mu, pos_offset, win_delta,
+        ring.T) into the chain state. pos_offset (self-extend / snap-back
+        window) is NOT override-gated — it is current host truth every
+        dispatch. win_delta (ISSUE 16) is an unconditional SUBTRACT from
+        the chained device lengths: a window advance re-bases a slot's
+        compact rows mid-chain without forcing an override (and therefore
+        without a host sync); overridden slots carry already-rebased host
+        lengths, so _pack_ov zeroes their delta to avoid double-counting."""
         ov_mask = ov_pack[0] > 0
         tokens = jnp.where(ov_mask, ov_pack[1].astype(jnp.int32), tokens)
-        lengths = jnp.where(ov_mask, ov_pack[2].astype(jnp.int32), lengths)
+        lengths = jnp.where(ov_mask, ov_pack[2].astype(jnp.int32), lengths) \
+            - ov_pack[6].astype(jnp.int32)
         ring_pos = jnp.where(ov_mask, ov_pack[3].astype(jnp.int32),
                              jnp.asarray(ring_pos))
         mu = jnp.where(ov_mask, ov_pack[4], jnp.asarray(mu))
         pos_offset = ov_pack[5].astype(jnp.int32)
-        ring = jnp.where(ov_mask[:, None], ov_pack[6:].T.astype(jnp.int32),
+        ring = jnp.where(ov_mask[:, None], ov_pack[7:].T.astype(jnp.int32),
                          jnp.asarray(ring))
         return tokens, lengths, ring, ring_pos, mu, pos_offset
 
@@ -2567,6 +3081,13 @@ class Engine:
         if self._paged:
             from localai_tpu.engine.paging import PagePool
 
+            if self._prefetch is not None:
+                # staged prefetch pages die with the pool below — drop
+                # the bookkeeping (no unref: the fresh pool has no
+                # record of them) and count the batch WASTED
+                n = len(self._prefetch.drain())
+                if n and self._hstore is not None:
+                    self._hstore.note_prefetch_wasted(n)
             self._pool = PagePool(S, self.ecfg.max_context,
                                   self._pool.page_size,
                                   self._pool_pages)
@@ -2604,6 +3125,7 @@ class Engine:
         self._cache_tokens = [[] for _ in range(S)]
         self._prefill_queue = []
         self._chain = None
+        self._win_delta.fill(0)   # no chain left to rebase (ISSUE 16)
         self._override = set()
         self._fifo.clear()
         self._fork_waiters = {}
@@ -3208,6 +3730,11 @@ class Engine:
                     else False
                 admitted = self._admit()
                 self._tmark("admit", t0)
+                if self._prefetch is not None:
+                    # prefetch-ahead for the requests STILL queued after
+                    # this tick's admissions (ISSUE 16): their host-tier
+                    # restores overlap the decode work dispatched below
+                    self._prefetch_tick()
                 t0 = time.monotonic()
                 prefilled = self._prefill_step()
                 self._tmark("prefill", t0)
@@ -3928,16 +4455,26 @@ class Engine:
             # non-llama families have no positional KV rows to share —
             # prefix reuse and prompt-cache restore are llama-only
             common = 0
+        win_off = 0
         if self._paged:
-            if self.ecfg.ga_n > 1 or mm_pos is not None:
-                # no reuse or sharing for these: recycle the slot's
+            if mm_pos is not None:
+                # no reuse or sharing for image rows: recycle the slot's
                 # retained pages into the pool
                 self._pool.release(slot, 0)
             else:
                 # paged reuse: own retained pages, or copy-on-write page
-                # sharing from ANY slot's prefix (zero KV row copies)
-                common = self._paged_admission(slot, ids, common)
-        if self._fam_llama and self.ecfg.ga_n <= 1 and mm_pos is None:
+                # sharing from ANY slot's prefix (zero KV row copies).
+                # Under self-extend only the tier-3 compressed-region
+                # reuse applies (gated inside, ISSUE 16 satellite).
+                common = self._paged_admission(slot, ids, common,
+                                               rid=req.request_id)
+                # snap-back admission (ISSUE 16): ``common`` is COMPACT
+                # (sink + window rows); win_off is the skipped middle
+                win_off = self._adm_win_off
+        if self._fam_llama and self.ecfg.ga_n <= 1 and mm_pos is None \
+                and win_off == 0:
+            # (the disk prompt cache stores contiguous rows — a windowed
+            # table has no contiguous image to overlay, skip it)
             common = self._restore_prompt_cache(slot, req, ids, common)
 
         # install sampling state for the slot
@@ -4026,9 +4563,19 @@ class Engine:
             s.spec_ok = False
         if s.spec_ok and self._spec_mode == "model":
             self._ensure_draft_cache()
-        s.pending = ids[common:]
+        s.win_off = win_off
+        if win_off:
+            # compact coordinates: the reused prefix covers the absolute
+            # rows [0, sink) ++ [win_off + sink_rows, win_off + common);
+            # pending resumes past the absolute end of the window. RoPE
+            # stays absolute via pos_offset (set after _init_ga below).
+            s.pending = ids[win_off + common:]
+        else:
+            s.pending = ids[common:]
         s.written = common
         s.reused = common
+        if win_off:
+            self.pos_offset[slot] = win_off
         # multimodal rows are image embeddings, not token embeddings — a
         # later text request must never "reuse" them as a token prefix
         self._cache_tokens[slot] = [] if mm_pos is not None else list(ids)
@@ -4441,6 +4988,54 @@ class Engine:
         self._sync_q.put(item)
         return True
 
+    def _prefill_win_piece(self, slot: int, s: "_Slot") -> bool:
+        """One prefill piece for a snap-back-windowed slot (ISSUE 16):
+        cache rows are COMPACT (s.written) but RoPE positions are
+        ABSOLUTE (win_off + written + t), so the piece rides the
+        explicit-positions programs self-extend already compiled — same
+        shapes, different position map, zero new program variants.
+        Singly, like ga pieces: the packed/ragged programs derive
+        positions from the cache row."""
+        chunk = self._chunk
+        remaining = len(s.pending)
+        final = remaining <= chunk
+        take = remaining if final else chunk
+        bucket = self._bucket_for(take) if final else chunk
+        positions = np.zeros((1, bucket), np.int32)
+        positions[0, :take] = s.win_off + s.written + np.arange(
+            take, dtype=np.int32)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :take] = s.pending[:take]
+        self._ensure_pages(slot, s.written + take)
+        self._commit_ptab()
+        t0 = time.monotonic()
+        if not final:
+            self.ck, self.cv = self._get_ga_chunk_fn(bucket)(
+                self.params, tokens, np.array([take], np.int32), self.ck,
+                self.cv, np.array([slot], np.int32),
+                np.array([s.written], np.int32), positions)
+            s.pending = s.pending[take:]
+            s.written += take
+            s.committed = s.written
+            s.t_prefill_ms += (time.monotonic() - t0) * 1e3
+            return True
+        out_ids, logprobs, self.ck, self.cv, self.rng_keys, mu_out = \
+            self._get_ga_final_fn(bucket, s.written > 0)(
+                self.params, tokens, np.array([take], np.int32), self.ck,
+                self.cv, np.array([slot], np.int32),
+                np.array([s.written], np.int32),
+                self.ring.copy(), self.ring_pos.copy(), self.bias,
+                self.rng_keys, sampling.pack_slot_params(self.slot_params),
+                self.mu.copy(), positions)
+        s.pending = []
+        s.written += take
+        if slot in self._prefill_queue:
+            self._prefill_queue.remove(slot)
+        item = _PendingPrefill([(slot, s)], out_ids, logprobs, mu_out, t0)
+        self._fifo.append(item)
+        self._sync_q.put(item)
+        return True
+
     def _init_ga(self, slot: int, s: "_Slot", P: int):
         """Set the slot's self-extend state for a fresh P-token ingestion."""
         if self.ecfg.ga_n <= 1 or s.mm_pos is not None:
@@ -4527,6 +5122,15 @@ class Engine:
             # positions, singly (never grouped or fused)
             return self._prefill_ga_piece(slot, s)
 
+        if self._win_pages:
+            # snap-back during INGESTION too: a fresh long prompt must
+            # never grow the device working set past the window — demote
+            # committed middle pages before the next chunk lands, then
+            # prefill at explicit absolute positions
+            self._advance_window(slot, min(len(s.pending), self._chunk))
+            if s.win_off > 0:
+                return self._prefill_win_piece(slot, s)
+
         # RAGGED PACKED PREFILL (module doc): when the head slot is
         # eligible, one dispatch packs EVERY eligible queued slot's
         # pending tail under the token budget — replacing per-slot
@@ -4594,7 +5198,8 @@ class Engine:
                     break
                 so = self.slots[other]
                 if so is None or so.phase != "prefill" \
-                        or so.mm_pos is not None or so.ga_blocks > 0:
+                        or so.mm_pos is not None or so.ga_blocks > 0 \
+                        or so.win_off > 0:
                     continue
                 of, ot, ob, oc = self._prefill_plan(other)
                 if of and not oc and ob == bucket:
@@ -4696,7 +5301,7 @@ class Engine:
         their draft-cache mirror rides a packed ragged program of its
         own (_get_draft_packed_fn), dispatched right behind the
         target's."""
-        return s.mm_pos is None and s.ga_blocks == 0
+        return s.mm_pos is None and s.ga_blocks == 0 and s.win_off == 0
 
     def _prefill_step_packed(self) -> bool:
         """ONE ragged dispatch for this tick's prompt ingestion: walk the
@@ -5322,7 +5927,17 @@ class Engine:
         p[3] = self.ring_pos
         p[4] = self.mu
         p[5] = self.pos_offset
-        p[6:] = self.ring.T
+        # window-advance length rebase (ISSUE 16): subtracted from the
+        # chained device lengths unconditionally; overridden slots take
+        # their (already rebased) host lengths instead, so their delta
+        # must not apply on top — and a COLD dispatch feeds rebased host
+        # lengths for EVERY slot, so the whole delta row drops
+        if self._chain is None:
+            self._win_delta.fill(0)
+        p[6] = self._win_delta
+        p[6][np.asarray(ov_mask, bool)] = 0.0
+        self._win_delta.fill(0)
+        p[7:] = self.ring.T
         return p
 
     def _pack_arrays(self, bucket: int, C: int, S: int) -> tuple:
@@ -5675,6 +6290,10 @@ class Engine:
         mask = np.zeros((S,), np.bool_)
         for i in included:
             s = self.slots[i]
+            # windowed slots decode singly: spec verify rows assume
+            # row == position, which the snap-back rebase breaks
+            if s.win_off > 0:
+                continue
             if s.spec_ok and C - 2 - (s.cache_len + infl[i]) >= W:
                 mask[i] = True
         if not mask.any():
@@ -5744,6 +6363,14 @@ class Engine:
             included.append(i)
         if not included:
             return False
+        if self._win_pages:
+            # snap-back BEFORE planning/ensure: demote cold middle pages
+            # so the upcoming steps land inside the bounded working set
+            # (the rebase rides _win_delta into the chain, so no
+            # override — and no host sync — is forced)
+            upcoming = self.ecfg.decode_burst * (self.ecfg.n_draft + 1) + 2
+            for i in included:
+                self._advance_window(i, infl[i] + upcoming)
         plan = self._plan_spec(included, infl)
         W = self.ecfg.n_draft + 1
         if plan is not None:
@@ -6140,7 +6767,7 @@ class Engine:
         elif s.n_decoded >= s.req.max_new_tokens:
             finish = "length"
             delta = s.held_text + s.detok.push(token_id) + s.detok.flush()
-        elif s.cache_len + 1 >= self.ecfg.max_context - 1:
+        elif s.win_off + s.cache_len + 1 >= self.ecfg.max_context - 1:
             if self.ecfg.context_shift:
                 delta = s.held_text + s.detok.push(token_id)
                 s.held_text = ""
@@ -6286,7 +6913,7 @@ class Engine:
             return self._rollback_grammar(slot, s)
         elif s.n_decoded >= s.req.max_new_tokens:
             finish = "length"
-        elif s.cache_len + 1 >= self.ecfg.max_context - 1:
+        elif s.win_off + s.cache_len + 1 >= self.ecfg.max_context - 1:
             if self.ecfg.context_shift:
                 # the emitter still stop-scans this token; a stop that
                 # completes here aborts the shifted slot via the note
@@ -6511,24 +7138,53 @@ class Engine:
             # sharing this history can still splice them), then give the
             # table back and re-allocate lazily per chunk — never
             # rewrite a page another slot or the cache reads
-            if self._pcache is not None:
+            if s.win_off > 0:
+                # windowed slot (ISSUE 16): sink-only retention + tail
+                # offload — the compact table has no contiguous absolute
+                # image for a full insert
+                self._retire_window(slot, s)
+            elif self._pcache is not None:
+                n_ins = s.committed
+                if self.ecfg.ga_n > 1:
+                    # fully-compressed rows only (see _release_slot)
+                    n_ins = min(n_ins, s.ga_blocks * self.ecfg.ga_w)
                 self._pcache.insert(self._pool, slot,
-                                    self._cache_tokens[slot][:s.committed])
+                                    self._cache_tokens[slot][:n_ins])
             self._pool.release(slot, 0)
         s.phase = "prefill"
         s.pending = list(new_ids)
         s.written = 0
         s.cache_len = 0
         s.committed = 0
+        s.win_off = 0
+        s.chain_keys = []       # the token stream is re-based: new chain
+        self._cache_tokens[slot] = list(new_ids)
+        reused = 0
+        if (self._paged and self._pcache is not None and s.mm_pos is None
+                and self.ecfg.ga_n <= 1):
+            # re-prefill reuse (ISSUE 16 satellite): the kept tail is the
+            # SUFFIX of history this slot just retained/offloaded page by
+            # page — but chain keys hash from the stream ROOT, so only a
+            # kept window whose pages were retained under the SAME root
+            # (e.g. a prior shift or a shared conversation prefix) can
+            # splice. When it can, the shift's re-prefill shrinks to the
+            # un-cached tail via the ordinary admission tiers, COW pages
+            # and all, instead of recomputing the whole half-context.
+            reused = self._paged_admission(slot, new_ids, 0,
+                                           rid=s.req.request_id)
+            s.win_off = self._adm_win_off
+            s.pending = new_ids[reused + s.win_off:]
+            s.written = reused
+            s.reused = reused
         self._init_ga(slot, s, len(new_ids))
+        if s.win_off:
+            self.pos_offset[slot] = s.win_off
         self.active_dev[slot] = False
         self.lengths[slot] = 0
         # restart the penalty ring from the kept window
         self.ring, self.ring_pos = sampling.set_slot_ring(
             self.ring, self.ring_pos, slot, new_ids)
         self._prefill_queue.append(slot)
-        # prefix matching against a mid-shift slot cannot happen (occupied)
-        self._cache_tokens[slot] = list(new_ids)
         # every in-flight burst dispatched before the shift sampled tokens
         # conditioned on the discarded context — drop this slot from them
         # (same invalidation rule as _rollback_grammar / self-extend)
@@ -6560,11 +7216,53 @@ class Engine:
             return delta[:-hold], delta[-hold:]
         return delta, ""
 
+    def _retire_window(self, slot: int, s: "_Slot") -> int:
+        """Shared windowed-slot retirement (ISSUE 16): the table holds
+        sinks ++ tail window at COMPACT rows, so only the sink prefix is
+        contiguous absolute truth the device tier may retain. The
+        committed tail-window pages are offloaded under their ABSOLUTE
+        chain keys first (with policy=demote the middle is already host-
+        resident, so the whole chain survives for a future windowed
+        re-admission), the sinks are retained, and the sink row count is
+        returned for the caller's release/trim."""
+        pool = self._pool
+        pg = pool.page_size
+        n_full = min(s.committed // pg, int(pool.owned[slot]))
+        sink = min(self._win_sink, n_full)
+        if self._hstore is not None and self._pcache is not None:
+            base = s.win_off // pg
+            keys = self._abs_chain_keys(slot, s, base + n_full)
+            victims = []
+            for t in range(sink, n_full):
+                ap = base + t
+                if ap >= len(keys) or self._hstore.contains(keys[ap]):
+                    continue
+                parent = keys[ap - 1] if ap > 0 else kvcache.PAGE_HASH_ROOT
+                victims.append((keys[ap], parent, ap,
+                                int(pool.ptab[slot, t])))
+            if victims:
+                self._dispatch_offload(victims)
+        if self._pcache is not None and sink > 0:
+            self._pcache.insert(pool, slot,
+                                self._cache_tokens[slot][:sink * pg])
+        return sink * pg
+
     def _release_slot(self, slot: int):
         # _cache_tokens is intentionally preserved (trimmed to rows whose KV
         # write actually executed) — the slot's rows stay valid and a future
         # request sharing a prefix reuses them
         s = self.slots[slot]
+        if s is not None and s.win_off > 0 and self._paged:
+            # snap-back window (ISSUE 16): compact bookkeeping no longer
+            # maps 1:1 onto the absolute token history — retire via the
+            # windowed path (offload tail, retain sinks only)
+            sink_rows = self._retire_window(slot, s)
+            self._pool.release(slot, sink_rows)
+            self._cache_tokens[slot] = self._cache_tokens[slot][:sink_rows]
+            self.slots[slot] = None
+            self.active_dev[slot] = False
+            self.lengths[slot] = 0
+            return
         if s is not None:
             self._cache_tokens[slot] = self._cache_tokens[slot][:s.committed]
         if self._paged:
@@ -6572,8 +7270,15 @@ class Engine:
             # still pin the pages): committed full pages enter the
             # token-hash store and survive this slot's next tenant
             if self._pcache is not None:
+                n_ins = len(self._cache_tokens[slot])
+                if self.ecfg.ga_n > 1 and s is not None:
+                    # only FULLY-COMPRESSED rows are stable under
+                    # self-extend (later block completions never rotate
+                    # them again) — the raw tail must not be retained
+                    # under a token key that promises final-form rows
+                    n_ins = min(n_ins, s.ga_blocks * self.ecfg.ga_w)
                 self._pcache.insert(self._pool, slot,
-                                    self._cache_tokens[slot])
+                                    self._cache_tokens[slot][:n_ins])
             # keep the retained prefix's pages in the table too (same
             # reuse story as _cache_tokens — the slot's own next request
             # reuses them for free); everything past returns to the pool

@@ -173,6 +173,67 @@ class RestoreStager:
         return a
 
 
+class PrefetchPipeline:
+    """Decode-time prefetch-ahead bookkeeping (ISSUE 16).
+
+    The engine's prefetch tick scans queued requests, predicts which
+    host-tier chain links their admission will restore, and uploads
+    those pages into DETACHED device pages (refs == 1, owned by this
+    pipeline) AHEAD of the admission — so the restore that would
+    otherwise sit synchronously on the admission path is already done
+    (PRESERVE, arXiv:2501.08192). Admission claims the contiguous
+    prefix of its continuation chain from here; whatever the prediction
+    got wrong ages out and is reclaimed as WASTED.
+
+    Engine-loop-thread only — no locking. The pipeline owns pure
+    bookkeeping: the pool owns the pages (each registered page carries
+    one detached reference that transfers on claim() or is unref'd by
+    the caller on expiry), the HostPageStore owns the counters, and the
+    auditor sees registered pages as caller-declared extras."""
+
+    __slots__ = ("pages", "seen_rids", "tick", "max_age")
+
+    def __init__(self, max_age: int = 64):
+        # chain key -> [page, parent, depth, tick_registered]
+        self.pages: dict[bytes, list] = {}
+        # request ids a prefetch pass has already scanned: a SYNC
+        # restore for one of these means the pipeline predicted the
+        # need but lost the race — that's a PREFETCH_LATE, the metric
+        # the CI gate holds at zero in steady state
+        self.seen_rids: set = set()
+        self.tick = 0
+        self.max_age = int(max_age)
+
+    def __len__(self) -> int:
+        return len(self.pages)
+
+    def register(self, key: bytes, parent: bytes, page: int, depth: int):
+        """Track one restored detached page under its chain key."""
+        self.pages[key] = [int(page), parent, int(depth), self.tick]
+
+    def claim(self, key: bytes):
+        """Take a prefetched page for admission — ownership of the
+        detached reference transfers to the caller. None if the key was
+        never prefetched (or already claimed/expired)."""
+        return self.pages.pop(key, None)
+
+    def expire(self) -> list:
+        """Pop entries older than max_age ticks — the prediction missed
+        (request cancelled upstream, prompt diverged, chain superseded).
+        Returns [(key, [page, parent, depth, tick]), ...]; the caller
+        unrefs each page and counts it WASTED."""
+        cutoff = self.tick - self.max_age
+        old = [k for k, rec in self.pages.items() if rec[3] < cutoff]
+        return [(k, self.pages.pop(k)) for k in old]
+
+    def drain(self) -> list:
+        """Pop everything (pool-pressure raid, device reset)."""
+        out = list(self.pages.items())
+        self.pages.clear()
+        self.seen_rids.clear()
+        return out
+
+
 class HostPageStore:
     """Byte-budgeted host-RAM index of offloaded pages."""
 
@@ -200,6 +261,14 @@ class HostPageStore:
         self.evicted_pages = 0   # host -> gone (budget eviction)
         self.corrupt_dropped = 0  # CRC mismatch at get(): tree dropped
         self.evict_blocked = 0   # budget evictions skipped: key mapped
+        # prefetch-ahead pipeline (ISSUE 16): restores issued BEFORE the
+        # admission/burst that needs them -> localai_kv_prefetch_*_total
+        self.prefetch_issued = 0   # pages restored ahead of need
+        self.prefetch_hits = 0     # prefetched pages claimed by admission
+        self.prefetch_late = 0     # sync restores the pipeline predicted
+        #                            but lost the race on
+        self.prefetch_wasted = 0   # prefetched pages reclaimed unclaimed
+        self.prefetch_inflight = 0  # restore batches in the sync worker
         # lifecycle ledger/auditor (ISSUE 15): attached by the owning
         # engine (owned store) or the EnginePool's SharedKV (shared
         # store); None = zero-cost no-op
@@ -237,6 +306,11 @@ class HostPageStore:
                 "corrupt_dropped": self.corrupt_dropped,
                 "mapped_keys": len(self._mapped),
                 "evict_blocked": self.evict_blocked,
+                "prefetch_issued": self.prefetch_issued,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetch_late": self.prefetch_late,
+                "prefetch_wasted": self.prefetch_wasted,
+                "prefetch_inflight": self.prefetch_inflight,
             }
 
     # ---------- shared-mode mapping refcounts (ISSUE 14) ----------
@@ -383,6 +457,32 @@ class HostPageStore:
     def note_miss(self):
         with self._lock:
             self.misses += 1
+
+    # ---------- prefetch-ahead telemetry (ISSUE 16) ----------
+
+    def note_prefetch_issued(self, n_pages: int):
+        with self._lock:
+            self.prefetch_issued += int(n_pages)
+            self.prefetch_inflight += 1
+            if self.audit is not None:
+                self.audit.ledger.record("prefetch")
+
+    def note_prefetch_done(self):
+        """One prefetch restore batch retired from the sync worker."""
+        with self._lock:
+            self.prefetch_inflight = max(0, self.prefetch_inflight - 1)
+
+    def note_prefetch_hit(self, n_pages: int):
+        with self._lock:
+            self.prefetch_hits += int(n_pages)
+
+    def note_prefetch_late(self, n: int = 1):
+        with self._lock:
+            self.prefetch_late += int(n)
+
+    def note_prefetch_wasted(self, n_pages: int):
+        with self._lock:
+            self.prefetch_wasted += int(n_pages)
 
     def _evict_to_budget_locked(self):
         if self._bytes <= self.budget_bytes:

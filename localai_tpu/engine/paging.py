@@ -224,6 +224,41 @@ class PagePool:
             self.ptab[slot, i] = self.num_pages
             self.dirty = True
 
+    def demote(self, slot: int, start_idx: int, k: int) -> list:
+        """Remove ``k`` table entries starting at ``start_idx`` from the
+        MIDDLE of a live slot's table and compact the tail left — the
+        snap-back window's cold-middle demotion (ISSUE 16). The caller
+        must have already captured the pages' rows (host-tier offload
+        gather dispatched BEFORE this call — program order protects the
+        content, same discipline as _reclaim_pages) or be running an
+        explicit drop/compression policy. Returns the removed page ids;
+        each is unref'd here (freed if this table held the last ref).
+
+        After the shift the slot's table is COMPACT again: owned[] still
+        equals the table-entry count, so the PR-15 auditor's table
+        invariants hold with no special casing — the engine re-bases the
+        slot's row coordinates by ``k * page_size`` to match."""
+        k = int(k)
+        start_idx = int(start_idx)
+        owned = int(self.owned[slot])
+        if k <= 0:
+            return []
+        if start_idx < 0 or start_idx + k > owned:
+            self._fail("demote",
+                       f"demote() range [{start_idx}, {start_idx + k}) "
+                       f"outside owned {owned}", slot=slot)
+        removed = [int(self.ptab[slot, start_idx + i]) for i in range(k)]
+        if self.audit is not None:
+            self.audit.ledger.record("demote", page=removed[0], slot=slot)
+        self.ptab[slot, start_idx:owned - k] = \
+            self.ptab[slot, start_idx + k:owned]
+        self.ptab[slot, owned - k:owned] = self.num_pages
+        self.owned[slot] = owned - k
+        self.dirty = True
+        for p in removed:
+            self.unref_detached(p)
+        return removed
+
     # ---------- sharing / copy-on-write ----------
 
     def share(self, src: int, dst: int, rows: int) -> int:

@@ -115,6 +115,13 @@ class PrefixPageCache:
         auditor's leak-freedom scan counts these as accounted-for."""
         return [e.page for e in self._entries.values()]
 
+    def contains(self, key: bytes) -> bool:
+        """Device-tier membership probe WITHOUT an LRU touch — the
+        prefetch planner (ISSUE 16) uses it to skip pages that are
+        already resident without promoting them over genuinely hot
+        chains."""
+        return key in self._entries
+
     def genealogy(self, limit: int = 64) -> list:
         """Per-chain genealogy for /debug/kv (ISSUE 15): the newest
         ``limit`` entries as {key, parent, page, depth, tick}, keys

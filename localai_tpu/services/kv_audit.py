@@ -11,7 +11,8 @@ lifecycle OBSERVABLE and ENFORCED:
 
 * ``KVLedger`` — a bounded ring of compact per-page transition records
   (alloc/free/share/clone/hold/drop/splice/release/retain/evict/
-  offload/restore/host_evict/adopt/migrate), with per-transition
+  offload/restore/host_evict/adopt/migrate/demote/compress/prefetch),
+  with per-transition
   counters and running live-page/live-hold balances. Fed by hooks in
   the four KV modules, each gated on a single ``audit is not None``
   check so ``kv_audit=off`` constructs nothing and allocates nothing on
@@ -50,7 +51,8 @@ import numpy as np
 #: hooks in paging.py / prefix_cache.py / kv_offload.py / pool.py
 TRANSITIONS = ("alloc", "free", "share", "clone", "hold", "drop",
                "splice", "release", "retain", "evict", "offload",
-               "restore", "host_evict", "adopt", "migrate", "reset")
+               "restore", "host_evict", "adopt", "migrate", "reset",
+               "demote", "compress", "prefetch")
 
 
 class KVLifecycleError(RuntimeError):

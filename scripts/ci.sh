@@ -15,6 +15,7 @@
 #                             since ISSUE 14)
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
+#   LOCALAI_LC_BUDGET_S       long-context phase wall clock (default 300)
 #
 # Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
@@ -244,5 +245,51 @@ print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
 sys.exit(0 if line.get("ok") == 1 and kv_v == 0 and kv_l == 0 else 1)
 PY
 rm -f "$prio_out"
+
+# Long-context serving tier (ISSUE 16): TTFT/ITL vs context length on
+# the snap-back window engine (bounded on-device working set, cold
+# middle demoted to host), the short-prompt byte gate (window machinery
+# invisible until the policy engages), and the decode-time
+# prefetch-ahead pipeline: a warm follow-up turn queued behind decode
+# blockers must find its host-tier links already resident
+# (PREFETCH_HIT >= 1) with zero predicted-but-synchronous restores
+# (PREFETCH_LATE=0), and the deep-chain audit sweep must stay clean.
+echo "== ci: bench longcontext =="
+lc_out=$(mktemp)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=512 \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_LC_BUDGET_S:-300}" \
+    python bench.py --longcontext | tee "$lc_out"
+
+python - "$lc_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{") and "metric" in ln:
+        line = json.loads(ln)
+wl = line.get("windowed_by_len") or {}
+lens = sorted(wl, key=int)
+p99 = {n: wl[n].get("itl_p99_ms") for n in lens}
+print(f"PREFETCH_HIT={line.get('prefetch_hits')} "
+      f"PREFETCH_LATE={line.get('prefetch_late')} "
+      f"PREFETCH_WASTED={line.get('prefetch_wasted')} "
+      f"LC_ITL_P99={p99} "
+      f"itl_p99_ratio={line.get('itl_p99_ratio')} "
+      f"short_byte_match={line.get('short_byte_match')} "
+      f"offloaded_pages={line.get('offloaded_pages')} "
+      f"warm_turn_ttft_ms={line.get('warm_turn_ttft_ms')}")
+# the sweep leaves deep offloaded chains behind — demote / compress /
+# prefetch are first-class ledger ops, so the audit must stay clean
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+if line.get("prefetch_late") != 0:
+    print(f"FAIL: prefetch pipeline went late "
+          f"(late={line.get('prefetch_late')} must be 0 at steady state)")
+    sys.exit(1)
+sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
+PY
+rm -f "$lc_out"
 
 echo "== ci: OK =="

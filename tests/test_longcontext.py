@@ -1,0 +1,368 @@
+"""Long-context serving tier (ISSUE 16): snap-back window compression +
+decode-time KV prefetch-ahead.
+
+The contract under test:
+  - the on-device KV of a windowed slot is a BOUNDED working set
+    (kv_sink_pages pinned head + kv_window_pages tail); the cold middle
+    demotes to the host tier (policy=demote) or drops under an explicit
+    ledger "compress" op (policy=drop) — either way kv_audit=strict
+    stays clean, because compression is a first-class lifecycle op;
+  - compact row coordinates re-base through win_off while RoPE
+    positions stay ABSOLUTE (pos_offset), so a prompt that fits the
+    working set is byte-identical to the unwindowed engine — the window
+    machinery is invisible until the policy engages;
+  - the prefetch pipeline restores a queued request's host-tier links
+    DURING the decode bursts ahead of its admission (PREFETCH_HIT),
+    and a predicted-but-synchronous restore is counted PREFETCH_LATE;
+  - self-extend (ga_n > 1) composes with the paged layout and the
+    host tier: compressed-region rows round-trip byte-exactly through
+    demote -> restore because a compressed row's grouped position
+    depends only on its absolute index (scope pins ga_n/ga_w).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.engine.kv_offload import PrefetchPipeline
+from localai_tpu.models import llama
+from localai_tpu.ops import kvcache
+
+
+class _Tok:
+    eos_token_id = 0
+
+    def decode(self, ids, **kw):
+        return "".join(chr(97 + (i % 26)) for i in ids)
+
+    def convert_ids_to_tokens(self, ids):
+        return [chr(97 + (i % 26)) for i in ids]
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg_params():
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2,
+        max_position_embeddings=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=2, max_context=128, prefill_buckets=(16, 64),
+                prefill_chunk=16, cache_dtype=jnp.float32,
+                kv_layout="paged", kv_page_size=4, decode_burst=2,
+                n_draft=0, kv_audit="strict")
+    base.update(kw)
+    return eng.EngineConfig(**base)
+
+
+def _engine(cfg, params, **kw):
+    e = eng.Engine(cfg, params, _Tok(), _ecfg(**kw))
+    e.start()
+    return e
+
+
+def _greedy(e, ids, n=8):
+    _, evs = e.generate_text(eng.GenRequest(
+        prompt_ids=list(ids), max_new_tokens=n, ignore_eos=True,
+        params=sampling.SamplingParamsHost(temperature=0.0)))
+    return eng.event_ids(evs), evs
+
+
+def _prompt(rng, n):
+    return [int(x) for x in rng.integers(1, 120, size=n)]
+
+
+def _sweep_clean(e):
+    snap = e.kv_audit_sweep()
+    assert snap["violations"] == 0, snap
+    assert snap["leaked_pages"] == 0, snap
+    return snap
+
+
+# ---------- configuration surface ----------
+
+def test_window_config_validation(tiny_cfg_params):
+    cfg, params = tiny_cfg_params
+    with pytest.raises(ValueError, match="prefix cache"):
+        eng.Engine(cfg, params, _Tok(),
+                   _ecfg(kv_window_pages=2, kv_prefix_cache=False))
+    with pytest.raises(ValueError, match="host tier"):
+        eng.Engine(cfg, params, _Tok(),
+                   _ecfg(kv_window_pages=2, kv_offload=False,
+                         kv_window_policy="demote"))
+    with pytest.raises(ValueError, match="does not fit"):
+        eng.Engine(cfg, params, _Tok(),
+                   _ecfg(kv_window_pages=40, kv_sink_pages=1))
+    with pytest.raises(ValueError, match="self-extend"):
+        eng.Engine(cfg, params, _Tok(),
+                   _ecfg(kv_window_pages=2, ga_n=2, ga_w=8))
+
+
+# ---------- window inert until it engages ----------
+
+@pytest.mark.slow
+def test_window_inert_byte_parity(tiny_cfg_params):
+    """A prompt whose prompt+generation (plus the window-advance
+    look-ahead margin) fits inside (sink + window) pages must take the
+    exact unwindowed path: byte-identical greedy output, win_off 0."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(5)
+    # budget = (1 sink + 4 window) * 4 rows = 20; 8 + 4 + margin(2*1+2)
+    # stays under it, so _advance_window never fires
+    ids = _prompt(rng, 8)
+    ew = _engine(cfg, params, kv_window_pages=4, kv_sink_pages=1)
+    try:
+        got_w, _ = _greedy(ew, ids, n=4)
+        assert all(s is None or s.win_off == 0 for s in ew.slots)
+        _sweep_clean(ew)
+    finally:
+        ew.shutdown()
+    eu = _engine(cfg, params)
+    try:
+        got_u, _ = _greedy(eu, ids, n=4)
+    finally:
+        eu.shutdown()
+    assert got_w == got_u
+
+
+# ---------- snap-back demotion ----------
+
+@pytest.mark.slow
+def test_window_demote_bounds_device_pages(tiny_cfg_params):
+    """A prompt far past the working set: the slot's resident pages
+    must stay bounded while the cold middle lands in the host tier
+    under its absolute chain keys, and the strict auditor must see a
+    clean lifecycle throughout (demote is a first-class ledger op)."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(6)
+    ids = _prompt(rng, 48)                     # 12 pages of 4 rows
+    e = _engine(cfg, params, kv_window_pages=2, kv_sink_pages=1)
+    try:
+        q = e.submit(eng.GenRequest(
+            prompt_ids=ids, max_new_tokens=12, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0)))
+        peak, windowed_seen = 0, False
+        deadline = time.monotonic() + 60
+        toks = []
+        while time.monotonic() < deadline:
+            dbg = e.kv_debug()
+            offs = (dbg.get("window") or {}).get("win_off_rows", [])
+            if any(offs):
+                windowed_seen = True
+                i = int(np.argmax(offs))
+                peak = max(peak, int(np.sum(
+                    e._pool.ptab[i] != e._pool.num_pages)))
+            try:
+                ev = q.get(timeout=0.02)
+            except Exception:
+                continue
+            if ev is None:
+                break
+            assert not ev.error, ev.error
+            toks.extend(ev.token_ids or
+                        ([ev.token_id] if ev.token_id >= 0 else []))
+        assert len(toks) == 12
+        assert windowed_seen, "window never engaged"
+        # bounded working set: sink + window + one prefill chunk of
+        # in-flight rows + COW/boundary slack — never the whole prompt
+        assert 0 < peak <= 1 + 2 + (16 // 4) + 2, peak
+        st = e._hstore.stats()
+        assert st["offloaded_pages"] >= 4    # the demoted cold middle
+        ledger = e._pool.audit.ledger.counts
+        assert ledger.get("demote", 0) >= 1
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
+
+
+def test_window_drop_policy_ledger(tiny_cfg_params):
+    """policy=drop: no host tier at all — the cold middle is compressed
+    away under an explicit ledger op, and the strict auditor agrees
+    nothing leaked."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(7)
+    e = _engine(cfg, params, kv_window_pages=2, kv_sink_pages=1,
+                kv_window_policy="drop", kv_offload=False)
+    try:
+        assert e._hstore is None
+        toks, _ = _greedy(e, _prompt(rng, 48), n=8)
+        assert len(toks) == 8
+        ledger = e._pool.audit.ledger.counts
+        assert ledger.get("compress", 0) >= 1
+        assert ledger.get("offload", 0) == 0   # nothing left for host RAM
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
+
+
+@pytest.mark.slow
+def test_windowed_context_shift_past_capacity(tiny_cfg_params):
+    """A windowed slot's compact length is clamped, so the shift
+    trigger must fire on the ABSOLUTE length (win_off + cache_len) —
+    generation past max_context still context-shifts and completes."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(8)
+    e = _engine(cfg, params, max_context=64, kv_window_pages=2,
+                kv_sink_pages=1, context_shift=True)
+    try:
+        toks, evs = _greedy(e, _prompt(rng, 24), n=60)
+        assert evs[-1].completion_tokens == 60
+        assert evs[-1].finish_reason == "length"
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
+
+
+# ---------- prefetch pipeline ----------
+
+def test_prefetch_pipeline_unit():
+    pf = PrefetchPipeline()
+    pf.register(b"k1", b"root", 7, 0)
+    pf.register(b"k2", b"k1", 8, 1)
+    assert len(pf) == 2
+    rec = pf.claim(b"k1")
+    assert rec is not None and rec[0] == 7 and rec[1] == b"root"
+    assert pf.claim(b"k1") is None          # single ownership transfer
+    assert pf.claim(b"missing") is None
+    # expiry: entries registered at tick 0 age out past max_age
+    pf.tick += pf.max_age + 1
+    expired = pf.expire()
+    assert [k for k, _ in expired] == [b"k2"]
+    assert len(pf) == 0
+    pf.register(b"k3", b"k2", 9, 2)
+    drained = pf.drain()
+    assert [k for k, _ in drained] == [b"k3"] and len(pf) == 0
+
+
+@pytest.mark.slow
+def test_warm_windowed_readmission_prefetch_hit(tiny_cfg_params):
+    """The tentpole e2e: a long windowed conversation's follow-up turn
+    is queued while both slots decode blockers; the prefetch tick must
+    restore its sink + tail-window links from the host tier DURING the
+    blockers' bursts, so the windowed admission claims them resident
+    (hits, zero LATE) and reuses exactly (sink + window) pages."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(9)
+    ids = _prompt(rng, 48)
+    e = _engine(cfg, params, kv_window_pages=2, kv_sink_pages=1,
+                kv_prefetch_ahead=2)
+    try:
+        toks, _ = _greedy(e, ids, n=8)       # cold: demotes the middle
+        st0 = e._hstore.stats()
+        assert st0["offloaded_pages"] >= 4
+        # pin both slots, then queue the warm follow-up turn behind them
+        blockers = [e.submit(eng.GenRequest(
+            prompt_ids=_prompt(rng, 8), max_new_tokens=32, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0)))
+            for _ in range(2)]
+        warm = e.submit(eng.GenRequest(
+            prompt_ids=ids + toks + _prompt(rng, 2), max_new_tokens=4,
+            ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0)))
+        last = None
+        for q in [warm] + blockers:
+            while True:
+                ev = q.get()
+                if ev is None:
+                    break
+                assert not ev.error, ev.error
+                if q is warm:
+                    last = ev
+        st = e._hstore.stats()
+        assert st["prefetch_issued"] >= 1
+        assert st["prefetch_hits"] >= 1
+        assert st["prefetch_late"] == 0
+        # windowed admission: exactly sink + window pages of compact reuse
+        assert last.timings["reused_prompt_tokens"] == (1 + 2) * 4
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
+
+
+# ---------- self-extend x host tier (ISSUE 16 satellite) ----------
+
+@pytest.mark.slow
+def test_selfextend_paged_host_restore_roundtrip(tiny_cfg_params):
+    """ga_n > 1 on the paged layout: a compressed chain evicted to the
+    host tier must restore byte-exactly — compressed-region rows only
+    (their grouped positions depend solely on absolute index), with the
+    continuation reproducing the cold greedy output bit-for-bit, which
+    is the round-trip check on pos_offset/ga_blocks state."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(10)
+    a = _prompt(rng, 40)                      # _ga_c(40) = 4 blocks of 8
+    e = _engine(cfg, params, ga_n=2, ga_w=8, kv_pool_pages=14)
+    try:
+        ref, _ = _greedy(e, a, n=6)
+        slot0 = next(i for i, t in enumerate(e._cache_tokens)
+                     if t[:40] == a)
+        e._commit_ptab()
+        ref_rows = np.asarray(kvcache.slot_rows(e.ck, slot0))[:, :32]
+        for _ in range(3):                    # churn: evict a's chain
+            _greedy(e, _prompt(rng, 40), n=6)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5.0 and e._hstore.pages < 4:
+            time.sleep(0.02)
+        assert e._hstore.pages >= 4, e._hstore.stats()
+        assert not any(t[:40] == a for t in e._cache_tokens)
+        got, evs = _greedy(e, a, n=6)
+        assert got == ref                     # byte-exact continuation
+        reused = evs[-1].timings["reused_prompt_tokens"]
+        # admission may reuse only the COMPRESSED region: c * ga_w rows
+        assert 0 < reused <= 4 * 8
+        slot1 = next(i for i, t in enumerate(e._cache_tokens)
+                     if t[:40] == a)
+        e._commit_ptab()
+        got_rows = np.asarray(kvcache.slot_rows(e.ck, slot1))[:, :32]
+        np.testing.assert_array_equal(got_rows[:, :reused],
+                                      ref_rows[:, :reused])
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
+
+
+def test_selfextend_paged_matches_auto_layout_gate(tiny_cfg_params):
+    """auto still degrades to contiguous under ga (historical default);
+    an explicit kv_layout=paged now composes instead of raising."""
+    cfg, params = tiny_cfg_params
+    e = eng.Engine(cfg, params, _Tok(), _ecfg(kv_layout="auto", ga_n=2,
+                                              ga_w=8, kv_audit="off"))
+    assert not e._paged
+    e2 = eng.Engine(cfg, params, _Tok(), _ecfg(ga_n=2, ga_w=8))
+    assert e2._paged and e2._pcache is not None
+
+
+# ---------- context-shift page reuse (ISSUE 16 satellite) ----------
+
+@pytest.mark.slow
+def test_context_shift_reuses_retained_pages(tiny_cfg_params):
+    """Two identical greedy requests: the first one's post-shift stream
+    leaves retained pages in the prefix cache under the rebased root;
+    the second request shifts at the same point with the same kept
+    window, so its shift re-prefill must splice those pages instead of
+    recomputing the half-context (the final event's reused count is the
+    shift admission's)."""
+    cfg, params = tiny_cfg_params
+    rng = np.random.default_rng(11)
+    ids = _prompt(rng, 40)
+    e = _engine(cfg, params, max_context=64, context_shift=True)
+    try:
+        t1, evs1 = _greedy(e, ids, n=40)      # shifts past row 63
+        assert evs1[-1].completion_tokens == 40
+        t2, evs2 = _greedy(e, ids, n=40)
+        assert t2 == t1
+        assert evs2[-1].timings["reused_prompt_tokens"] >= 16
+        _sweep_clean(e)
+    finally:
+        e.shutdown()
