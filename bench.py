@@ -1459,6 +1459,214 @@ def bench_replicas(cfg, S, C, max_new=48):
     return out
 
 
+def bench_cluster(cfg, S, C, max_new=32):
+    """Cross-host KV federation scenario (ISSUE 17): TWO ClusterHosts —
+    each its own EnginePool + host KV tier, joined only by the KV
+    streaming transport — behind one ClusterRouter, in three phases:
+
+    1. cross-host warm serve: a prompt admitted on host 0 is re-served
+       on host 1; the chain must STREAM over the wire into host 1's
+       local tier (kv_stream_hits >= 1) and the greedy output must be
+       byte-identical — the KV_STREAM_HITS gate;
+    2. host crash mid-stream: host 0's engine loop dies under a live
+       decode (its host tier + wire server survive); the router
+       re-adopts on host 1, which pulls the checkpointed chain out of
+       the carcass over the wire; the stream finishes error-free and
+       byte-matches a fresh re-admission on the adopting host — the
+       CLUSTER_HOST_RECOVERED gate;
+    3. prefill/decode disaggregation (fresh prefill+decode cluster):
+       the prefill host pays TTFT then retires the chain to the
+       transport, the decode host splices it and carries the stream
+       byte-identically (DISAGG_BYTE_MATCH gate), and the victim's
+       decode ITL is measured against a concurrent prefill wave
+       hammering the prefill host (itl_wave_ratio — Splitwise's
+       isolation claim, reported not gated on CPU).
+
+    Byte-gate references go through the ROUTER pinned to the adopting
+    host, so they splice the same conditioning tier (the PR-10 numerics
+    caveat, now spanning hosts)."""
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.cluster import ClusterHost, ClusterRouter
+    from localai_tpu.engine.weights import random_params
+    from localai_tpu.services.eventlog import EVENTS
+    from localai_tpu.services.faults import FAULTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(29)
+    C = max(128, C)
+    pg = 8
+    ecfg = eng.EngineConfig(num_slots=2, max_context=C,
+                            prefill_buckets=(32, 128), decode_burst=4,
+                            kv_page_size=pg, cache_dtype=jnp.float32,
+                            kv_audit="on")
+    plen = min(64, C - max_new - 8)
+    plen -= plen % pg                      # page-aligned: whole-chain reuse
+    out = {"max_new": max_new, "plen": plen}
+
+    def make_req(ids, n):
+        return eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=n, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+
+    def drain(o, first_ev=None):
+        """-> (ids, per-token arrival stamps, err)."""
+        ids, ts, err = [], [], None
+        ev = first_ev
+        while True:
+            if ev is None:
+                ev = o.get()
+                if ev is None:
+                    break
+            if ev.error is not None:
+                err = ev.error
+            now = time.monotonic()
+            if ev.token_ids:
+                ids.extend(ev.token_ids)
+                ts.extend([now] * len(ev.token_ids))
+            elif ev.token_id >= 0:
+                ids.append(ev.token_id)
+                ts.append(now)
+            ev = None
+        return ids, ts, err
+
+    def itl_ms(ts):
+        if len(ts) < 2:
+            return None
+        return round((ts[-1] - ts[0]) / (len(ts) - 1) * 1e3, 2)
+
+    def wait_for(pred, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not pred():
+            time.sleep(0.02)
+        return pred()
+
+    def build_cluster(roles):
+        hosts = [ClusterHost.build(cfg, params, _ByteTokenizer(), ecfg,
+                                   host_id=i, engines=1, role=role)
+                 for i, role in enumerate(roles)]
+        router = ClusterRouter(hosts)
+        router.start(precompile=True)
+        return router
+
+    # ---- phases 1+2: a two-host both/both cluster ----
+    router = build_cluster(["both", "both"])
+    h0, h1 = router.hosts
+    try:
+        # phase 1: warm cross-host serve over the wire
+        p1 = rng.integers(0, 255, size=plen).tolist()
+        r1 = make_req(p1, 8)
+        t0 = time.monotonic()
+        o = router.submit(r1, host=0)
+        first = o.get()
+        out["cold_ttft_ms"] = round((time.monotonic() - t0) * 1e3, 2)
+        ids_cold, _, err = drain(o, first_ev=first)
+        keys = list(h0.pool._engines[0]._pcache.chain_keys(p1))
+        store0 = h0.pool._shared.store
+        wait_for(lambda: all(store0.contains(k) for k in keys))
+        hits0 = h1.fed.stats()["hits"]
+        ids_warm, warm_ttft = None, None
+        for _ in range(2):      # first warm run pays splice compiles
+            rw = make_req(p1, 8)
+            t0 = time.monotonic()
+            o = router.submit(rw, host=1)
+            first = o.get()
+            warm_ttft = time.monotonic() - t0
+            ids_warm, _, werr = drain(o, first_ev=first)
+        st = h1.fed.stats()
+        out["warm_ttft_ms"] = round(warm_ttft * 1e3, 2)
+        out["kv_stream_hits"] = st["hits"] - hits0
+        out["kv_stream_pages"] = st["pages"]
+        out["kv_stream_bytes"] = st["bytes"]
+        out["kv_stream_served_pages"] = h0.server.stats()["pages_out"]
+        out["stream_byte_match"] = (err is None and werr is None
+                                    and ids_warm == ids_cold)
+
+        # phase 2: kill host 0 under a live decode
+        p2 = rng.integers(0, 255, size=plen).tolist()
+        drain(router.submit(make_req(p2, 4), host=0))   # warm the chain
+        keys2 = list(h0.pool._engines[0]._pcache.chain_keys(p2))
+        wait_for(lambda: all(store0.contains(k) for k in keys2))
+        EVENTS.clear()
+        victim = make_req(p2, max_new)
+        o = router.submit(victim, host=0)
+        first = o.get()
+        h0.kill()
+        ids, _, err = drain(o, first_ev=first)
+        migs = [ev for ev in EVENTS.events() if ev["event"] == "migrate"
+                and ev["rid"] == victim.request_id]
+        k = migs[0]["n_decoded"] if migs else 0
+        m = router.metrics()
+        out["crash_stream_ok"] = err is None and len(ids) == max_new
+        out["crash_n_decoded"] = k
+        out["hosts_alive_after"] = m["cluster"]["hosts_alive"]
+        out["host_recovered"] = m["cluster"]["hosts_recovered"]
+        cmatch = False
+        if out["crash_stream_ok"] and 0 < k < max_new \
+                and router.where(victim.request_id) == 1:
+            ref, _, rerr = drain(router.submit(
+                make_req(list(p2) + ids[:k], max_new - k), host=1))
+            cmatch = rerr is None and ids[k:] == ref
+        out["crash_byte_match"] = cmatch
+    finally:
+        FAULTS.reset()
+        _kv_sweep(router, out)
+        router.shutdown()
+
+    # ---- phase 3: prefill/decode disaggregation ----
+    router = build_cluster(["prefill", "decode"])
+    try:
+        EVENTS.clear()
+        p3 = rng.integers(0, 255, size=plen).tolist()
+        req = make_req(p3, max_new)
+        o = router.submit(req)
+        ids, ts, err = drain(o)
+        hand = [ev for ev in EVENTS.events()
+                if ev["event"] == "disagg_handoff"
+                and ev["rid"] == req.request_id]
+        k = hand[0]["n_decoded"] if hand else 0
+        out["disagg_handoffs"] = \
+            router.metrics()["cluster"]["disagg_handoffs"]
+        out["disagg_n_decoded"] = k
+        out["disagg_stream_ok"] = err is None and len(ids) == max_new
+        out["disagg_itl_ms"] = itl_ms(ts[max(1, k):])
+        dmatch = False
+        if out["disagg_stream_ok"] and 0 < k < max_new \
+                and router.where(req.request_id) == 1:
+            ref, _, rerr = drain(router.submit(
+                make_req(list(p3) + ids[:k], max_new - k), host=1))
+            dmatch = rerr is None and ids[k:] == ref
+        out["disagg_byte_match"] = dmatch
+        # decode ITL under a concurrent prefill wave on the other host
+        victim = make_req(rng.integers(0, 255, size=plen).tolist(),
+                          max_new)
+        o = router.submit(victim)
+        wave = [router.submit(make_req(
+            rng.integers(0, 255, size=plen).tolist(), 2))
+            for _ in range(6)]
+        ids_w, ts_w, werr = drain(o)
+        for w in wave:
+            drain(w)
+        kw = next((ev["n_decoded"] for ev in EVENTS.events()
+                   if ev["event"] == "disagg_handoff"
+                   and ev["rid"] == victim.request_id), 1)
+        out["disagg_itl_wave_ms"] = itl_ms(ts_w[max(1, kw):])
+        if out["disagg_itl_ms"] and out["disagg_itl_wave_ms"]:
+            out["itl_wave_ratio"] = round(
+                out["disagg_itl_wave_ms"] / out["disagg_itl_ms"], 2)
+        out["disagg_wave_ok"] = werr is None and len(ids_w) == max_new
+    finally:
+        FAULTS.reset()
+        _kv_sweep(router, out)
+        router.shutdown()
+    out["recovered"] = bool(out.get("crash_stream_ok")
+                            and out.get("crash_byte_match")
+                            and out.get("host_recovered") == 1
+                            and out.get("hosts_alive_after") == 1)
+    return out
+
+
 def bench_slo(cfg, S, C, n_low=6, n_high=4, max_new=8):
     """Per-class SLO burn-rate + violation flight-recorder scenario
     (ISSUE 12), on ONE engine with a deliberately split objective:
@@ -2653,7 +2861,8 @@ def main():
             or "--multiturn" in sys.argv or "--packed-prefill" in sys.argv
             or "--chaos" in sys.argv or "--priority" in sys.argv
             or "--slo" in sys.argv or "--spec" in sys.argv
-            or "--replicas" in sys.argv or "--longcontext" in sys.argv):
+            or "--replicas" in sys.argv or "--longcontext" in sys.argv
+            or "--cluster" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -2829,6 +3038,30 @@ def main():
                   and r.get("recovered") is True)
             print(json.dumps({
                 "metric": f"replicas_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", "ok": 1 if ok else 0, **r,
+            }))
+            return
+
+        if "--cluster" in sys.argv:
+            # cross-host KV federation (ISSUE 17): f32 weights so the
+            # cross-host stream / crash-recovery / disagg byte gates
+            # compare the continued stream against a fresh re-admission
+            # on the adopting host deterministically
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            C = max(128, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 128)
+            r = bench_cluster(cfg, S, C)
+            ok = (r.get("kv_stream_hits", 0) >= 1
+                  and r.get("stream_byte_match") is True
+                  and r.get("disagg_byte_match") is True
+                  and r.get("recovered") is True
+                  and r.get("kv_audit_violations") == 0)
+            print(json.dumps({
+                "metric": f"cluster_{preset}", "value": 1 if ok else 0,
                 "unit": "ok", "ok": 1 if ok else 0, **r,
             }))
             return

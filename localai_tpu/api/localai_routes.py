@@ -223,6 +223,14 @@ _SPEC_COUNTERS = (("rounds", "spec_rounds_total"),
 # from engine metrics()["kv_audit"] (pool-aggregated for engines>1)
 _KV_AUDIT_COUNTERS = ("checks", "violations", "leaked_pages",
                       "ledger_events")
+# cross-host KV streaming transport (ISSUE 17): the federated tier's
+# fetch totals, from engine metrics()["kv_stream"] (stats key ->
+# localai_kv_stream_<metric>_total)
+_KV_STREAM_COUNTERS = (("fetches", "fetches"), ("hits", "hits"),
+                       ("misses", "misses"), ("pages", "pages"),
+                       ("bytes", "bytes"), ("pushes", "pushes"),
+                       ("pushed_pages", "pushed_pages"),
+                       ("corrupt_rejected", "corrupt_rejected"))
 
 
 def _refresh_engine_metrics(state):
@@ -257,6 +265,9 @@ def _refresh_engine_metrics(state):
               *(m for _k, m in _SPEC_COUNTERS),
               "spec_acceptance_rate",
               *(f"kv_audit_{k}_total" for k in _KV_AUDIT_COUNTERS),
+              *(f"kv_stream_{m}_total" for _k, m in _KV_STREAM_COUNTERS),
+              "kv_stream_inflight", "kv_stream_peers_online",
+              "cluster_hosts", "disagg_handoffs_total",
               "engine_replicas", "replica_queue_depth",
               "replica_slots_in_flight", "replica_migrations_total",
               "pool_affinity_hits_total", "pool_affinity_misses_total",
@@ -490,6 +501,29 @@ def _refresh_engine_metrics(state):
             for key in _KV_AUDIT_COUNTERS:
                 METRICS.set_counter(f"kv_audit_{key}_total",
                                     ka.get(key, 0), label_str(model=name))
+        # cross-host KV federation (ISSUE 17): the peer tier's transfer
+        # totals; absent unless kv_peers= armed a federated tier
+        ks = stats.get("kv_stream")
+        if ks:
+            for skey, mkey in _KV_STREAM_COUNTERS:
+                METRICS.set_counter(f"kv_stream_{mkey}_total",
+                                    ks.get(skey, 0), label_str(model=name))
+            METRICS.set_gauge("kv_stream_inflight", ks.get("inflight", 0),
+                              label_str(model=name))
+            METRICS.set_gauge("kv_stream_peers_online",
+                              ks.get("peers_online", 0),
+                              label_str(model=name))
+        # cluster width + prefill/decode disaggregation handoffs
+        cl = stats.get("cluster")
+        if cl:
+            METRICS.set_gauge("cluster_hosts", cl.get("hosts_alive", 0),
+                              label_str(model=name))
+        dg = stats.get("disagg")
+        if dg:
+            METRICS.set_counter("disagg_handoffs_total",
+                                dg.get("handoffs", 0),
+                                label_str(model=name,
+                                          role=dg.get("role", "both")))
 
 
 async def metrics(request):

@@ -87,6 +87,8 @@ class EngineServicer(BackendServicer):
         self._state = pb.StatusResponse.UNINITIALIZED
         self._load_lock = threading.Lock()
         self._embed = False
+        self.kv_server = None      # ISSUE 17: KVWireServer when kv_serve=
+        self.kv_fed = None         # ISSUE 17: FederatedKV when kv_peers=
 
     @staticmethod
     def _host_store_path(extra: dict, request) -> str:
@@ -462,6 +464,13 @@ class EngineServicer(BackendServicer):
                 extra.get("n_draft", "")).strip()).isdigit() else {}),
             **({"spec_ngram": sn} if (sn := int(
                 extra.get("spec_ngram", 0) or 0)) > 0 else {}),
+            # prefill/decode disaggregation role (ISSUE 17): "both"
+            # (the default) is bit-for-bit the single-host path;
+            # "prefill" retires finished prefills to the cluster
+            # transport, "decode" is a routing hint
+            **({"disagg": dg} if (dg := str(
+                extra.get("disagg", "") or "").strip().lower()) in
+               ("prefill", "decode", "both") else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -512,6 +521,51 @@ class EngineServicer(BackendServicer):
         # for tests that only care about wiring
         self.engine.start(
             precompile=os.environ.get("LOCALAI_PRECOMPILE", "1") != "0")
+        # cross-host KV federation (ISSUE 17): kv_serve=1|host:port makes
+        # this host's KV tier network-addressable (peers stream chain
+        # entries out of it); kv_peers=host:port|host:port attaches the
+        # federated tier so a local host-store miss consults peers before
+        # falling back to re-prefill. Both absent (the default) leaves
+        # the single-host path untouched.
+        self.kv_server = None
+        self.kv_fed = None
+        kv_serve = str(extra.get("kv_serve", "") or "").strip()
+        serve_on = kv_serve.lower() not in ("", "0", "false", "off", "no")
+        kv_peers = [a.strip() for a in
+                    str(extra.get("kv_peers", "") or "").split("|")
+                    if a.strip()]
+        if serve_on or kv_peers:
+            if n_engines > 1:
+                store, index = (self.engine._shared.store,
+                                self.engine._shared.index)
+            else:
+                store, index = self.engine._hstore, None
+            if store is None:
+                log.warning("kv_serve/kv_peers ignored: no host KV "
+                               "tier (kv_offload=0 or a non-paged layout)")
+            else:
+                if serve_on:
+                    from localai_tpu.services.kv_wire import KVWireServer
+
+                    bind, port = "127.0.0.1", 0
+                    if ":" in kv_serve:
+                        b, _, p = kv_serve.rpartition(":")
+                        bind, port = b, int(p)
+                    self.kv_server = KVWireServer(
+                        store, index=index,
+                        host_id=int(extra.get("kv_host_id", 0) or 0),
+                        bind=bind, port=port)
+                    log.info("kv wire serving at %s",
+                                self.kv_server.start())
+                if kv_peers:
+                    from localai_tpu.engine.kv_stream import (FederatedKV,
+                                                              KVStreamClient)
+
+                    self.kv_fed = FederatedKV(store, [
+                        KVStreamClient(a, store.scope, store.page_size)
+                        for a in kv_peers]).attach()
+                    log.info("kv federated tier attached: %d peer(s)",
+                                len(kv_peers))
         self._embed = request.embeddings
 
         # multimodal projector (LLaVA-style vision tower; reference injects

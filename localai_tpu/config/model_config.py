@@ -329,6 +329,28 @@ class ModelConfig:
             elif k == "engines" and not (v.isdigit() and int(v) > 0):
                 problems.append(
                     f"engines must be a positive integer, got {v!r}")
+            elif k == "disagg" and v not in ("both", "prefill", "decode"):
+                # prefill/decode disaggregation role (ISSUE 17)
+                problems.append(
+                    f"disagg must be both|prefill|decode, got {v!r}")
+            elif k == "kv_peers":
+                # peer wire addresses, |-separated (the options wire
+                # splits on commas): host:port[|host:port...]
+                for a in v.split("|"):
+                    a = a.strip()
+                    h, _, p = a.rpartition(":")
+                    if not h or not p.isdigit():
+                        problems.append(
+                            f"kv_peers entries must be host:port, got {a!r}")
+                        break
+            elif k == "kv_serve":
+                # "1" (ephemeral port) or an explicit bind host:port
+                if v.lower() not in ("0", "1", "false", "true", "off",
+                                     "on", "no", "yes"):
+                    h, _, p = v.rpartition(":")
+                    if not h or not p.isdigit():
+                        problems.append(
+                            f"kv_serve must be 0|1|host:port, got {v!r}")
             elif k == "peak_tflops":
                 try:
                     if float(v) < 0:
@@ -370,6 +392,19 @@ class ModelConfig:
                 ("0", "false", "off", "no")):
             problems.append("engines>1 requires preempt=1 (pause/resume "
                             "is the pool's migration primitive)")
+        # cross-knob (ISSUE 17): a disaggregated role ejects/splices via
+        # the same pause/resume primitive, and ships chains through the
+        # host tier — both must be armed
+        if opts.get("disagg", "both") != "both":
+            if opts.get("preempt", "1").lower() in ("0", "false", "off",
+                                                    "no"):
+                problems.append("disagg=prefill|decode requires preempt=1 "
+                                "(pause/resume is the handoff primitive)")
+            if opts.get("kv_offload", "1").lower() in ("0", "false", "off",
+                                                       "no"):
+                problems.append("disagg=prefill|decode requires "
+                                "kv_offload=1 (chains ship via the host "
+                                "tier)")
         return problems
 
     def usecases(self) -> Usecase:

@@ -1,0 +1,659 @@
+"""Cluster router over N engine-pool worker hosts (ISSUE 17).
+
+The serving unit grows one more level: a ``ClusterRouter`` fronts N
+``ClusterHost``s, each a PR-14 ``EnginePool`` (replicas + one shared
+host tier) made NETWORK-ADDRESSABLE by a ``KVWireServer``
+(services/kv_wire.py) and peer-aware by a ``FederatedKV``
+(engine/kv_stream.py). The PR-2/3 chained block hashes already make KV
+location-independent, so everything the pool does across replicas —
+prefix-affinity routing, live handoff, crash recovery — lifts across
+hosts with the wire as the only new mechanism:
+
+* ROUTING: the router polls each host's chain-key DIGEST (the pool
+  prefix index + host-tier membership) over the wire and routes each
+  request to the host holding the longest prefix match; peer-held
+  chains a probe misses still stream in at admission through the
+  federated tier, so a wrong guess costs a fetch, not a re-prefill.
+
+* DISAGGREGATION (DejaVu / Splitwise): hosts carry a ``role`` —
+  ``prefill`` hosts run admission + packed prefill only and retire each
+  chain to the transport after its first token; the router hands the
+  ResumeEntry to a ``decode`` host which pre-fetches the streamed chain
+  and splices it. Decode ITL never queues behind a prefill wave.
+
+* CRASH RECOVERY: a host whose engine loops die (accelerator/host loop
+  lost; the wire server thread keeps serving the surviving host tier —
+  loop death is not store death) is harvested exactly like a dead pool
+  replica, one level up: in-flight slots and parked resumes re-adopt on
+  sibling hosts whose federated tier streams the warm chains over; the
+  client stream never closes (PR-10's resume ≡ fresh-re-admission
+  contract makes the continuation byte-identical to re-submitting
+  prompt + emitted).
+
+* AUDIT (ISSUE 15, lifted cluster-wide): chain entries in flight on the
+  wire are DECLARED EXTRAS, never leaks — ``kv_audit_sweep`` folds
+  every host's sweep and checks all transports are quiesced.
+
+``cluster=off`` (the default) never constructs any of this — the
+single-host PR-16 path is untouched, bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine.kv_stream import FederatedKV, KVStreamClient
+from localai_tpu.engine.pool import EnginePool
+from localai_tpu.engine.scheduler import PRIORITY_RANK, ResumeEntry
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.kv_wire import KVWireServer, WireError
+
+log = logging.getLogger(__name__)
+
+# how many recovery/disagg chain pins to keep mapped before releasing
+# the oldest (same bound and rationale as pool._MAX_PINS)
+_MAX_PINS = 16
+# digest poll cadence: affinity data may be this stale; staleness costs
+# a federated fetch at admission, never correctness
+_DIGEST_PERIOD_S = 0.25
+
+
+class ClusterHost:
+    """One worker host: an EnginePool + its shared KV tiers, serving its
+    host tier to peers over the wire and consulting peers on misses.
+
+    ``role``: ``both`` (default — a full host), ``prefill`` (admission +
+    packed prefill only; finished prefills retire to the transport) or
+    ``decode`` (receives disagg handoffs; the router keeps fresh
+    arrivals away when a prefill host is alive)."""
+
+    def __init__(self, host_id: int, pool: EnginePool, role: str = "both",
+                 bind: str = "127.0.0.1"):
+        assert role in ("both", "prefill", "decode"), role
+        self.host_id = int(host_id)
+        self.pool = pool
+        self.role = role
+        self._bind = bind
+        self.server: Optional[KVWireServer] = None
+        self.fed: Optional[FederatedKV] = None
+        self.address = ""
+        self.killed = False
+        # host-scoped chaos identity: in-process hosts share the global
+        # FAULTS table, so replica{N}_die would collide across hosts —
+        # every engine loop on this host consumes one firing of this
+        # name instead (kill() arms count=len(engines))
+        self._die_fault = f"cluster{self.host_id}_die"
+        for e in self.pool._engines:
+            e._die_fault = self._die_fault
+
+    # ---------- construction ----------
+
+    @classmethod
+    def build(cls, model_cfg, params, tokenizer, engine_cfg=None,
+              host_id: int = 0, engines: int = 1, role: str = "both",
+              bind: str = "127.0.0.1", **kw):
+        """One host = one EnginePool with a role-annotated config.
+        Requires the preemptive scheduler (pause/resume is the handoff
+        primitive) and a host tier (the transport serves it)."""
+        ecfg = engine_cfg or eng.EngineConfig()
+        ecfg = dataclasses.replace(ecfg, disagg=role)
+        if not ecfg.preempt:
+            raise ValueError("cluster hosts require preempt=1 (pause/"
+                             "resume is the handoff primitive)")
+        if not ecfg.kv_offload or not ecfg.kv_prefix_cache:
+            raise ValueError("cluster hosts require kv_offload=1 + the "
+                             "prefix cache (the wire serves the host "
+                             "tier)")
+        pool = EnginePool.build(model_cfg, params, tokenizer, ecfg,
+                                engines=max(1, int(engines)), **kw)
+        return cls(host_id, pool, role=role, bind=bind)
+
+    # ---------- lifecycle ----------
+
+    def start(self, precompile: bool = False) -> str:
+        self.pool.start(precompile=precompile)
+        store = self.pool._shared.store
+        if store is None:
+            raise RuntimeError("cluster host has no shared host store "
+                               "(kv_offload off, or a non-paged layout?)")
+        self.server = KVWireServer(store, index=self.pool._shared.index,
+                                   host_id=self.host_id, bind=self._bind)
+        self.address = self.server.start()
+        for e in self.pool._engines:
+            # continuous warm-chain checkpointing (DejaVu): active
+            # chains stream to the host tier on the watermark cadence
+            # so a crash leaves near-current state for siblings to pull
+            e.kv_checkpoint = True
+        log.info("cluster host %d (%s) serving kv at %s",
+                 self.host_id, self.role, self.address)
+        return self.address
+
+    def connect_peers(self, addresses: list):
+        """Attach the federated tier: this host's store misses consult
+        these peers (every other host's wire address)."""
+        store = self.pool._shared.store
+        peers = [KVStreamClient(a, store.scope, store.page_size)
+                 for a in addresses if a and a != self.address]
+        self.fed = FederatedKV(store, peers).attach()
+        return self.fed
+
+    def shutdown(self):
+        if self.fed is not None:
+            self.fed.close()
+        if self.server is not None:
+            self.server.stop()
+        self.pool.shutdown()
+
+    # ---------- health / chaos ----------
+
+    @property
+    def alive(self) -> bool:
+        """False once every engine loop on the host died WITHOUT
+        shutdown (the pool's crash asymmetry, host-wide). The wire
+        server is deliberately not consulted: loop death with a live
+        store is exactly the recoverable state."""
+        if self.killed and all(not e.loop_alive for e in self.pool._engines):
+            return False
+        dead = [e for e in self.pool._engines
+                if e._thread is not None
+                and not e.loop_alive and not e._stop]
+        return len(dead) < len(self.pool._engines)
+
+    def kill(self):
+        """Chaos: lose this host's engine loops (accelerator gone), but
+        NOT its host tier or wire server — siblings stream the warm
+        chains out of the carcass. The pool's own housekeeping stops
+        FIRST so it cannot race the router's harvest by failing streams
+        when it finds no live sibling replica."""
+        self.killed = True
+        self.pool._hk_stop.set()
+        FAULTS.arm(self._die_fault, count=len(self.pool._engines))
+        for e in self.pool._engines:
+            e._wake.set()
+
+    # ---------- load ----------
+
+    def load(self, rank: int = 1) -> float:
+        return sum(self.pool._load(i, rank)
+                   for i in range(len(self.pool._engines))
+                   if not self.pool._dead[i])
+
+
+class ClusterRouter:
+    """Front door over N ClusterHosts: cross-host prefix-affinity
+    routing, disagg handoff brokering, host crash recovery, cluster-wide
+    audit. Mirrors the pool surface the servicer drives (submit /
+    generate / cancel / metrics / kv_audit_sweep / shutdown)."""
+
+    def __init__(self, hosts: list):
+        assert hosts, "ClusterRouter needs at least one host"
+        self.hosts = list(hosts)
+        self._dead = [False] * len(hosts)
+        self._lock = threading.Lock()
+        self._where: dict = {}
+        self._where_order: list = []
+        self._digests: list = [set() for _ in hosts]
+        self._clients: list = [None] * len(hosts)
+        self._t_digest = 0.0
+        self._pins: list = []
+        self._disagg_q: "queue.Queue" = queue.Queue()
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.disagg_handoffs = 0
+        self.hosts_recovered = 0
+        self._routed = 0
+        self._hk_stop = threading.Event()
+        self._hk_thread: Optional[threading.Thread] = None
+
+    # ---------- lifecycle ----------
+
+    def start(self, precompile: bool = False):
+        addrs = [h.start(precompile=precompile) for h in self.hosts]
+        for h in self.hosts:
+            h.connect_peers(addrs)
+        store = self.hosts[0].pool._shared.store
+        # the router's own digest/stats connections ride the same wire
+        # the federated tier uses — affinity data is whatever a peer
+        # could learn, no in-process shortcuts
+        self._clients = [KVStreamClient(a, store.scope, store.page_size)
+                         for a in addrs]
+        # prefill-role engines hand finished chains to the router
+        for i, h in enumerate(self.hosts):
+            if h.role == "prefill":
+                for e in h.pool._engines:
+                    e.disagg_handoff = self._make_handoff(i)
+        self._hk_thread = threading.Thread(
+            target=self._housekeeping, name="cluster-router", daemon=True)
+        self._hk_thread.start()
+
+    def shutdown(self):
+        self._hk_stop.set()
+        if self._hk_thread is not None:
+            self._hk_thread.join(timeout=5)
+        self._drain_disagg()        # nothing may strand in the broker
+        with self._lock:
+            pins, self._pins = self._pins, []
+        for host_i, rid, keys in pins:
+            self._unpin(host_i, rid, keys)
+        for c in self._clients:
+            if c is not None:
+                c.close()
+        for h in self.hosts:
+            try:
+                h.shutdown()
+            except Exception:
+                log.exception("cluster host %d shutdown failed", h.host_id)
+
+    # ---------- routing ----------
+
+    def _alive_hosts(self):
+        return [i for i in range(len(self.hosts)) if not self._dead[i]]
+
+    def _note_where(self, rid: str, host: int):
+        with self._lock:
+            if rid not in self._where:
+                self._where_order.append(rid)
+            self._where[rid] = host
+            while len(self._where_order) > 4096:
+                old = self._where_order.pop(0)
+                self._where.pop(old, None)
+
+    def where(self, rid: str) -> Optional[int]:
+        return self._where.get(rid)
+
+    def _poll_digests(self):
+        """Refresh the per-host chain-key sets used for affinity. A
+        host that fails to answer keeps its last digest — stale beats
+        empty, and the federated fetch at admission is the backstop."""
+        for i in self._alive_hosts():
+            c = self._clients[i]
+            if c is None or not c.online():
+                continue
+            try:
+                d = c.digest()
+            except (OSError, WireError):
+                continue
+            self._digests[i] = {bytes.fromhex(k)
+                                for k in d.get("keys", ())}
+
+    def _match_depth(self, keys: list, digest: set) -> int:
+        d = 0
+        for k in keys:
+            if k not in digest:
+                break
+            d += 1
+        return d
+
+    def _route(self, req, host: Optional[int] = None) -> int:
+        alive = self._alive_hosts()
+        if not alive:
+            raise RuntimeError("cluster: no live hosts")
+        if host is not None:
+            if host not in alive:
+                raise RuntimeError(f"cluster: host {host} is not live")
+            self._routed += 1
+            return host
+        # fresh arrivals need a prefill-capable host; pure-decode hosts
+        # receive work only through the disagg broker (unless they are
+        # all that's left — serving beats failing)
+        cands = [i for i in alive if self.hosts[i].role != "decode"]
+        if not cands:
+            cands = alive
+        rank = PRIORITY_RANK.get(getattr(req, "priority", None), 1)
+        self._routed += 1
+        if len(cands) > 1 and getattr(req, "prompt_ids", None):
+            pc = self.hosts[cands[0]].pool._engines[0]._pcache
+            if pc is not None:
+                keys = list(pc.chain_keys(req.prompt_ids))
+                best_i, best_d = None, 0
+                for i in cands:
+                    d = self._match_depth(keys, self._digests[i])
+                    if d > best_d or (d == best_d and d > 0
+                                      and best_i is not None
+                                      and self.hosts[i].load(rank)
+                                      < self.hosts[best_i].load(rank)):
+                        best_i, best_d = i, d
+                if best_i is not None and best_d > 0:
+                    self.affinity_hits += 1
+                    return best_i
+                self.affinity_misses += 1
+        return min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+
+    def submit(self, req, host: Optional[int] = None) -> "queue.Queue":
+        i = self._route(req, host=host)
+        self._note_where(req.request_id, i)
+        return self.hosts[i].pool.submit(req)
+
+    def generate(self, req, host: Optional[int] = None):
+        out = self.submit(req, host=host)
+        while True:
+            ev = out.get()
+            if ev is None:
+                return
+            yield ev
+
+    def cancel(self, request_id: str):
+        i = self._where.get(request_id)
+        if i is not None and not self._dead[i]:
+            self.hosts[i].pool.cancel(request_id)
+        else:
+            for i in self._alive_hosts():
+                self.hosts[i].pool.cancel(request_id)
+
+    # ---------- chain pinning ----------
+
+    def _pin(self, host_i: int, rid: str, keys: list):
+        """Map recovered/disagg chain keys in ``host_i``'s store under
+        ("cluster", rid) so budget eviction can't beat the adoptive
+        replica's restore; bounded, oldest released first."""
+        if not keys:
+            return
+        store = self.hosts[host_i].pool._shared.store
+        owner = ("cluster", rid)
+        for k in keys:
+            store.map_key(k, owner)
+        drop = []
+        with self._lock:
+            self._pins.append((host_i, rid, keys))
+            while len(self._pins) > _MAX_PINS:
+                drop.append(self._pins.pop(0))
+        for old in drop:
+            self._unpin(*old)
+
+    def _unpin(self, host_i: int, rid: str, keys: list):
+        store = self.hosts[host_i].pool._shared.store
+        owner = ("cluster", rid)
+        for k in keys:
+            store.unmap_key(k, owner)
+
+    # ---------- disaggregation ----------
+
+    def _make_handoff(self, src_host: int):
+        """The callback a prefill-role engine fires (on its loop thread)
+        with a finished-prefill ResumeEntry: enqueue for the router
+        thread — the loop must not block on a peer fetch."""
+        def handoff(entry, keys, _src=src_host):
+            self._disagg_q.put((_src, entry, keys))
+        return handoff
+
+    def _drain_disagg(self):
+        while True:
+            try:
+                src, entry, keys = self._disagg_q.get_nowait()
+            except queue.Empty:
+                return
+            self._place_disagg(src, entry, keys)
+
+    def _place_disagg(self, src: int, entry: ResumeEntry, keys: list):
+        rid = entry.req.request_id
+        cands = [i for i in self._alive_hosts()
+                 if i != src and self.hosts[i].role != "prefill"]
+        if not cands:
+            # no decode host: hand the request back — the source engine
+            # decodes it to completion (never strand a client stream)
+            entry.req._no_disagg = True
+            if not self._dead[src] and self._adopt_on(src, rid, entry):
+                return
+            for i in self._alive_hosts():
+                if self._adopt_on(i, rid, entry):
+                    return
+            self.hosts[src].pool._fail_stream(
+                entry.req, "disagg: no host can adopt")
+            return
+        rank = PRIORITY_RANK.get(entry.priority, 1)
+        tgt = min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+        host = self.hosts[tgt]
+        # stream the prefilled chain over BEFORE admission so the decode
+        # host splices local, verified bytes (prefetch > demand-fetch:
+        # one round-trip for the whole chain, off the engine loop)
+        self._pin(tgt, rid, keys)
+        if host.fed is not None and keys:
+            host.fed.prefetch(keys)
+        if not self._adopt_on(tgt, rid, entry):
+            entry.req._no_disagg = True
+            if self._dead[src] or not self._adopt_on(src, rid, entry):
+                self.hosts[src].pool._fail_stream(
+                    entry.req, "disagg: no host can adopt")
+            return
+        self.disagg_handoffs += 1
+        # the source kept the chain mapped under ("disagg", rid) from
+        # its force-offload; the decode host holds its own copy now
+        src_store = self.hosts[src].pool._shared.store
+        for k in keys:
+            src_store.unmap_key(k, ("disagg", rid))
+        EVENTS.emit("disagg_handoff", rid=rid, src=src, dst=tgt,
+                    n_decoded=entry.n_decoded, keys=len(keys))
+
+    def _adopt_on(self, host_i: int, rid: str, entry: ResumeEntry) -> bool:
+        """Adopt a ResumeEntry on the least-loaded live replica of one
+        host; the pool's note_where keeps its own cancel path working."""
+        pool = self.hosts[host_i].pool
+        rank = PRIORITY_RANK.get(entry.priority, 1)
+        reps = [i for i in range(len(pool._engines)) if not pool._dead[i]]
+        if not reps:
+            return False
+        r = min(reps, key=lambda i: (pool._load(i, rank), i))
+        if not pool._engines[r].adopt_resume(entry):
+            return False
+        pool._note_where(rid, r)
+        self._note_where(rid, host_i)
+        aud = pool._engines[r]._kv_audit
+        if aud is not None:
+            aud.ledger.record("adopt", slot=("cluster", host_i), rid=rid)
+        return True
+
+    # ---------- crash recovery ----------
+
+    def _recover_host(self, i: int):
+        """A host's engine loops died (its device tiers are gone; its
+        host tier and wire server survive). Everything it was serving
+        re-adopts on sibling hosts: warm chains stream over the wire
+        from the carcass store, cold ones re-prefill the identical
+        history. Client streams never close — the StreamEvent queues
+        ride the ResumeEntries (pool._recover_replica, one level up)."""
+        host = self.hosts[i]
+        self._dead[i] = True
+        host.pool._hk_stop.set()    # no same-host recovery races
+        self._digests[i] = set()
+        EVENTS.emit("cluster_host_down", host=i, role=host.role)
+        log.warning("cluster: host %d loop(s) died; recovering", i)
+        recovered = failed = 0
+        for e in host.pool._engines:
+            r = e.replica_id
+            if r < len(host.pool._dead):
+                host.pool._dead[r] = True
+            if e._emitter is not None:
+                try:
+                    e._emitter.drain(2.0)
+                except Exception:
+                    pass
+            for slot, s in enumerate(e.slots):
+                if s is None:
+                    continue
+                e.slots[slot] = None
+                rid = s.req.request_id
+                ok = False
+                if e._sched is not None and e._preempt_eligible(slot, s):
+                    hist = list(e._cache_tokens[slot])
+                    if len(hist) < s.prompt_len:
+                        hist = list(s.req.prompt_ids) + list(s.generated)
+                    entry = ResumeEntry(
+                        req=s.req, ids=hist, priority=s.req.priority,
+                        generated=list(s.generated), n_decoded=s.n_decoded,
+                        prompt_len=s.prompt_len, detok=s.detok,
+                        held_text=s.held_text, t_start=s.t_start,
+                        t_first_token=s.t_first_token or None,
+                        t_prefill_ms=s.t_prefill_ms, mu=float(e.mu[slot]),
+                        preempt_count=s.preempts)
+                    ok = self._adopt_on_sibling_host(rid, entry, src=i)
+                if ok:
+                    recovered += 1
+                else:
+                    failed += 1
+                    host.pool._fail_stream(
+                        s.req, f"cluster host {i} died; request not "
+                               f"recoverable on a sibling host")
+            if e._sched is not None:
+                for entry in e._sched.drain_parked():
+                    if self._adopt_on_sibling_host(
+                            entry.req.request_id, entry, src=i):
+                        recovered += 1
+                    else:
+                        failed += 1
+                        host.pool._fail_stream(
+                            entry.req, f"cluster host {i} died")
+            while True:
+                try:
+                    r2 = e._queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    tgt = self._route(r2)
+                    self._note_where(r2.request_id, tgt)
+                    self.hosts[tgt].pool.submit(r2)
+                    recovered += 1
+                except Exception:
+                    failed += 1
+                    host.pool._fail_stream(
+                        r2, f"cluster host {i} died; no live sibling")
+        self.hosts_recovered += 1
+        EVENTS.emit("cluster_host_recovered", host=i,
+                    recovered=recovered, failed=failed)
+        log.warning("cluster: host %d recovery done "
+                    "(recovered=%d failed=%d)", i, recovered, failed)
+
+    def _adopt_on_sibling_host(self, rid: str, entry: ResumeEntry,
+                               src: int) -> bool:
+        cands = [i for i in self._alive_hosts()
+                 if i != src and self.hosts[i].role != "prefill"]
+        if not cands:
+            cands = [i for i in self._alive_hosts() if i != src]
+        if not cands:
+            return False
+        rank = PRIORITY_RANK.get(entry.priority, 1)
+        tgt = min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+        host = self.hosts[tgt]
+        pc = host.pool._engines[0]._pcache
+        keys = list(pc.chain_keys(entry.ids)) if pc is not None else []
+        if keys:
+            self._pin(tgt, rid, keys)
+            if host.fed is not None:
+                # pull the dead host's checkpointed chain into the
+                # target's local tier before admission restores it
+                host.fed.prefetch(keys)
+        if not self._adopt_on(tgt, rid, entry):
+            return False
+        EVENTS.emit("migrate", rid=rid, src=("host", src),
+                    dst=("host", tgt), reason="host_crash", kind="resume",
+                    n_decoded=entry.n_decoded)
+        return True
+
+    # ---------- housekeeping ----------
+
+    def _housekeeping(self):
+        while not self._hk_stop.wait(0.05):
+            try:
+                for i, h in enumerate(self.hosts):
+                    if not self._dead[i] and not h.alive:
+                        self._recover_host(i)
+                self._drain_disagg()
+                t0 = time.monotonic()
+                if t0 - self._t_digest > _DIGEST_PERIOD_S:
+                    self._t_digest = t0
+                    self._poll_digests()
+            except Exception:
+                log.exception("cluster router housekeeping failed")
+
+    # ---------- audit ----------
+
+    def kv_audit_sweep(self, drained: bool = False) -> dict:
+        """Cluster-wide fold of every live host's pool sweep, plus the
+        transport conservation check: with the cluster quiesced no
+        entry may still be in flight on any wire (a declared extra that
+        never lands IS a leak)."""
+        out = {"mode": "off", "checks": 0, "violations": 0,
+               "leaked_pages": 0, "ledger_events": 0,
+               "stream_inflight": 0}
+        for i in self._alive_hosts():
+            snap = self.hosts[i].pool.kv_audit_sweep(drained=drained)
+            if snap.get("mode") != "off":
+                out["mode"] = snap["mode"]
+                for k in ("checks", "violations", "leaked_pages",
+                          "ledger_events"):
+                    out[k] += snap.get(k, 0)
+        for h in self.hosts:
+            if h.fed is not None:
+                out["stream_inflight"] += h.fed.inflight
+        if drained:
+            if out["stream_inflight"]:
+                out["violations"] += 1
+                log.warning("cluster audit: %d stream fetches still in "
+                            "flight after drain", out["stream_inflight"])
+        return out
+
+    # ---------- observability ----------
+
+    def metrics(self) -> dict:
+        ms = [h.pool.metrics() if not self._dead[i] else None
+              for i, h in enumerate(self.hosts)]
+        live = [m for m in ms if m is not None]
+        out = dict(live[0]) if live else {}
+        for k in ("slots_total", "slots_active", "queued",
+                  "total_tokens_generated", "prompt_tokens_reused"):
+            out[k] = sum(m.get(k) or 0 for m in live)
+        stream = {"fetches": 0, "hits": 0, "misses": 0, "pages": 0,
+                  "bytes": 0, "pushes": 0, "pushed_pages": 0,
+                  "corrupt_rejected": 0, "inflight": 0}
+        served = {"serves": 0, "pages_out": 0, "bytes_out": 0}
+        for h in self.hosts:
+            if h.fed is not None:
+                fs = h.fed.stats()
+                for k in stream:
+                    stream[k] += fs.get(k, 0)
+            if h.server is not None:
+                ss = h.server.stats()
+                for k in served:
+                    served[k] += ss.get(k, 0)
+        out["kv_stream"] = stream
+        out["kv_stream_served"] = served
+        out["cluster"] = {
+            "hosts": len(self.hosts),
+            "hosts_alive": len(self._alive_hosts()),
+            "hosts_recovered": self.hosts_recovered,
+            "routed": self._routed,
+            "affinity_hits": self.affinity_hits,
+            "affinity_misses": self.affinity_misses,
+            "disagg_handoffs": self.disagg_handoffs
+                               + sum(e.disagg_handoffs
+                                     for h in self.hosts
+                                     for e in h.pool._engines),
+            "roles": {str(h.host_id): h.role for h in self.hosts},
+        }
+        out["hosts"] = [{
+            "host": h.host_id,
+            "role": h.role,
+            "alive": not self._dead[i],
+            "address": h.address,
+            "kv_stream": (h.fed.stats() if h.fed is not None else {}),
+        } for i, h in enumerate(self.hosts)]
+        return out
+
+    def kv_debug(self) -> dict:
+        return {
+            "cluster_hosts": len(self.hosts),
+            "hosts": [{
+                "host": h.host_id, "role": h.role,
+                "alive": not self._dead[i], "address": h.address,
+                **h.pool.kv_debug(),
+                "kv_stream": (h.fed.stats() if h.fed is not None else {}),
+                "kv_serve": (h.server.stats()
+                             if h.server is not None else {}),
+            } for i, h in enumerate(self.hosts)],
+        }

@@ -332,6 +332,20 @@ class EngineConfig:
     # counters + kv_audit_violation events + flight dumps); "strict" =
     # violations raise KVAuditError, for tests and chaos rigs.
     kv_audit: str = "on"
+    # --- prefill/decode disaggregation (ISSUE 17) ---
+    # cluster role: "both" (the default — a normal engine, bit-for-bit
+    # the single-host path), "prefill" (admission + packed prefill
+    # only: once a slot's prefill completes and its first token is out,
+    # the request is ejected via the PR-10 pause primitive, its chain
+    # force-offloaded to the host tier, and the ResumeEntry handed to
+    # the registered disagg_handoff — the cluster router streams the
+    # chain to a decode host and re-admits it there), or "decode" (a
+    # routing hint: the cluster router sends it no fresh prefill work;
+    # the engine itself needs no restriction — a resumed admission's
+    # splice prefill is part of decoding the handoff). With no handoff
+    # registered a "prefill" engine serves requests to completion like
+    # "both" — a request is never stranded on a role knob.
+    disagg: str = "both"
 
 
 @dataclasses.dataclass
@@ -1100,6 +1114,20 @@ class Engine:
         # replica_die fault name (chaos: pool crash recovery) — checked
         # at the tick top only while fault injection is armed
         self._die_fault = f"replica{self.replica_id}_die"
+        # --- cluster serving (ISSUE 17) ---
+        # prefill/decode disaggregation: the cluster router registers a
+        # handoff here on "prefill"-role engines; _process_disagg ejects
+        # finished-prefill slots into it at the tick top. None = no
+        # cluster — the tick-top check is one attribute read.
+        self.disagg_handoff = None
+        self._disagg_prefill = (self.ecfg.disagg == "prefill")
+        self.disagg_handoffs = 0
+        # warm-chain checkpointing (DejaVu-style KV streaming for crash
+        # recovery): when armed by a ClusterHost, active slots' committed
+        # chains are retained + force-offloaded to the host tier on the
+        # watermark cadence — so a host that dies mid-decode leaves its
+        # warm chains fetchable by the sibling that re-adopts its work.
+        self.kv_checkpoint = False
         # --- resume_reserve_pages autosize (ISSUE 14 satellite; the
         # open PR-10 follow-up): EWMA of preemptions/min x average pages
         # retained per preemption -> effective reserve when the explicit
@@ -1335,6 +1363,9 @@ class Engine:
         }
         if self._hstore is not None:
             out["host"] = self._hstore.stats()
+            if self._hstore.federated is not None:
+                # peer tier (ISSUE 17): wire fetch/push totals
+                out["kv_stream"] = self._hstore.federated.stats()
         if self._win_pages:
             out["window"] = {
                 "pages": self._win_pages,
@@ -1842,7 +1873,12 @@ class Engine:
             n_avail = d
             while n_avail < len(keys) and (
                     keys[n_avail] in pf.pages
-                    or self._hstore.contains(keys[n_avail])):
+                    # contains_any (ISSUE 17): a chain link held only by
+                    # a PEER host still counts as available — the get()
+                    # below streams it through the federated tier, so
+                    # prefetch-ahead rides the transport (PRESERVE
+                    # across hosts)
+                    or self._hstore.contains_any(keys[n_avail])):
                 n_avail += 1
             if n_avail <= d:
                 continue
@@ -1997,7 +2033,12 @@ class Engine:
             key = keys[n_avail]
             if ((self._prefetch is not None
                  and key in self._prefetch.pages)
-                    or self._hstore.contains(key)):
+                    # contains_any (ISSUE 17): peer-held links count as
+                    # available — the selected links' get() streams them
+                    # in through the federated tier; a probe/get race
+                    # (peer died in between) is the same handled hole as
+                    # a local CRC drop
+                    or self._hstore.contains_any(key)):
                 n_avail += 1
             else:
                 break
@@ -3288,6 +3329,15 @@ class Engine:
                 # host tier: state=offloaded pool gauge + transfer totals
                 out["kv_pages_offloaded"] = self._hstore.pages
                 out["kv_offload"] = self._hstore.stats()
+                fed = self._hstore.federated
+                if fed is not None:
+                    # peer tier (ISSUE 17) ->
+                    # localai_kv_stream_{pages,bytes,fetches,hits,
+                    # misses}_total
+                    out["kv_stream"] = fed.stats()
+            if self.ecfg.disagg != "both":
+                out["disagg"] = {"role": self.ecfg.disagg,
+                                 "handoffs": self.disagg_handoffs}
             if self._kv_audit is not None:
                 # lifecycle auditor (ISSUE 15): checks/violations/leaked
                 # pages/ledger events -> localai_kv_audit_*_total
@@ -3703,11 +3753,22 @@ class Engine:
                 # the pause point is a burst boundary like any preempt
                 if self._migrate_req:
                     self._process_migrations()
+                # prefill/decode disaggregation (ISSUE 17): on a
+                # prefill-role engine, slots whose prefill completed
+                # (first token out) retire to the cluster transport at
+                # the same burst boundary migration uses
+                if self._disagg_prefill and self.disagg_handoff is not None:
+                    self._process_disagg()
                 if t0 - t_wm > 0.5:
                     # watermark fold (ISSUE 8): cheap max() samples so
                     # pool peaks between /metrics scrapes are not lost
                     t_wm = t0
                     self._sample_watermarks()
+                    if self.kv_checkpoint:
+                        # cluster mode (ISSUE 17): stream active slots'
+                        # warm chains to the host tier so a host crash
+                        # leaves them fetchable by re-adopting siblings
+                        self._checkpoint_active_chains()
                     if self._kv_audit is not None:
                         # online KV invariant audit (ISSUE 15): same
                         # cadence, same thread — the mirrors are between
@@ -4228,6 +4289,65 @@ class Engine:
         if victims:
             self._dispatch_offload(victims)
         return mapped
+
+    # ---- prefill/decode disaggregation (ISSUE 17) ----------------------
+
+    def _process_disagg(self):
+        """Engine-loop tick-top on a "prefill"-role engine: retire every
+        slot whose prefill has completed (>= 1 decoded token — the
+        packed prefill and its first-token emit are done, so TTFT was
+        paid HERE) to the cluster transport. The ejection IS the PR-10
+        pause primitive with park=False, exactly like live migration:
+        the chain force-offloads to the host tier mapped under
+        ("disagg", rid) so budget eviction can't race the decode host's
+        streamed restore, and the ResumeEntry goes to the registered
+        handoff. A handoff that fails re-parks the entry locally — the
+        request is never stranded, this engine just decodes it like
+        role "both" would."""
+        for i, s in enumerate(self.slots):
+            if s is None or s.n_decoded < 1 or s.phase != "decode":
+                continue
+            if getattr(s.req, "_no_disagg", False):
+                continue    # router had no decode host: serve locally
+            if self._sched is None or not self._preempt_eligible(i, s):
+                continue
+            rid = s.req.request_id
+            entry = self._preempt_slot(i, why="disagg", park=False)
+            if entry is True or not entry:
+                continue
+            keys = self._offload_chain(entry.ids, ("disagg", rid))
+            self.disagg_handoffs += 1
+            if self._kv_audit is not None:
+                self._kv_audit.ledger.record("disagg", rid=rid)
+            try:
+                self.disagg_handoff(entry, keys)
+            except Exception:
+                log.exception("disagg handoff failed for %s; decoding "
+                              "locally", rid)
+                self._sched.adopt(entry)
+
+    def _checkpoint_active_chains(self):
+        """Watermark-cadence warm-chain streaming (cluster mode,
+        ISSUE 17): retain + force-offload every active slot's committed
+        chain so the host tier — and through the wire server, every
+        peer — always holds a near-current copy (DejaVu streams KV off
+        the accelerator continuously; a crashed host's in-flight work
+        then resumes on a sibling from streamed state instead of a full
+        re-prefill). Steady-state cost is one pcache.insert dedup and
+        one contains() walk per slot — pages already offloaded are
+        skipped inside _offload_chain."""
+        if self._pcache is None or self._hstore is None or not self._paged:
+            return
+        for i, s in enumerate(self.slots):
+            if s is None or s.win_off > 0:
+                continue        # windowed slots checkpoint via demote
+            hist = self._cache_tokens[i]
+            committed = min(s.committed, len(hist))
+            pg = self._pool.page_size
+            if committed < pg:
+                continue
+            self._pcache.insert(self._pool, i, hist[:committed])
+            self._offload_chain(hist[:committed])
 
     def _free_count(self) -> int:
         return sum(1 for s in self.slots if s is None)
@@ -7279,6 +7399,13 @@ class Engine:
                     n_ins = min(n_ins, s.ga_blocks * self.ecfg.ga_w)
                 self._pcache.insert(self._pool, slot,
                                     self._cache_tokens[slot][:n_ins])
+                if self.kv_checkpoint and n_ins > 0:
+                    # cluster mode (ISSUE 17): the finished chain also
+                    # lands in the host tier at release, so a peer host
+                    # can serve this prefix via the streaming transport
+                    # even when the release-to-next-request gap is
+                    # shorter than the watermark checkpoint cadence
+                    self._offload_chain(self._cache_tokens[slot][:n_ins])
             # keep the retained prefix's pages in the table too (same
             # reuse story as _cache_tokens — the slot's own next request
             # reuses them for free); everything past returns to the pool

@@ -273,6 +273,13 @@ class HostPageStore:
         # engine (owned store) or the EnginePool's SharedKV (shared
         # store); None = zero-cost no-op
         self.audit = None
+        # federated peer tier (ISSUE 17): a kv_stream.FederatedKV
+        # attached when clustering is armed. get() consults it on a
+        # local miss (fetched entries are CRC-verified and inserted
+        # HERE before the caller sees them) and contains_any() consults
+        # peer membership. None = single-host: both hooks dissolve into
+        # one `is not None` check, so cluster=off stays bit-for-bit.
+        self.federated = None
 
     # ---------- introspection ----------
 
@@ -401,10 +408,29 @@ class HostPageStore:
 
     def get(self, key: bytes):
         """Entry for a chain key (LRU-touched), or None — the host half
-        of the two-tier chain walk. The page CRC is verified on EVERY
-        read: a corrupted entry (and its now-untrusted subtree) is
-        dropped and reported as a miss, so the caller re-prefills and
-        the generation stays byte-exact."""
+        of the two-tier chain walk. On a local miss the federated peer
+        tier (ISSUE 17) is consulted OUTSIDE the store lock: a peer's
+        entry is fetched, CRC-verified and inserted locally, then read
+        back through the normal local path — so every caller-visible
+        entry passed the same integrity gate regardless of where it
+        came from. Any transport failure is a plain miss (re-prefill)."""
+        e = self.get_local(key)
+        if e is not None:
+            return e
+        fed = self.federated
+        if fed is not None and fed.fetch_into([key]):
+            return self.get_local(key)
+        return None
+
+    def get_local(self, key: bytes):
+        """The local half of get(): LRU-touched CRC-checked read of
+        THIS store only — never the federated tier. The wire server
+        serves peers through this accessor (a served fetch recursing
+        into the peer tier would let two cold hosts chase each other's
+        misses forever). The page CRC is verified on EVERY read: a
+        corrupted entry (and its now-untrusted subtree) is dropped and
+        reported as a miss, so the caller re-prefills and the
+        generation stays byte-exact."""
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -443,8 +469,23 @@ class HostPageStore:
             return e
 
     def contains(self, key: bytes) -> bool:
+        """LOCAL membership only — offload/pin/await logic must reason
+        about THIS store's contents, never a peer's."""
         with self._lock:
             return key in self._entries
+
+    def contains_any(self, key: bytes) -> bool:
+        """Membership across the local store AND the federated peer
+        tier — the cheap availability probe the admission walk and the
+        prefetch scan use (no LRU touch, no CRC, no transfer). A
+        contains_any()=True / get()=None race is already a handled
+        path for every caller (identical to a local CRC drop between
+        probe and read): availability shrinks and the walk re-selects
+        or re-prefills."""
+        if self.contains(key):
+            return True
+        fed = self.federated
+        return fed is not None and fed.peer_has(key)
 
     def note_restore(self, n_pages: int):
         with self._lock:

@@ -1,0 +1,361 @@
+"""Federated KV tier: fetch/push chain entries from peer hosts' stores.
+
+The client half of ISSUE 17's cross-host KV streaming transport
+(services/kv_wire.py is the serving half). A ``FederatedKV`` sits
+BEHIND a host's ``HostPageStore`` lookup — ``store.federated`` — so the
+existing two-tier chain walk transparently grows a third tier:
+
+    device pages -> local host store -> peer host stores -> re-prefill
+
+A restore miss on the local tier consults peers before falling back to
+re-prefill; whatever a peer ships is CRC-recomputed on arrival (exactly
+like the persisted-store reload path) and inserted into the LOCAL store
+first, so the engine's restore path reads only local, verified bytes.
+Any transport failure — refused connect, severed stream, CRC reject,
+scope mismatch — degrades to a plain miss: the caller re-prefills the
+identical token history, byte-identical output, just slower (the PR-3
+contract, now spanning hosts; DejaVu arXiv:2403.01876).
+
+Peer health mirrors federation.py's Worker: a connect/stream failure
+stamps ``failed_at`` and the peer sits out a cooldown window instead of
+being hammered on every miss. Membership probes (``peer_has``) keep a
+short-TTL negative cache so an admission walk over a long cold chain
+costs one HAS round-trip per peer, not one per page.
+
+``store.federated`` stays None unless clustering is armed, so
+``cluster=off`` is bit-for-bit the single-host path — the store-level
+hook dissolves into one ``is not None`` check.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+
+from localai_tpu.services.kv_wire import (OP_DIGEST, OP_ERR, OP_FETCH,
+                                          OP_HAS, OP_HELLO, OP_OK, OP_PUSH,
+                                          OP_STATS, WIRE_VERSION, WireError,
+                                          _jdump, _jload, pack_entries,
+                                          recv_frame, send_frame,
+                                          unpack_entries)
+
+log = logging.getLogger(__name__)
+
+# a failed peer sits out this long before being retried
+PEER_COOLDOWN_S = 5.0
+# negative membership answers are cached this long (admission probes of
+# a cold chain must not ask the same peer the same question per page)
+NEG_TTL_S = 0.5
+
+
+class KVStreamClient:
+    """One framed, reconnecting connection to a peer's KVWireServer.
+
+    Thread-safe: the engine loop, the sync worker, and the cluster
+    router may all fetch concurrently; frames are request/response, so
+    one lock serializes the socket. Reconnect + HELLO happen lazily on
+    the next request after any failure."""
+
+    def __init__(self, address: str, scope: bytes, page_size: int,
+                 timeout_s: float = 5.0):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self._addr = (host or "127.0.0.1", int(port))
+        self.scope = scope
+        self.page_size = int(page_size)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._sock = None
+        self.failed_at = 0.0
+        self.peer_host = -1
+
+    def online(self, cooldown_s: float = PEER_COOLDOWN_S) -> bool:
+        return (time.monotonic() - self.failed_at) > cooldown_s
+
+    # ---- transport ----
+
+    def _connect_locked(self):
+        s = socket.create_connection(self._addr, timeout=self.timeout_s)
+        s.settimeout(self.timeout_s)
+        try:
+            send_frame(s, OP_HELLO, _jdump(
+                {"version": WIRE_VERSION, "scope": self.scope.hex(),
+                 "page_size": self.page_size}))
+            op, payload = recv_frame(s)
+            if op != OP_OK:
+                raise WireError(f"HELLO refused: {_jload(payload)}")
+            self.peer_host = int(_jload(payload).get("host", -1))
+        except Exception:
+            s.close()
+            raise
+        self._sock = s
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def request(self, op: int, payload: bytes = b"") -> tuple:
+        """One round-trip; raises WireError/OSError on failure (the
+        socket is dropped — the next call reconnects + re-HELLOs)."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect_locked()
+                send_frame(self._sock, op, payload)
+                rop, rpayload = recv_frame(self._sock)
+            except (OSError, WireError):
+                self._close_locked()
+                self.failed_at = time.monotonic()
+                raise
+            if rop == OP_ERR:
+                raise WireError(str(_jload(rpayload).get("error", "?")))
+            self.failed_at = 0.0
+            return rop, rpayload
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+    # ---- convenience ops ----
+
+    def has(self, keys: list) -> list:
+        _, payload = self.request(OP_HAS, _jdump(
+            {"keys": [k.hex() for k in keys]}))
+        return [bool(b) for b in _jload(payload)["has"]]
+
+    def fetch(self, keys: list) -> bytes:
+        """Raw entry payload for ``keys`` (b"" = nothing present)."""
+        _, payload = self.request(OP_FETCH, _jdump(
+            {"keys": [k.hex() for k in keys]}))
+        return payload
+
+    def push(self, body: bytes) -> dict:
+        _, payload = self.request(OP_PUSH, body)
+        return _jload(payload)
+
+    def digest(self) -> dict:
+        _, payload = self.request(OP_DIGEST)
+        return _jload(payload)
+
+    def stats(self) -> dict:
+        _, payload = self.request(OP_STATS)
+        return _jload(payload)
+
+
+class FederatedKV:
+    """The peer tier behind one HostPageStore's lookup.
+
+    Attach with ``attach()``; from then on ``store.get`` consults
+    ``fetch_into`` on a local miss and ``store.contains_any`` consults
+    ``peer_has``. Entries always land in the LOCAL store before the
+    caller sees them — the wire tier fills the local tier, it never
+    substitutes for it — so every engine read stays a local, CRC-checked
+    ``get_local``.
+
+    Conservation (ISSUE 15, lifted cluster-wide): an entry in flight on
+    the wire is a DECLARED EXTRA, never a leak — ``inflight`` counts
+    outstanding fetch/push round-trips and must read zero once the
+    cluster is quiesced (ClusterRouter.kv_audit_sweep enforces it)."""
+
+    def __init__(self, store, peers: list):
+        self.store = store
+        self.peers = list(peers)
+        self._lock = threading.Lock()
+        self._neg: dict = {}         # key -> monotonic stamp of last miss
+        self.inflight = 0
+        # telemetry -> localai_kv_stream_{pages,bytes,fetches,hits,
+        # misses}_total (+ pushes/corrupt for /debug/kv)
+        self.fetches = 0             # fetch round-trips issued
+        self.hits = 0                # fetch round-trips that landed pages
+        self.misses = 0              # round-trips that landed nothing
+        self.pages = 0               # entries admitted from peers
+        self.bytes = 0               # payload bytes received
+        self.pushes = 0              # push round-trips issued
+        self.pushed_pages = 0        # entries shipped by push
+        self.corrupt_rejected = 0    # CRC-rejected on arrival
+        self.has_queries = 0
+
+    def attach(self):
+        self.store.federated = self
+        return self
+
+    def detach(self):
+        if self.store.federated is self:
+            self.store.federated = None
+
+    def close(self):
+        self.detach()
+        for p in self.peers:
+            p.close()
+
+    # ---- membership ----
+
+    def peer_has(self, key: bytes) -> bool:
+        """Does ANY online peer hold this chain key? Negative answers
+        are cached for NEG_TTL_S; positives are not cached at all — the
+        follow-up get() lands the entry locally, which IS the cache."""
+        now = time.monotonic()
+        with self._lock:
+            t = self._neg.get(key)
+            if t is not None and now - t < NEG_TTL_S:
+                return False
+            self.has_queries += 1
+        for p in self.peers:
+            if not p.online():
+                continue
+            try:
+                if p.has([key])[0]:
+                    return True
+            except (OSError, WireError):
+                continue
+        with self._lock:
+            self._neg[key] = now
+            if len(self._neg) > 65536:
+                self._neg.clear()
+        return False
+
+    # ---- fetch ----
+
+    def _admit(self, payload: bytes) -> int:
+        """CRC-verify and insert a fetched payload into the local store.
+        Returns entries admitted; rejects ride the corrupt counter and
+        degrade to a miss (the caller re-prefills — always correct)."""
+        from localai_tpu.engine.kv_offload import _page_crc
+
+        store = self.store
+        ents = unpack_entries(payload, store.scope, store.page_size)
+        n = 0
+        for ent in ents:
+            if _page_crc(ent["k"], ent["v"]) != ent["crc"]:
+                with self._lock:
+                    self.corrupt_rejected += 1
+                log.warning("kv stream: CRC reject on fetched page "
+                            "depth=%d — degrading to re-prefill",
+                            ent["depth"])
+                continue
+            dk, dv = ent["dk"], ent["dv"]
+            if dk is not None and _page_crc(dk, dv) != ent["dcrc"]:
+                dk = dv = None   # draft planes decay, target survives
+            store.put(ent["key"], ent["parent"], ent["depth"],
+                      ent["k"], ent["v"], dk=dk, dv=dv)
+            if store.audit is not None:
+                store.audit.ledger.record("stream_in", key=ent["key"])
+            n += 1
+        return n
+
+    def fetch_into(self, keys: list) -> int:
+        """Fetch ``keys`` from the first online peer that has them and
+        insert into the local store. Returns entries admitted. Every
+        failure mode (dead peer, severed stream, CRC reject) returns 0
+        for the missing keys — a plain miss."""
+        want = [k for k in keys if not self.store.contains(k)]
+        if not want:
+            return 0
+        with self._lock:
+            self.inflight += 1
+            self.fetches += 1
+        admitted = 0
+        try:
+            for p in self.peers:
+                if not p.online():
+                    continue
+                try:
+                    payload = p.fetch(want)
+                except (OSError, WireError) as e:
+                    log.warning("kv stream: fetch from %s failed: %s",
+                                p.address, e)
+                    continue
+                if not payload:
+                    continue
+                try:
+                    n = self._admit(payload)
+                except WireError as e:
+                    log.warning("kv stream: bad payload from %s: %s",
+                                p.address, e)
+                    continue
+                admitted += n
+                with self._lock:
+                    self.pages += n
+                    self.bytes += len(payload)
+                if admitted:
+                    break        # one peer served the chain: done
+        finally:
+            with self._lock:
+                self.inflight -= 1
+                if admitted:
+                    self.hits += 1
+                    for k in want:
+                        self._neg.pop(k, None)
+                else:
+                    self.misses += 1
+        return admitted
+
+    def prefetch(self, keys: list) -> int:
+        """Batch-fetch a whole chain ahead of an admission (disagg
+        handoff, crash re-adoption) — one FETCH round-trip for every
+        key not already local."""
+        return self.fetch_into(list(keys))
+
+    # ---- push ----
+
+    def push_to(self, peer: "KVStreamClient", keys: list) -> int:
+        """Ship local entries for ``keys`` to one peer (disagg chain
+        retirement / proactive replication). Returns entries the peer
+        accepted; 0 on any failure (the puller-side federated tier
+        still covers the chain, so push is an optimization, never a
+        correctness dependency)."""
+        store = self.store
+        ents = []
+        for k in keys:
+            e = store.get_local(k)
+            if e is None:
+                break            # chains are root-down: stop at a hole
+            ents.append(e)
+        if not ents:
+            return 0
+        body = pack_entries(store.scope, store.page_size, ents)
+        with self._lock:
+            self.inflight += 1
+        try:
+            r = peer.push(body)
+        except (OSError, WireError) as e:
+            log.warning("kv stream: push to %s failed: %s",
+                        peer.address, e)
+            return 0
+        finally:
+            with self._lock:
+                self.inflight -= 1
+        n = int(r.get("accepted", 0))
+        with self._lock:
+            self.pushes += 1
+            self.pushed_pages += n
+            self.bytes += len(body)
+        if store.audit is not None:
+            for e in ents[:n]:
+                store.audit.ledger.record("stream_out", key=e.key)
+        return n
+
+    # ---- observability ----
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "peers": len(self.peers),
+                "peers_online": sum(1 for p in self.peers if p.online()),
+                "inflight": self.inflight,
+                "fetches": self.fetches,
+                "hits": self.hits,
+                "misses": self.misses,
+                "pages": self.pages,
+                "bytes": self.bytes,
+                "pushes": self.pushes,
+                "pushed_pages": self.pushed_pages,
+                "corrupt_rejected": self.corrupt_rejected,
+                "has_queries": self.has_queries,
+            }

@@ -309,6 +309,12 @@ class PoolPrefixIndex:
         with self._lock:
             return len(self._where)
 
+    def keys(self) -> list:
+        """Every chain key some replica's device tier retains — the
+        host's contribution to the cluster routing digest (ISSUE 17)."""
+        with self._lock:
+            return list(self._where)
+
     def note_insert(self, replica: int, key: bytes, depth: int) -> None:
         with self._lock:
             self._where.setdefault(key, {})[replica] = depth

@@ -11,6 +11,8 @@ SURVEY §2.4: "front-door LB over N model servers / pods (DCN)").
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import logging
 import random
 import time
@@ -61,42 +63,76 @@ class FederatedServer:
         return random.choice(candidates)
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
+        """Stream one request through a worker. Failure attribution
+        matters (ISSUE 17 satellite): only UPSTREAM faults — refused
+        connect, timeout, a mid-stream read error — stamp ``failed_at``
+        and bench the worker. A CLIENT that disconnects mid-stream (the
+        common case for abandoned SSE token streams) must NOT count
+        against the worker, and must still decrement ``inflight`` so
+        least-used routing never sees phantom load."""
         worker = self.pick()
         url = f"{worker.base}{request.path_qs}"
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in HOP_HEADERS}
         body = await request.read()
         worker.inflight += 1
-        resp = None
         try:
             session = self._get_session()
-            async with session.request(request.method, url, data=body,
-                                       headers=headers) as upstream:
+            try:
+                upstream = await session.request(
+                    request.method, url, data=body, headers=headers)
+            except asyncio.CancelledError:
+                raise            # client gone before connect: not a fault
+            except Exception as e:
+                # connect refused / DNS / timeout: the worker is at
+                # fault, and nothing is on the wire yet — clean 502
+                worker.failed_at = time.monotonic()
+                log.warning("worker %s failed: %s", worker.base, e)
+                raise web.HTTPBadGateway(
+                    text=f"worker {worker.base} failed: {e}")
+            try:
                 resp = web.StreamResponse(status=upstream.status)
                 for k, v in upstream.headers.items():
                     if k.lower() not in HOP_HEADERS:
                         resp.headers[k] = v
                 await resp.prepare(request)
                 # stream chunks through (SSE token streams stay live)
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
+                while True:
+                    try:
+                        chunk = await upstream.content.readany()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        # mid-stream UPSTREAM failure: worker at fault;
+                        # headers already sent, so terminate the stream
+                        # (a second response would corrupt the wire)
+                        worker.failed_at = time.monotonic()
+                        log.warning("worker %s failed mid-stream: %s",
+                                    worker.base, e)
+                        with contextlib.suppress(Exception):
+                            await resp.write_eof()
+                        return resp
+                    if not chunk:
+                        break
+                    try:
+                        await resp.write(chunk)
+                    except asyncio.CancelledError:
+                        raise
+                    except (ConnectionError, RuntimeError) as e:
+                        # CLIENT dropped mid-stream: the worker did
+                        # nothing wrong — stays online, no failed_at
+                        log.debug("client dropped mid-stream (%s); "
+                                  "worker %s stays online", e, worker.base)
+                        return resp
+                upstream.release()   # fully drained: pool the connection
                 await resp.write_eof()
                 return resp
-        except Exception as e:
-            worker.failed_at = time.monotonic()
-            log.warning("worker %s failed: %s", worker.base, e)
-            if resp is None or not resp.prepared:
-                # nothing on the wire yet: a clean 502 is still possible
-                raise web.HTTPBadGateway(
-                    text=f"worker {worker.base} failed: {e}")
-            # headers/partial body already sent: terminate the stream
-            # instead of raising (a second response would corrupt the wire)
-            import contextlib
-
-            with contextlib.suppress(Exception):
-                await resp.write_eof()
-            return resp
+            finally:
+                upstream.close()     # no-op after release(); otherwise
+                                     # drops the half-read connection
         finally:
+            # every exit — success, 502, upstream fault, client
+            # disconnect, cancellation — releases the in-flight slot
             worker.inflight -= 1
 
     async def status(self, request: web.Request) -> web.Response:

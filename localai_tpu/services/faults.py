@@ -36,6 +36,17 @@ Injection points (grep for ``FAULTS.take``):
                                  KV auditor must detect (ISSUE 15)
 ``replicaN_die``                 engine loop tick top: raise, killing replica
                                  N's loop (pool crash recovery)
+``clusterN_die``                 same hook, host-scoped name: kill every
+                                 engine loop on cluster host N (router
+                                 crash recovery, ISSUE 17)
+``kv_stream_drop``               services/kv_wire.py FETCH handler: sever the
+                                 peer stream mid-chain (no reply, socket
+                                 shutdown) — the puller must degrade to a
+                                 local re-prefill, byte-identical
+``kv_stream_corrupt``            services/kv_wire.py FETCH handler: flip a
+                                 byte in the shipped payload (the receiver's
+                                 CRC recompute must reject the entry; the
+                                 server's own store is untouched)
 ==========================  =================================================
 """
 

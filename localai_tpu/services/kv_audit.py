@@ -52,7 +52,12 @@ import numpy as np
 TRANSITIONS = ("alloc", "free", "share", "clone", "hold", "drop",
                "splice", "release", "retain", "evict", "offload",
                "restore", "host_evict", "adopt", "migrate", "reset",
-               "demote", "compress", "prefetch")
+               "demote", "compress", "prefetch",
+               # ISSUE 17 cluster transport: entries crossing the wire
+               # are declared extras, never leaks — stream_in/stream_out
+               # bracket a FederatedKV fetch/push, disagg marks a
+               # prefill-role chain retirement to the decode host
+               "stream_in", "stream_out", "disagg")
 
 
 class KVLifecycleError(RuntimeError):

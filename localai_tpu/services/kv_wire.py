@@ -1,0 +1,460 @@
+"""Cross-host KV wire protocol: a HostPageStore made network-addressable.
+
+ISSUE 17's transport layer. DejaVu (arXiv:2403.01876) streams KV-cache
+state between hosts so prefill/decode disaggregation, cross-host warm
+restores, and crash recovery all ride one mechanism; this module is the
+wire half of that design for the TPU serving stack. A ``KVWireServer``
+fronts one host's (shared) ``HostPageStore`` over length-prefixed TCP
+frames; peers fetch/push chain entries — target AND draft planes —
+with exactly the integrity discipline the on-disk ``kv_host_store``
+persistence enforces: a protocol version tag, the full page SCOPE
+(model family + attention geometry + cache dtype + page size), and a
+CRC per plane set that the RECEIVER recomputes before admitting a page
+(bad bytes never enter a store; the requester re-prefills, which is
+always correct).
+
+Frame format (all integers big-endian)::
+
+    +--------+-----+------------------+
+    | len:u32| op:u8| payload[len]    |
+    +--------+-----+------------------+
+
+Control payloads (HELLO/HAS/DIGEST/STATS and every reply envelope) are
+UTF-8 JSON; entry payloads (FETCH replies, PUSH requests) are the
+store's own npz container format (``pack_entries``) extended with the
+draft planes the on-disk format deliberately drops — on the wire a
+draft plane is worth shipping (the peer's speculation warms instantly),
+on disk it is not (staleness risk across restarts).
+
+Sessions are stateful: a client MUST open with HELLO, which pins the
+protocol version and the store scope for the connection — every later
+frame on a mismatched session is refused. The server is a daemon
+``ThreadingTCPServer``: one OS thread per peer connection, blocking
+reads, no event loop — peers are few (a pod's worth of hosts), frames
+are large, and the GIL releases during socket I/O and numpy copies.
+
+Chaos hooks (services/faults.py): ``kv_stream_drop`` severs the
+connection mid-FETCH instead of replying (the requester sees a dead
+peer and degrades to local re-prefill); ``kv_stream_corrupt`` flips a
+byte in the outgoing COPY of a fetched page so the receiver's CRC check
+must reject it (the server's own store is never touched).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from localai_tpu.services.faults import FAULTS
+
+log = logging.getLogger(__name__)
+
+WIRE_VERSION = 1
+
+# ops
+OP_HELLO = 1
+OP_OK = 2
+OP_ERR = 3
+OP_HAS = 4
+OP_FETCH = 5
+OP_PUSH = 6
+OP_DIGEST = 7
+OP_STATS = 8
+
+_HDR = struct.Struct(">IB")
+# one frame tops out at 1 GiB — far above any sane chain batch, low
+# enough that a corrupted length prefix cannot OOM the receiver
+MAX_FRAME = 1 << 30
+# DIGEST caps the advertised key set: routing only needs the warm
+# working set, not an unbounded dump of a 100 GB host tier
+DIGEST_MAX_KEYS = 8192
+
+
+class WireError(RuntimeError):
+    """Protocol violation or peer-reported error."""
+
+
+def send_frame(sock, op: int, payload: bytes = b"") -> None:
+    sock.sendall(_HDR.pack(len(payload), op) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise WireError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock) -> tuple:
+    """(op, payload) or raises WireError on a severed/garbled stream."""
+    hdr = _recv_exact(sock, _HDR.size)
+    n, op = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise WireError(f"frame length {n} exceeds cap")
+    return op, _recv_exact(sock, n) if n else b""
+
+
+def _jdump(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def _jload(payload: bytes):
+    return json.loads(payload.decode()) if payload else {}
+
+
+# --------------- entry (de)serialization ---------------
+
+
+def _plane_payload(prefix: str, rows, payload: dict):
+    """Stage one K-or-V plane set into the npz payload dict; handles
+    the {"q","s"} int8 page dicts exactly like HostPageStore.save."""
+    from localai_tpu.engine.kv_offload import _to_savable
+
+    if isinstance(rows[0], dict):
+        payload[prefix + "q"] = np.stack([r["q"] for r in rows])
+        payload[prefix + "s"] = np.stack([r["s"] for r in rows])
+        return True
+    arr, name = _to_savable(np.stack(rows))
+    payload[prefix + "d"] = arr
+    payload[prefix + "dtype"] = np.asarray(name)
+    return False
+
+
+def _plane_unpack(prefix: str, data, n: int, quant: bool) -> list:
+    from localai_tpu.engine.kv_offload import _from_savable
+
+    if quant:
+        q, s = data[prefix + "q"], data[prefix + "s"]
+        return [{"q": q[i], "s": s[i]} for i in range(n)]
+    arr = _from_savable(data[prefix + "d"], str(data[prefix + "dtype"]))
+    return [arr[i] for i in range(n)]
+
+
+def pack_entries(scope: bytes, page_size: int, entries: list) -> bytes:
+    """Serialize host-store entries (``_HostEntry`` or anything with the
+    same attributes) for the wire. The carried CRCs are the SOURCE
+    store's — the receiver recomputes over the received bytes and
+    rejects on mismatch, so wire corruption can never be admitted."""
+    payload = {
+        "version": np.int32(WIRE_VERSION),
+        "scope": np.frombuffer(scope, np.uint8),
+        "page_size": np.int32(page_size),
+        "keys": np.stack([np.frombuffer(e.key, np.uint8)
+                          for e in entries]),
+        "parents": np.stack([np.frombuffer(e.parent, np.uint8)
+                             for e in entries]),
+        "depths": np.asarray([e.depth for e in entries], np.int64),
+        "crcs": np.asarray([e.crc for e in entries], np.uint32),
+    }
+    quant = _plane_payload("k", [e.k for e in entries], payload)
+    _plane_payload("v", [e.v for e in entries], payload)
+    payload["quant"] = np.int32(1 if quant else 0)
+    # draft planes (ISSUE 13) ride the wire — unlike disk persistence —
+    # as a masked sub-batch: only the entries that carry them
+    didx = [i for i, e in enumerate(entries) if e.dk is not None]
+    payload["didx"] = np.asarray(didx, np.int64)
+    if didx:
+        payload["dcrcs"] = np.asarray([entries[i].dcrc for i in didx],
+                                      np.uint32)
+        dq = _plane_payload("dk", [entries[i].dk for i in didx], payload)
+        _plane_payload("dv", [entries[i].dv for i in didx], payload)
+        payload["dquant"] = np.int32(1 if dq else 0)
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def unpack_entries(data: bytes, scope: bytes, page_size: int) -> list:
+    """Parse a pack_entries payload into per-entry dicts, enforcing the
+    version/scope/page-size contract (same rules as HostPageStore.load:
+    a mismatch means the bytes describe a DIFFERENT model or layout and
+    must be refused, not coerced). CRC verification is left to the
+    caller — the receiver recomputes over its OWN copy of the arrays so
+    a flip anywhere on the path is caught. Raises WireError on any
+    structural defect."""
+    try:
+        z = np.load(io.BytesIO(data), allow_pickle=False)
+        if int(z["version"]) != WIRE_VERSION:
+            raise WireError(f"wire version {int(z['version'])} != "
+                            f"{WIRE_VERSION}")
+        if (bytes(z["scope"].tobytes()) != scope
+                or int(z["page_size"]) != page_size):
+            raise WireError("scope/page-size mismatch (different model "
+                            "or layout)")
+        keys, parents, depths = z["keys"], z["parents"], z["depths"]
+        crcs = z["crcs"]
+        n = keys.shape[0]
+        quant = bool(int(z["quant"]))
+        ks = _plane_unpack("k", z, n, quant)
+        vs = _plane_unpack("v", z, n, quant)
+        didx = z["didx"].tolist()
+        dks = dvs = dcrcs = None
+        if didx:
+            dquant = bool(int(z["dquant"]))
+            dks = _plane_unpack("dk", z, len(didx), dquant)
+            dvs = _plane_unpack("dv", z, len(didx), dquant)
+            dcrcs = z["dcrcs"]
+        out = []
+        for i in range(n):
+            ent = {"key": bytes(keys[i].tobytes()),
+                   "parent": bytes(parents[i].tobytes()),
+                   "depth": int(depths[i]), "crc": int(crcs[i]),
+                   "k": ks[i], "v": vs[i],
+                   "dk": None, "dv": None, "dcrc": 0}
+            out.append(ent)
+        for j, i in enumerate(didx):
+            out[i]["dk"] = dks[j]
+            out[i]["dv"] = dvs[j]
+            out[i]["dcrc"] = int(dcrcs[j])
+        return out
+    except WireError:
+        raise
+    except Exception as e:
+        raise WireError(f"malformed entry payload: "
+                        f"{type(e).__name__}: {e}") from e
+
+
+# --------------- server ---------------
+
+
+class _PeerHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: KVWireServer = self.server.kv     # type: ignore[attr-defined]
+        hello = False
+        try:
+            while True:
+                op, payload = recv_frame(self.request)
+                if op == OP_HELLO:
+                    hello = srv._handle_hello(self.request, payload)
+                    continue
+                if not hello:
+                    send_frame(self.request, OP_ERR,
+                               _jdump({"error": "HELLO required first"}))
+                    return
+                if not srv._dispatch(self.request, op, payload):
+                    return       # fault-severed connection
+        except (WireError, OSError):
+            pass                 # peer went away: the thread just ends
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class KVWireServer:
+    """Serve one host's HostPageStore (and optionally its
+    PoolPrefixIndex digest) to cluster peers. The server reads the
+    store through its LOCAL accessors only — a served FETCH must never
+    recurse into the store's own federated tier, or two cold hosts
+    would chase each other's misses forever."""
+
+    def __init__(self, store, index=None, host_id: int = 0,
+                 bind: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        self.index = index
+        self.host_id = int(host_id)
+        self._bind = (bind, int(port))
+        self.address = ""
+        self._srv = None
+        self._thread = None
+        self._lock = threading.Lock()
+        # telemetry (monotonic totals; the serving half of the
+        # localai_kv_stream_* family — the client half lives on
+        # kv_stream.FederatedKV)
+        self.serves = 0          # FETCH requests answered
+        self.pages_out = 0       # entries shipped to peers
+        self.bytes_out = 0       # payload bytes shipped
+        self.pushes_in = 0       # PUSH requests accepted
+        self.pages_in = 0        # entries accepted from peers
+
+    # ---- lifecycle ----
+
+    def start(self) -> str:
+        self._srv = _Server(self._bind, _PeerHandler)
+        self._srv.kv = self      # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="kv-wire", daemon=True)
+        self._thread.start()
+        host, port = self._srv.server_address[:2]
+        self.address = f"{host}:{port}"
+        log.info("kv wire server host=%d listening on %s",
+                 self.host_id, self.address)
+        return self.address
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+    def stats(self) -> dict:
+        """Local (in-process) view of the serving counters — the same
+        numbers OP_STATS ships to peers."""
+        with self._lock:
+            return {"host": self.host_id, "serves": self.serves,
+                    "pages_out": self.pages_out,
+                    "bytes_out": self.bytes_out,
+                    "pushes_in": self.pushes_in,
+                    "pages_in": self.pages_in}
+
+    # ---- op handlers (connection threads) ----
+
+    def _handle_hello(self, sock, payload) -> bool:
+        req = _jload(payload)
+        store = self.store
+        if store is None:
+            send_frame(sock, OP_ERR, _jdump({"error": "no store"}))
+            return False
+        if (int(req.get("version", -1)) != WIRE_VERSION
+                or req.get("scope") != store.scope.hex()
+                or int(req.get("page_size", -1)) != store.page_size):
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": "version/scope/page-size mismatch",
+                 "version": WIRE_VERSION, "scope": store.scope.hex(),
+                 "page_size": store.page_size}))
+            return False
+        send_frame(sock, OP_OK, _jdump(
+            {"version": WIRE_VERSION, "host": self.host_id,
+             "scope": store.scope.hex(), "page_size": store.page_size}))
+        return True
+
+    def _dispatch(self, sock, op: int, payload: bytes) -> bool:
+        """Handle one post-HELLO frame; False = connection severed."""
+        store = self.store
+        if op == OP_HAS:
+            keys = [bytes.fromhex(k) for k in _jload(payload)["keys"]]
+            send_frame(sock, OP_OK, _jdump(
+                {"has": [1 if store.contains(k) else 0 for k in keys]}))
+            return True
+        if op == OP_FETCH:
+            return self._handle_fetch(sock, payload)
+        if op == OP_PUSH:
+            return self._handle_push(sock, payload)
+        if op == OP_DIGEST:
+            send_frame(sock, OP_OK, _jdump(self.digest()))
+            return True
+        if op == OP_STATS:
+            send_frame(sock, OP_OK, _jdump(
+                {"host": self.host_id, "stats": store.stats(),
+                 "serves": self.serves, "pages_out": self.pages_out,
+                 "bytes_out": self.bytes_out, "pushes_in": self.pushes_in,
+                 "pages_in": self.pages_in}))
+            return True
+        send_frame(sock, OP_ERR, _jdump({"error": f"unknown op {op}"}))
+        return True
+
+    def _handle_fetch(self, sock, payload) -> bool:
+        store = self.store
+        keys = [bytes.fromhex(k) for k in _jload(payload)["keys"]]
+        if FAULTS.active and FAULTS.take("kv_stream_drop") is not None:
+            # chaos: sever the peer stream mid-chain — no reply, no
+            # close handshake; the requester must degrade to local
+            # re-prefill byte-identically
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return False
+        ents = []
+        for k in keys:
+            # get_local: CRC-checked read, LRU touch, and — critically —
+            # no federated recursion (see class docstring)
+            e = store.get_local(k)
+            if e is None:
+                continue
+            ents.append(e)
+            if store.audit is not None:
+                store.audit.ledger.record("stream_out", key=k)
+        if not ents:
+            send_frame(sock, OP_OK, b"")
+            return True
+        body = pack_entries(store.scope, store.page_size, ents)
+        if FAULTS.active and FAULTS.take("kv_stream_corrupt") is not None:
+            # chaos: flip one byte of the first entry's K plane in the
+            # outgoing COPY (re-pack from corrupted clones) so the
+            # receiver's CRC recompute MUST reject it; the local store
+            # is untouched
+            import copy
+
+            bad = []
+            for e in ents:
+                c = copy.copy(e)
+                bad.append(c)
+            first = bad[0]
+            k0 = first.k
+            leaf = next(iter(k0.values())) if isinstance(k0, dict) else k0
+            flat = np.array(leaf, copy=True).view(np.uint8).reshape(-1)
+            flat[0] ^= 0xFF
+            corrupted = flat.view(leaf.dtype).reshape(leaf.shape)
+            if isinstance(k0, dict):
+                nk = dict(k0)
+                nk[next(iter(k0))] = corrupted
+                first.k = nk
+            else:
+                first.k = corrupted
+            body = pack_entries(store.scope, store.page_size, bad)
+        with self._lock:
+            self.serves += 1
+            self.pages_out += len(ents)
+            self.bytes_out += len(body)
+        send_frame(sock, OP_OK, body)
+        return True
+
+    def _handle_push(self, sock, payload) -> bool:
+        from localai_tpu.engine.kv_offload import _page_crc
+
+        store = self.store
+        try:
+            ents = unpack_entries(payload, store.scope, store.page_size)
+        except WireError as e:
+            send_frame(sock, OP_ERR, _jdump({"error": str(e)}))
+            return True
+        accepted = rejected = 0
+        for ent in ents:
+            if _page_crc(ent["k"], ent["v"]) != ent["crc"]:
+                rejected += 1
+                continue
+            dk, dv = ent["dk"], ent["dv"]
+            if dk is not None and _page_crc(dk, dv) != ent["dcrc"]:
+                dk = dv = None   # draft planes decay, target survives
+            store.put(ent["key"], ent["parent"], ent["depth"],
+                      ent["k"], ent["v"], dk=dk, dv=dv)
+            if store.audit is not None:
+                store.audit.ledger.record("stream_in", key=ent["key"])
+            accepted += 1
+        with self._lock:
+            self.pushes_in += 1
+            self.pages_in += accepted
+        send_frame(sock, OP_OK, _jdump(
+            {"accepted": accepted, "rejected": rejected}))
+        return True
+
+    # ---- digest (router affinity) ----
+
+    def digest(self) -> dict:
+        """The polled routing digest: which chain keys this host can
+        serve warm — its replicas' device tiers (the pool index) plus
+        the host tier itself — capped at DIGEST_MAX_KEYS. The router
+        matches a request's chain keys root-down against this set."""
+        keys = set()
+        if self.index is not None:
+            keys.update(self.index.keys())
+        store = self.store
+        if store is not None:
+            with store._lock:
+                keys.update(store._entries)
+        out = [k.hex() for k in list(keys)[:DIGEST_MAX_KEYS]]
+        return {"host": self.host_id, "keys": out,
+                "truncated": len(keys) > len(out),
+                "pages": store.pages if store is not None else 0}

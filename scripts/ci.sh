@@ -16,6 +16,7 @@
 #   LOCALAI_CHAOS_BUDGET_S    chaos phase wall clock (default 180 here)
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #   LOCALAI_LC_BUDGET_S       long-context phase wall clock (default 300)
+#   LOCALAI_CLUSTER_BUDGET_S  cluster phase wall clock (default 300)
 #
 # Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
@@ -291,5 +292,52 @@ if line.get("prefetch_late") != 0:
 sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
 PY
 rm -f "$lc_out"
+
+# Cross-host KV federation (ISSUE 17): a warm prefix admitted on one
+# host must serve on another via the KV streaming transport (no
+# re-prefill: KV_STREAM_HITS >= 1, byte-identical), a disaggregated
+# prefill->decode handoff must continue byte-identically on the decode
+# host, killing a host mid-stream must re-adopt on the sibling without
+# closing the client stream, and the cluster-wide audit must stay
+# clean. rc != 0 if any gate regresses.
+echo "== ci: bench cluster =="
+cluster_out=$(mktemp)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=128 \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_CLUSTER_BUDGET_S:-300}" \
+    python bench.py --cluster | tee "$cluster_out"
+
+python - "$cluster_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{") and "metric" in ln:
+        line = json.loads(ln)
+print(f"KV_STREAM_HITS={line.get('kv_stream_hits')} "
+      f"DISAGG_BYTE_MATCH={line.get('disagg_byte_match')} "
+      f"CLUSTER_HOST_RECOVERED={line.get('host_recovered')} "
+      f"stream_byte_match={line.get('stream_byte_match')} "
+      f"cold_ttft_ms={line.get('cold_ttft_ms')} "
+      f"warm_ttft_ms={line.get('warm_ttft_ms')} "
+      f"crash_byte_match={line.get('crash_byte_match')} "
+      f"itl_wave_ratio={line.get('itl_wave_ratio')}")
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+hits = line.get("kv_stream_hits")
+if (hits is None or not hits >= 1
+        or line.get("stream_byte_match") is not True
+        or line.get("disagg_byte_match") is not True
+        or line.get("host_recovered") != 1):
+    print(f"FAIL: cluster serving regressed (kv_stream_hits={hits} must "
+          f"be >= 1, stream_byte_match={line.get('stream_byte_match')} "
+          f"and disagg_byte_match={line.get('disagg_byte_match')} must "
+          f"be true, host_recovered={line.get('host_recovered')} must "
+          f"be 1)")
+    sys.exit(1)
+sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
+PY
+rm -f "$cluster_out"
 
 echo "== ci: OK =="

@@ -1,0 +1,300 @@
+"""Cluster serving (engine/cluster.py, ISSUE 17): cross-host warm
+prefix serving over the KV streaming transport, digest-driven affinity
+routing, prefill/decode disaggregation, host-death recovery, and the
+cluster-wide audit sweep.
+
+The byte gates are PR-10's resume contract lifted across HOSTS: a
+continuation that crossed the wire (disagg handoff, crash re-adoption)
+must equal a FRESH re-admission of (prompt + tokens emitted before the
+handoff) on the adopting host — the reference goes through the router
+so it splices the same conditioning tier (the PR-10 numerics caveat)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.engine.cluster import ClusterHost, ClusterRouter
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _greedy(tok, prompt: str, n: int = 8, priority: str = "") -> eng.GenRequest:
+    return eng.GenRequest(
+        prompt_ids=tok.encode(prompt),
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n, ignore_eos=True, priority=priority)
+
+
+def _collect(out, timeout: float = 60.0) -> list:
+    events = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return events
+        events.append(ev)
+
+
+def _ecfg(**kw):
+    base = dict(num_slots=2, max_context=96, prefill_buckets=(16, 64),
+                decode_burst=4, kv_page_size=8, kv_audit="strict")
+    base.update(kw)
+    return eng.EngineConfig(**base)
+
+
+# ---- construction guards ----
+
+
+def test_cluster_host_build_rejections(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    with pytest.raises(ValueError, match="preempt"):
+        ClusterHost.build(cfg, params, byte_tokenizer,
+                          _ecfg(preempt=False))
+    with pytest.raises(ValueError, match="kv_offload"):
+        ClusterHost.build(cfg, params, byte_tokenizer,
+                          _ecfg(kv_offload=False))
+    with pytest.raises(AssertionError):
+        ClusterHost(0, pool=None, role="sideways")
+
+
+# ---- live two-host cluster (role=both) ----
+
+
+@pytest.fixture(scope="module")
+def cluster(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    hosts = [ClusterHost.build(cfg, params, byte_tokenizer, _ecfg(),
+                               host_id=i, engines=1) for i in range(2)]
+    router = ClusterRouter(hosts)
+    router.start()
+    yield router
+    router.shutdown()
+
+
+def _wait_for(pred, timeout=10.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what or pred}")
+
+
+def test_warm_prefix_streams_across_hosts(cluster, byte_tokenizer):
+    """The tentpole acceptance: a warm prefix admitted on host A serves
+    on host B WITHOUT re-prefill — the chain streams over the wire
+    (kv_stream hits > 0), lands CRC-verified in B's local tier, and the
+    greedy continuation is byte-identical."""
+    router = cluster
+    prompt = "cross-host warm prefix, streamed not re-prefilled!"
+    req1 = _greedy(byte_tokenizer, prompt, 12)
+    evs1 = _collect(router.submit(req1, host=0))
+    assert all(e.error is None for e in evs1)
+    assert router.where(req1.request_id) == 0
+    h0, h1 = router.hosts
+    keys = list(h0.pool._engines[0]._pcache.chain_keys(req1.prompt_ids))
+    assert len(keys) >= 2, "prompt must span >= 2 full pages"
+    # release-time checkpoint: the finished chain lands in host 0's
+    # HOST tier (async offload worker), where the wire can serve it
+    store0 = h0.pool._shared.store
+    _wait_for(lambda: all(store0.contains(k) for k in keys),
+              what="host 0 release-time chain offload")
+    s_before = h1.fed.stats()
+    reused0 = h1.pool.metrics().get("prompt_tokens_reused") or 0
+    req2 = _greedy(byte_tokenizer, prompt, 12)
+    evs2 = _collect(router.submit(req2, host=1))
+    assert all(e.error is None for e in evs2)
+    assert eng.event_ids(evs2) == eng.event_ids(evs1)   # byte gate
+    s_after = h1.fed.stats()
+    assert s_after["hits"] > s_before["hits"]
+    assert s_after["pages"] >= s_before["pages"] + len(keys)
+    assert s_after["bytes"] > s_before["bytes"]
+    # the streamed pages SPLICED (prefix reuse), not re-prefilled
+    assert (h1.pool.metrics().get("prompt_tokens_reused") or 0) > reused0
+    # ...and landed in B's local tier first
+    assert all(h1.pool._shared.store.contains(k) for k in keys)
+    assert h0.server.stats()["pages_out"] >= len(keys)
+
+
+def test_digest_affinity_routes_to_warm_host(cluster, byte_tokenizer):
+    """The router's polled DIGEST drives prefix-affinity: a repeat
+    prompt routes to a host advertising its chain keys."""
+    router = cluster
+    prompt = "digest affinity should find the warm host here"
+    req1 = _greedy(byte_tokenizer, prompt, 8)
+    evs1 = _collect(router.submit(req1, host=0))
+    assert all(e.error is None for e in evs1)
+    keys = list(router.hosts[0].pool._engines[0]._pcache.chain_keys(
+        req1.prompt_ids))
+    _wait_for(lambda: keys[0] in router._digests[0],
+              what="digest poll to advertise host 0's chain")
+    hits0 = router.affinity_hits
+    req2 = _greedy(byte_tokenizer, prompt, 8)
+    evs2 = _collect(router.submit(req2))          # unpinned: affinity
+    assert all(e.error is None for e in evs2)
+    assert router.affinity_hits == hits0 + 1
+    assert eng.event_ids(evs2) == eng.event_ids(evs1)
+
+
+def test_cluster_metrics_and_audit_clean(cluster):
+    router = cluster
+    m = router.metrics()
+    assert m["cluster"]["hosts"] == 2
+    assert m["cluster"]["hosts_alive"] == 2
+    assert m["cluster"]["routed"] >= 1
+    assert m["kv_stream"]["fetches"] >= 1
+    assert m["kv_stream"]["inflight"] == 0
+    assert m["kv_stream_served"]["pages_out"] >= 1
+    assert len(m["hosts"]) == 2 and all(h["alive"] for h in m["hosts"])
+    dbg = router.kv_debug()
+    assert dbg["cluster_hosts"] == 2
+    # strict audit, cluster-wide, with the transport quiesced (the
+    # drained=True variant additionally requires an EMPTIED pool — a
+    # post-shutdown check, not a live-serving one)
+    snap = router.kv_audit_sweep()
+    assert snap["mode"] == "strict"
+    assert snap["violations"] == 0, snap
+    assert snap["stream_inflight"] == 0
+
+
+def test_host_death_mid_stream_sibling_continues(cluster, byte_tokenizer):
+    """The DejaVu failure model at cluster level: host 0's engine loop
+    dies mid-decode. Its host tier + wire server survive (loop death is
+    not store death); the router harvests the in-flight request onto
+    host 1, whose federated tier streams the checkpointed chain out of
+    the carcass — the client stream never errors, restore rows tick on
+    the sibling, and the continuation passes the byte gate.
+
+    KEEP LAST in this module: it permanently kills host 0 of the
+    module-scoped cluster."""
+    router = cluster
+    h0, h1 = router.hosts
+    prompt = "the cluster crash victim's warm prompt"
+    # phase 0: warm host 0's HOST tier with the prompt chain (release-
+    # time checkpoint), so the sibling's prefetch finds it on the wire
+    r0 = _greedy(byte_tokenizer, prompt, 4)
+    _collect(router.submit(r0, host=0))
+    keys = list(h0.pool._engines[0]._pcache.chain_keys(r0.prompt_ids))
+    assert len(keys) >= 2
+    store0 = h0.pool._shared.store
+    _wait_for(lambda: all(store0.contains(k) for k in keys),
+              what="host 0 chain offload")
+    EVENTS.clear()
+    # phase 1: the victim streams from host 0, which dies under it
+    n = 48
+    victim = _greedy(byte_tokenizer, prompt, n)
+    out = router.submit(victim, host=0)
+    first = out.get(timeout=60.0)
+    assert first.error is None
+    sched1 = h1.pool._engines[0].metrics()["scheduler"]
+    fed1 = h1.fed.stats()
+    h0.kill()
+    evs = [first] + _collect(out)
+    # the stream finished WITHOUT an error despite the host crash
+    assert all(ev.error is None for ev in evs)
+    ids = eng.event_ids(evs)
+    assert len(ids) == n
+    assert router.where(victim.request_id) == 1
+    downs = [e for e in EVENTS.events() if e["event"] == "cluster_host_down"]
+    assert downs and downs[0]["host"] == 0
+    recs = [e for e in EVENTS.events()
+            if e["event"] == "cluster_host_recovered"]
+    assert recs and recs[0]["recovered"] >= 1 and recs[0]["failed"] == 0
+    migs = [e for e in EVENTS.events() if e["event"] == "migrate"
+            and e["rid"] == victim.request_id]
+    assert migs and migs[0]["reason"] == "host_crash"
+    k = migs[0]["n_decoded"]
+    assert 0 < k < n
+    # the sibling pulled the dead host's chain over the WIRE and
+    # spliced it — restore rows tick, stream pages flowed
+    sched2 = h1.pool._engines[0].metrics()["scheduler"]
+    assert sched2["adoptions"] >= sched1["adoptions"] + 1
+    assert sched2["resume_restore_rows"] > sched1["resume_restore_rows"]
+    assert h1.fed.stats()["pages"] > fed1["pages"]
+    m = router.metrics()
+    assert m["cluster"]["hosts_alive"] == 1
+    assert m["cluster"]["hosts_recovered"] == 1
+    # new work still flows (to the survivor)
+    after = _greedy(byte_tokenizer, "post-crash cluster traffic", 4)
+    assert all(ev.error is None for ev in _collect(router.submit(after)))
+    assert router.where(after.request_id) == 1
+    # the byte gate: recovered continuation == a FRESH submission of
+    # (prompt + the k pre-crash tokens) on the adopting host, which
+    # splices the same conditioning tier
+    ref = eng.event_ids(list(router.generate(eng.GenRequest(
+        prompt_ids=byte_tokenizer.encode(prompt) + ids[:k],
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n - k, ignore_eos=True), host=1)))
+    assert ids[k:] == ref
+    # strict audit stays clean across the crash + recovery
+    snap = router.kv_audit_sweep()
+    assert snap["violations"] == 0, snap
+
+
+# ---- prefill/decode disaggregation ----
+
+
+@pytest.fixture(scope="module")
+def disagg_cluster(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    hosts = [
+        ClusterHost.build(cfg, params, byte_tokenizer, _ecfg(),
+                          host_id=0, engines=1, role="prefill"),
+        ClusterHost.build(cfg, params, byte_tokenizer, _ecfg(),
+                          host_id=1, engines=1, role="decode"),
+    ]
+    router = ClusterRouter(hosts)
+    router.start()
+    yield router
+    router.shutdown()
+
+
+def test_disagg_prefill_hands_off_to_decode_host(
+        disagg_cluster, byte_tokenizer):
+    """Splitwise/DejaVu disaggregation: the prefill host pays TTFT,
+    retires the chain to the transport, and the decode host splices the
+    streamed chain and carries the stream — byte-identically."""
+    router = disagg_cluster
+    EVENTS.clear()
+    prompt = "disaggregate this prompt across the two roles"
+    n = 24
+    req = _greedy(byte_tokenizer, prompt, n)
+    out = router.submit(req)
+    # fresh arrivals route to the prefill-capable host
+    assert router.where(req.request_id) == 0
+    evs = _collect(out)
+    assert all(e.error is None for e in evs)
+    ids = eng.event_ids(evs)
+    assert len(ids) == n
+    # the handoff happened and the decode host finished the request
+    hand = [e for e in EVENTS.events() if e["event"] == "disagg_handoff"]
+    assert hand and hand[0]["rid"] == req.request_id
+    assert hand[0]["src"] == 0 and hand[0]["dst"] == 1
+    assert router.where(req.request_id) == 1
+    m = router.metrics()
+    assert m["cluster"]["disagg_handoffs"] >= 1
+    assert m["cluster"]["roles"] == {"0": "prefill", "1": "decode"}
+    # the chain crossed via the transport (prefetch before adoption)
+    assert router.hosts[1].fed.stats()["pages"] >= 1
+    # byte gate: continuation == fresh re-admission of (prompt + the k
+    # pre-handoff tokens) on the decode host
+    k = hand[0]["n_decoded"]
+    assert 0 < k < n
+    ref = eng.event_ids(list(router.generate(eng.GenRequest(
+        prompt_ids=byte_tokenizer.encode(prompt) + ids[:k],
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n - k, ignore_eos=True), host=1)))
+    assert ids[k:] == ref
+    snap = router.kv_audit_sweep()
+    assert snap["violations"] == 0, snap
+    assert snap["stream_inflight"] == 0

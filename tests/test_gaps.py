@@ -115,6 +115,105 @@ def test_federated_server_balances_and_survives_dead_worker():
     assert fed2.pick().base == f"http://127.0.0.1:{p2}"
 
 
+# failure attribution (ISSUE 17 satellite): only UPSTREAM faults bench a
+# worker; a client abandoning its stream must not, and inflight always
+# returns to zero either way
+
+
+def _stream_worker(chunks=150, delay=0.02, abort_after=None):
+    """Worker streaming `chunks` chunks; with abort_after, it severs its
+    own connection mid-stream WITHOUT a clean chunked-encoding EOF (an
+    upstream mid-stream fault as the proxy sees it)."""
+    import asyncio as aio
+
+    from aiohttp import web
+
+    async def handler(request):
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        for i in range(chunks):
+            await resp.write(b"x" * 1024)
+            if abort_after is not None and i + 1 >= abort_after:
+                request.transport.close()
+                return resp
+            await aio.sleep(delay)
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_route("*", "/{p:.*}", handler)
+    return app
+
+
+def _wait_inflight_zero(fed, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(w.inflight == 0 for w in fed.workers):
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"inflight never drained: {[w.inflight for w in fed.workers]}")
+
+
+def test_federation_refused_connect_benches_worker():
+    from localai_tpu.federation import FederatedServer
+
+    pf = free_port()
+    fed = FederatedServer(["http://127.0.0.1:1"])
+    _run_app_bg(fed.build_app(), pf)
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pf}", timeout=30)
+    r = c.post("/v1/chat/completions", json={})
+    assert r.status_code == 502
+    assert fed.workers[0].failed_at > 0.0      # benched: upstream fault
+    _wait_inflight_zero(fed)
+
+
+def test_federation_client_disconnect_not_a_worker_fault():
+    """A client that walks away mid-stream (abandoned SSE) must NOT
+    stamp failed_at — the worker did nothing wrong — and the in-flight
+    slot must still be released."""
+    from localai_tpu.federation import FederatedServer
+
+    pw, pf = free_port(), free_port()
+    _run_app_bg(_stream_worker(), pw)
+    fed = FederatedServer([f"http://127.0.0.1:{pw}"])
+    _run_app_bg(fed.build_app(), pf)
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pf}", timeout=30)
+    with c.stream("GET", "/v1/stream") as r:
+        assert r.status_code == 200
+        next(r.iter_bytes())                   # one chunk, then hang up
+    c.close()
+    _wait_inflight_zero(fed)
+    assert fed.workers[0].failed_at == 0.0     # stays online
+    assert fed.workers[0].online()
+
+
+def test_federation_upstream_midstream_fault_benches_worker():
+    """The worker dying mid-body IS an upstream fault: failed_at is
+    stamped, the truncated stream terminates (no second response), and
+    the in-flight slot is released."""
+    from localai_tpu.federation import FederatedServer
+
+    pw, pf = free_port(), free_port()
+    _run_app_bg(_stream_worker(abort_after=2), pw)
+    fed = FederatedServer([f"http://127.0.0.1:{pw}"])
+    _run_app_bg(fed.build_app(), pf)
+    c = httpx.Client(base_url=f"http://127.0.0.1:{pf}", timeout=30)
+    got = 0
+    try:
+        with c.stream("GET", "/v1/stream") as r:
+            assert r.status_code == 200        # headers made it through
+            for chunk in r.iter_bytes():
+                got += len(chunk)
+    except httpx.HTTPError:
+        pass                                   # truncated stream is fine
+    assert got <= 3 * 1024
+    _wait_inflight_zero(fed)
+    assert fed.workers[0].failed_at > 0.0      # benched: upstream fault
+
+
 # ---------- guesser ----------
 
 def _ckpt(tmp_path, name, chat_template=None, model_type="llama", extra=None):

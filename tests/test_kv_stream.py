@@ -1,0 +1,261 @@
+"""Cross-host KV streaming transport (ISSUE 17): wire (de)serialization,
+the KVWireServer/KVStreamClient pair, the FederatedKV peer tier behind
+HostPageStore.get, chaos-fault degrade paths, and knob validation.
+
+The invariant under test everywhere: whatever the wire does — serve,
+drop, corrupt, refuse — the requesting host ends up byte-identical,
+either via a CRC-verified locally-landed copy or via a plain miss that
+re-prefills."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from localai_tpu.engine.kv_offload import HostPageStore, _page_crc
+from localai_tpu.engine.kv_stream import FederatedKV, KVStreamClient
+from localai_tpu.ops import kvcache
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.kv_wire import (KVWireServer, WireError,
+                                          pack_entries, unpack_entries)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _scope(pgs=4, name="unit"):
+    return kvcache.page_scope(pgs, name)
+
+
+def _page(v, shape=(2, 4, 2, 8)):
+    return np.full(shape, v, np.float32)
+
+
+def _chain(store, n, start=0, parent=None, val=0.0, draft=False):
+    """Insert an n-entry chain; returns the keys."""
+    keys = []
+    parent = parent if parent is not None else kvcache.PAGE_HASH_ROOT
+    for i in range(n):
+        key = kvcache.page_chain_hash(parent, [start + i] * 4, store.scope)
+        store.put(key, parent, i, _page(val + i), _page(val + i + 100),
+                  dk=_page(val + i + 500) if draft else None,
+                  dv=_page(val + i + 600) if draft else None)
+        keys.append(key)
+        parent = key
+    return keys
+
+
+@pytest.fixture()
+def wire_pair():
+    """A serving store with a warm chain, a cold store, and a connected
+    client — torn down after the test."""
+    src = HostPageStore(_scope(), 4, budget_mb=64)
+    dst = HostPageStore(_scope(), 4, budget_mb=64)
+    server = KVWireServer(src, host_id=7)
+    addr = server.start()
+    client = KVStreamClient(addr, dst.scope, dst.page_size, timeout_s=5.0)
+    try:
+        yield src, dst, server, client
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------- (de)serialization ----------
+
+
+def test_pack_unpack_roundtrip_with_draft_planes():
+    s = HostPageStore(_scope(), 4, budget_mb=64)
+    keys = _chain(s, 3, draft=True)
+    # mixed batch: one entry without draft planes
+    extra = kvcache.page_chain_hash(keys[-1], [99] * 4, s.scope)
+    s.put(extra, keys[-1], 3, _page(40), _page(41))
+    ents = [s.get_local(k) for k in keys + [extra]]
+    body = pack_entries(s.scope, s.page_size, ents)
+    out = unpack_entries(body, s.scope, s.page_size)
+    assert len(out) == 4
+    for ent, e in zip(out, ents):
+        assert ent["key"] == e.key and ent["parent"] == e.parent
+        assert ent["depth"] == e.depth and ent["crc"] == e.crc
+        assert np.array_equal(ent["k"], e.k)
+        assert np.array_equal(ent["v"], e.v)
+        assert _page_crc(ent["k"], ent["v"]) == ent["crc"]
+    # draft planes ride the wire as a masked sub-batch
+    assert all(np.array_equal(out[i]["dk"], ents[i].dk) for i in range(3))
+    assert out[3]["dk"] is None and out[3]["dcrc"] == 0
+
+
+def test_pack_unpack_roundtrip_quantized_pages():
+    s = HostPageStore(_scope(), 4, budget_mb=64)
+    q = {"q": np.full((2, 4, 2, 8), 3, np.int8),
+         "s": np.full((2, 4, 1, 1), 0.5, np.float32)}
+    key = kvcache.page_chain_hash(kvcache.PAGE_HASH_ROOT, [1] * 4, s.scope)
+    s.put(key, kvcache.PAGE_HASH_ROOT, 0, dict(q), dict(q))
+    e = s.get_local(key)
+    out = unpack_entries(pack_entries(s.scope, s.page_size, [e]),
+                         s.scope, s.page_size)
+    assert isinstance(out[0]["k"], dict)
+    assert np.array_equal(out[0]["k"]["q"], q["q"])
+    assert np.array_equal(out[0]["k"]["s"], q["s"])
+    assert _page_crc(out[0]["k"], out[0]["v"]) == out[0]["crc"]
+
+
+def test_unpack_refuses_wrong_scope_and_page_size():
+    s = HostPageStore(_scope(), 4, budget_mb=64)
+    keys = _chain(s, 1)
+    body = pack_entries(s.scope, s.page_size, [s.get_local(keys[0])])
+    with pytest.raises(WireError, match="mismatch"):
+        unpack_entries(body, _scope(name="other"), s.page_size)
+    with pytest.raises(WireError, match="mismatch"):
+        unpack_entries(body, s.scope, 8)
+    with pytest.raises(WireError, match="malformed"):
+        unpack_entries(b"not an npz", s.scope, s.page_size)
+
+
+# ---------- wire server + client ----------
+
+
+def test_hello_pins_scope_and_refuses_mismatch(wire_pair):
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 2)
+    assert client.has(keys) == [True, True]   # implicit HELLO succeeded
+    assert client.peer_host == 7
+    bad = KVStreamClient(server.address, _scope(name="other"),
+                         src.page_size)
+    with pytest.raises(WireError, match="HELLO refused"):
+        bad.has(keys)
+    bad.close()
+
+
+def test_fetch_lands_byte_identical_entries(wire_pair):
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 3, draft=True)
+    fed = FederatedKV(dst, [client]).attach()
+    n = fed.fetch_into(keys)
+    assert n == 3
+    for k in keys:
+        a, b = src.get_local(k), dst.get_local(k)
+        assert np.array_equal(a.k, b.k) and np.array_equal(a.v, b.v)
+        assert np.array_equal(a.dk, b.dk)
+        assert a.crc == b.crc and a.parent == b.parent
+    st = fed.stats()
+    assert st["hits"] == 1 and st["misses"] == 0
+    assert st["pages"] == 3 and st["bytes"] > 0 and st["inflight"] == 0
+    sv = server.stats()
+    assert sv["serves"] == 1 and sv["pages_out"] == 3
+
+
+def test_store_get_streams_through_federated_tier(wire_pair):
+    """The tentpole hook: a restore miss on the local tier consults
+    peers transparently — store.get() itself fills from the wire."""
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 2)
+    fed = FederatedKV(dst, [client]).attach()
+    assert not dst.contains(keys[0])
+    assert dst.contains_any(keys[0])          # availability probe
+    e = dst.get(keys[0])                      # miss -> wire -> local
+    assert e is not None and np.array_equal(e.k, _page(0))
+    assert dst.contains(keys[0])              # landed locally first
+    fed.detach()
+    assert dst.get(keys[1]) is None           # detached: plain miss
+
+
+def test_peer_has_negative_cache(wire_pair):
+    src, dst, server, client = wire_pair
+    fed = FederatedKV(dst, [client]).attach()
+    ghost = b"\x05" * 16
+    assert not fed.peer_has(ghost)
+    q = fed.stats()["has_queries"]
+    assert not fed.peer_has(ghost)            # served from the neg cache
+    assert fed.stats()["has_queries"] == q
+
+
+def test_push_to_ships_chain(wire_pair):
+    src, dst, server, client = wire_pair
+    # invert the roles: dst holds the chain, pushes it to the server's
+    # store via the same client connection
+    keys = _chain(dst, 3)
+    fed = FederatedKV(dst, [client])
+    assert fed.push_to(client, keys) == 3
+    for k in keys:
+        assert src.contains(k)
+        assert np.array_equal(src.get_local(k).k, dst.get_local(k).k)
+    assert server.stats()["pages_in"] == 3
+    assert fed.stats()["pushed_pages"] == 3
+
+
+# ---------- chaos faults ----------
+
+
+def test_kv_stream_corrupt_is_rejected_and_degrades_to_miss(wire_pair):
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 2)
+    fed = FederatedKV(dst, [client]).attach()
+    FAULTS.arm("kv_stream_corrupt")
+    assert fed.fetch_into(keys) == 1          # entry 0 corrupted, 1 ok
+    assert not dst.contains(keys[0])          # CRC reject: never admitted
+    assert fed.stats()["corrupt_rejected"] == 1
+    # the server's OWN store is untouched — next fetch is clean
+    assert src.get_local(keys[0]) is not None
+    assert fed.fetch_into([keys[0]]) == 1
+    assert np.array_equal(dst.get_local(keys[0]).k,
+                          src.get_local(keys[0]).k)
+
+
+def test_kv_stream_corrupt_whole_fetch_is_a_plain_miss(wire_pair):
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 1)
+    fed = FederatedKV(dst, [client]).attach()
+    FAULTS.arm("kv_stream_corrupt")
+    assert dst.get(keys[0]) is None           # degrade: re-prefill path
+    assert fed.stats()["misses"] == 1 and fed.stats()["inflight"] == 0
+
+
+def test_kv_stream_drop_severs_and_client_reconnects(wire_pair):
+    src, dst, server, client = wire_pair
+    keys = _chain(src, 2)
+    fed = FederatedKV(dst, [client]).attach()
+    FAULTS.arm("kv_stream_drop")
+    assert fed.fetch_into(keys) == 0          # severed mid-FETCH
+    assert fed.stats()["misses"] == 1
+    assert not client.online()                # benched for the cooldown
+    client.failed_at = 0.0                    # cooldown elapses
+    assert fed.fetch_into(keys) == 2          # fresh connect + HELLO
+    assert dst.contains(keys[1])
+
+
+def test_dead_peer_is_a_plain_miss():
+    dst = HostPageStore(_scope(), 4, budget_mb=64)
+    dead = KVStreamClient("127.0.0.1:1", dst.scope, dst.page_size,
+                          timeout_s=0.5)
+    fed = FederatedKV(dst, [dead]).attach()
+    assert dst.get(b"\x09" * 16) is None
+    assert not dead.online()
+    assert fed.stats()["inflight"] == 0
+    dead.close()
+
+
+# ---------- knob validation ----------
+
+
+def test_cluster_knobs_validate():
+    from localai_tpu.config.model_config import ModelConfig
+
+    ok = ModelConfig(name="m", options=[
+        "disagg=prefill", "kv_peers=h1:9001|h2:9002", "kv_serve=1"])
+    assert ok.validate() == []
+    assert any("disagg" in p for p in ModelConfig(
+        name="m", options=["disagg=sideways"]).validate())
+    assert any("kv_peers" in p for p in ModelConfig(
+        name="m", options=["kv_peers=nope"]).validate())
+    assert any("kv_serve" in p for p in ModelConfig(
+        name="m", options=["kv_serve=:x"]).validate())
+    # cross-knob: disagg ships chains via pause/resume + the host tier
+    assert any("preempt" in p for p in ModelConfig(
+        name="m", options=["disagg=decode", "preempt=0"]).validate())
+    assert any("kv_offload" in p for p in ModelConfig(
+        name="m", options=["disagg=decode", "kv_offload=0"]).validate())
