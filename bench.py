@@ -1145,10 +1145,18 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
     accepted-tokens-per-dispatch (emitted spec tokens per verify round —
     1.0 means speculation bought nothing) and the emitted-token ITL on
     vs off. The byte gate doubles as the ``spec=0`` untouched check: the
-    off engine runs the plain burst path bit-for-bit."""
+    off engine runs the plain burst path bit-for-bit.
+
+    A second SAMPLED wave (ISSUE 18: temperature 0.8, fixed seed ladder,
+    top-k sharpened so prompt-lookup proposals land inside the filtered
+    window) reruns the same prompts through rejection-sampling
+    acceptance: headline ``sampled_accept_per_dispatch`` (from the
+    per-mode counter split) and a two-sample chi-square p-value of
+    spec-on vs spec-off token frequencies — sampled speculation is
+    lossless in DISTRIBUTION, not bytes, so the gate is statistical."""
     import jax.numpy as jnp
     from localai_tpu.engine import engine as eng
-    from localai_tpu.engine import sampling
+    from localai_tpu.engine import sampling, speculative
     from localai_tpu.engine.weights import random_params
 
     params = random_params(cfg)
@@ -1162,18 +1170,30 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
         prompts.append(p.tolist())
     ka = {}
 
-    def run_wave(draft):
+    def run_waves(draft):
+        # ONE engine (one precompile of the spec-tick ladder) serves the
+        # greedy wave then the sampled wave — the sampled wave riding the
+        # already-compiled tick is itself evidence that rejection
+        # acceptance shares the combined compiled body (ISSUE 18); the
+        # per-mode counter split keeps the headlines separable
         ecfg = eng.EngineConfig(
             num_slots=S, max_context=C, prefill_buckets=(32, 128),
             cache_dtype=jnp.float32, draft=draft)
         engine = eng.Engine(cfg, params, _ByteTokenizer(), ecfg,
                             eos_token_ids={cfg.vocab_size - 1})
         engine.start(precompile=True)
-        try:
+
+        def wave(sampled):
+            def _params(i):
+                if sampled:
+                    return sampling.SamplingParamsHost(
+                        temperature=0.8, seed=1000 + i, top_k=4)
+                return sampling.SamplingParamsHost(temperature=0.0)
+
             outs = [engine.submit(eng.GenRequest(
                 prompt_ids=list(p), max_new_tokens=max_new, ignore_eos=True,
-                params=sampling.SamplingParamsHost(temperature=0.0)))
-                for p in prompts]
+                params=_params(i)))
+                for i, p in enumerate(prompts)]
             ids, itls = [], []
             for o in outs:
                 toks, times = [], []
@@ -1188,14 +1208,20 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
                 ids.append(toks)
                 if len(times) > 1:
                     itls.append((times[-1] - times[0]) / (len(times) - 1))
+            return ids, itls
+
+        try:
+            ids_g, itls_g = wave(sampled=False)
+            ids_s, itls_s = wave(sampled=True)
             spec = (engine.metrics().get("spec") or {})
-            return ids, itls, spec
+            return ids_g, itls_g, ids_s, itls_s, spec
         finally:
             _kv_sweep(engine, ka)
             engine.shutdown()
 
-    ids_off, itls_off, _ = run_wave("0")
-    ids_on, itls_on, spec = run_wave("ngram")
+    ids_off, itls_off, ids_soff, itls_soff, _ = run_waves("0")
+    ids_on, itls_on, ids_son, itls_son, spec = run_waves("ngram")
+    bg = (spec.get("by_mode") or {}).get("greedy") or {}
     out = {"n_req": n_req, "max_new": max_new,
            "byte_match": ids_on == ids_off,
            "itl_on_ms": round(float(np.median(itls_on)) * 1e3, 3)
@@ -1203,13 +1229,40 @@ def bench_spec(cfg, S, C, n_req=None, max_new=64):
            "itl_off_ms": round(float(np.median(itls_off)) * 1e3, 3)
            if itls_off else None,
            "accept_per_dispatch": round(
-               spec.get("accept_per_dispatch", 0.0), 3),
-           "acceptance_rate": round(spec.get("acceptance_rate", 0.0), 3),
-           "rounds": spec.get("rounds", 0),
+               bg.get("accept_per_dispatch", 0.0), 3),
+           "acceptance_rate": round(bg.get("acceptance_rate", 0.0), 3),
+           "rounds": bg.get("rounds", 0),
            "dispatches": spec.get("dispatches", 0),
            "mixed_dispatches": spec.get("mixed_dispatches", 0)}
     if out["itl_on_ms"] and out["itl_off_ms"]:
         out["itl_speedup"] = round(out["itl_off_ms"] / out["itl_on_ms"], 2)
+
+    # sampled-wave gates (ISSUE 18): same prompts, temperature 0.8 +
+    # seed ladder; both runs are deterministic, so the chi-square
+    # p-value is a fixed number — the distribution-preservation gate
+    bm = (spec.get("by_mode") or {}).get("sampled") or {}
+    V = cfg.vocab_size
+
+    def _counts(ids):
+        flat = [t for toks in ids for t in toks]
+        return np.bincount(np.asarray(flat, np.int64), minlength=V)[:V]
+
+    _stat, dof, pval = speculative.two_sample_chi2(
+        _counts(ids_son), _counts(ids_soff))
+    out.update({
+        "sampled_accept_per_dispatch": round(
+            bm.get("accept_per_dispatch", 0.0), 3),
+        "sampled_acceptance_rate": round(
+            bm.get("acceptance_rate", 0.0), 3),
+        "sampled_rounds": bm.get("rounds", 0),
+        "sampled_itl_on_ms": round(float(np.median(itls_son)) * 1e3, 3)
+        if itls_son else None,
+        "sampled_itl_off_ms": round(float(np.median(itls_soff)) * 1e3, 3)
+        if itls_soff else None,
+        "sampled_chi2_p": round(pval, 4),
+        "sampled_chi2_dof": dof,
+        "sampled_dist_ok": bool(pval > 0.01),
+    })
     out.update(ka)
     return out
 
@@ -2591,7 +2644,17 @@ def _engine_direct_spec(deadline: float, partial: dict) -> dict:
                        "itl_speedup": r.get("itl_speedup"),
                        "rounds": r.get("rounds"),
                        "dispatches": r.get("dispatches"),
-                       "mixed_dispatches": r.get("mixed_dispatches")}
+                       "mixed_dispatches": r.get("mixed_dispatches"),
+                       # ISSUE 18: stochastic speculative sampling wave
+                       "sampled_accept_per_dispatch": r.get(
+                           "sampled_accept_per_dispatch"),
+                       "sampled_acceptance_rate": r.get(
+                           "sampled_acceptance_rate"),
+                       "sampled_rounds": r.get("sampled_rounds"),
+                       "sampled_itl_on_ms": r.get("sampled_itl_on_ms"),
+                       "sampled_itl_off_ms": r.get("sampled_itl_off_ms"),
+                       "sampled_chi2_p": r.get("sampled_chi2_p"),
+                       "sampled_dist_ok": r.get("sampled_dist_ok")}
                 _kv_pick(out, r)
         if not out:
             out = {"error": (f"rc={res.returncode} "
@@ -2998,7 +3061,9 @@ def main():
             r = bench_spec(cfg, S, C)
             ok = (r.get("accept_per_dispatch") is not None
                   and r.get("accept_per_dispatch") > 1.0
-                  and r.get("byte_match") is True)
+                  and r.get("byte_match") is True
+                  and (r.get("sampled_accept_per_dispatch") or 0) > 1.0
+                  and r.get("sampled_dist_ok") is True)
             print(json.dumps({
                 "metric": f"spec_{preset}",
                 "value": r.get("accept_per_dispatch"),
@@ -3234,10 +3299,16 @@ def main():
             "slo_violations": slo.get("violations_low"),
             "trace_merged": slo.get("trace_merged"),
             # speculative decoding (ISSUE 13): accepted tokens per verify
-            # dispatch with draft=ngram, byte parity vs speculation off
+            # dispatch with draft=ngram, byte parity vs speculation off;
+            # ISSUE 18 adds the sampled wave (rejection acceptance) —
+            # accept-per-dispatch must exceed 1.0 AND the chi-square test
+            # must not distinguish spec-on from plain sampling
             "spec": spec,
             "spec_accept_per_dispatch": spec.get("accept_per_dispatch"),
             "spec_byte_match": spec.get("byte_match"),
+            "spec_sampled_accept_per_dispatch": spec.get(
+                "sampled_accept_per_dispatch"),
+            "spec_sampled_dist_ok": spec.get("sampled_dist_ok"),
             # engine replica pool (ISSUE 14): affinity must hit on the
             # warm resubmission, migration and crash recovery must stay
             # byte-identical to a fresh pool re-admission

@@ -215,7 +215,10 @@ _DEVICE_MEM_GAUGES = (("bytes_in_use", "bytes_in_use"),
 # metrics()["slo"]; flight-recorder dump counters ride along
 _SLO_WINDOWS = (("burn_5m", "5m"), ("burn_1h", "1h"))
 # speculative decoding (ISSUE 13): per-round totals + the acceptance
-# rate, from engine metrics()["spec"]
+# rate, from engine metrics()["spec"]; since ISSUE 18 each series is
+# additionally split by acceptance mode — mode="greedy" (accept_greedy)
+# vs mode="sampled" (rejection-sampling acceptance) — from
+# metrics()["spec"]["by_mode"], alongside the unlabeled aggregate
 _SPEC_COUNTERS = (("rounds", "spec_rounds_total"),
                   ("proposed", "spec_proposed_total"),
                   ("accepted", "spec_accepted_total"))
@@ -382,6 +385,15 @@ def _refresh_engine_metrics(state):
             METRICS.set_gauge("spec_acceptance_rate",
                               spec.get("acceptance_rate", 0.0),
                               label_str(model=name))
+            # ISSUE 18: per-acceptance-mode split (greedy vs sampled)
+            for mode, c in (spec.get("by_mode") or {}).items():
+                for skey, mkey in _SPEC_COUNTERS:
+                    METRICS.set_counter(
+                        mkey, c.get(skey, 0),
+                        label_str(model=name, mode=mode))
+                METRICS.set_gauge("spec_acceptance_rate",
+                                  c.get("acceptance_rate", 0.0),
+                                  label_str(model=name, mode=mode))
         # system observability (ISSUE 8): compile counters, memory
         # watermarks, goodput/MFU
         so = stats.get("sysobs")

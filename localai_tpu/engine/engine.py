@@ -461,7 +461,8 @@ class _Burst:
     __slots__ = ("n_steps", "slots", "pack", "group", "t_dispatch",
                  "t_ready", "pack_np", "ids_np", "lps_np", "first_ids",
                  "first_lps", "folded", "skip_slots", "ready", "err",
-                 "head", "spec_mask", "spec_width", "n_out_np")
+                 "head", "spec_mask", "spec_width", "n_out_np",
+                 "spec_greedy")
 
     def __init__(self, n_steps, slots, pack, group=(), t_dispatch=0.0,
                  head=None):
@@ -473,6 +474,7 @@ class _Burst:
         self.spec_mask = None
         self.spec_width = 0
         self.n_out_np = None        # [R, S] per-round emit counts
+        self.spec_greedy = None     # [S] dispatch-time greedy snapshot
         self.group = list(group)    # fused-admission slots (subset of slots)
         # early-emit split: the _PendingPrefill head this burst is
         # chained off on-device. The sync worker readies the head FIRST
@@ -907,10 +909,17 @@ class Engine:
         # fused spec-tick counters (ISSUE 13): dispatches = spec ticks
         # issued, mixed_dispatches = ticks that carried BOTH spec rounds
         # and plain-decode rows, rounds/proposed/accepted = per-slot
-        # round totals, tokens = emitted spec tokens (accepted + bonus)
+        # round totals, tokens = emitted spec tokens (accepted + bonus).
+        # by_mode (ISSUE 18) splits the per-slot totals between greedy
+        # (accept_greedy) and sampled (accept_sampled) slots; the flat
+        # keys stay the cross-mode aggregates.
         self._spec_stats = {"dispatches": 0, "mixed_dispatches": 0,
                             "rounds": 0, "proposed": 0, "accepted": 0,
-                            "tokens": 0}
+                            "tokens": 0,
+                            "by_mode": {
+                                m: {"rounds": 0, "proposed": 0,
+                                    "accepted": 0, "tokens": 0}
+                                for m in ("greedy", "sampled")}}
 
         # pipelined decode state (r4 redesign): bursts chain device-side
         # through (tokens, lengths, ring, ring_pos, mu) output handles, and
@@ -3303,11 +3312,22 @@ class Engine:
         out["spec"] = {
             "mode": self._spec_mode,
             "n_draft": self.ecfg.n_draft,
-            **st,
+            **{k: v for k, v in st.items() if k != "by_mode"},
             "acceptance_rate": (st["accepted"] / st["proposed"]
                                 if st["proposed"] else 0.0),
             "accept_per_dispatch": (st["tokens"] / st["rounds"]
                                     if st["rounds"] else 0.0),
+            # ISSUE 18: the same counters + derived rates split by
+            # acceptance mode (greedy accept_greedy vs sampled
+            # rejection-sampling) — /metrics labels and /debug/state
+            # carry this through verbatim
+            "by_mode": {
+                m: {**c,
+                    "acceptance_rate": (c["accepted"] / c["proposed"]
+                                        if c["proposed"] else 0.0),
+                    "accept_per_dispatch": (c["tokens"] / c["rounds"]
+                                            if c["rounds"] else 0.0)}
+                for m, c in st["by_mode"].items()},
         }
         if self._paged:
             out["kv_layout"] = "paged"
@@ -3539,6 +3559,14 @@ class Engine:
         }
         if self._device_mem:
             out["device_mem"] = dict(self._device_mem)
+        # speculative counters with the ISSUE-18 per-mode split (greedy
+        # vs sampled rejection acceptance), mirroring metrics()["spec"]
+        st = self._spec_stats
+        out["spec"] = {
+            "mode": self._spec_mode,
+            **{k: v for k, v in st.items() if k != "by_mode"},
+            "by_mode": {m: dict(c) for m, c in st["by_mode"].items()},
+        }
         if self._slo is not None and self._slo.enabled:
             out["slo"] = self._slo.snapshot()
         out["flight_recorder"] = self._flight.snapshot()
@@ -4658,13 +4686,17 @@ class Engine:
         s.cur_penalty = penalty0
         s.mm_pos, s.mm_vec = mm_pos, mm_vec
         self._init_ga(slot, s, len(ids))
-        # per-SLOT speculation eligibility (ISSUE 13: per-request, any
-        # drafting mode — with draft=auto every llama-family greedy
-        # request speculates via n-gram self-drafting). Gates: greedy,
-        # ungrammared, no logit_bias, no penalties — the spec verify
-        # accepts via the sampler's own greedy top-k, so any logit
-        # shaping would silently diverge from the burst sampler. The
-        # n-gram drafter has no draft state, so reused prefixes and
+        # per-SLOT speculation eligibility (ISSUE 13 greedy, ISSUE 18
+        # sampled: per-request, any drafting mode — with draft=auto every
+        # ungrammared llama-family request speculates via n-gram
+        # self-drafting; greedy slots accept via accept_greedy
+        # (byte-identical), sampled slots via rejection sampling against
+        # the filtered verify distribution (distribution-identical)).
+        # Gates: ungrammared, no logit_bias, no penalties, no mirostat —
+        # the spec verify scores W positions against ONE frozen sampler
+        # state, so per-token-evolving logit shaping (penalty ring,
+        # mirostat mu) would silently diverge from the burst sampler.
+        # The n-gram drafter has no draft state, so reused prefixes and
         # preemption resumes stay eligible; the model drafter on the
         # CONTIGUOUS fallback still requires a draft-mirrored prompt (no
         # reused prefix, no resume) — only the PAGED draft cache shares
@@ -4672,12 +4704,13 @@ class Engine:
         # acceptance quality, never correctness).
         sp = req.params
         s.spec_ok = (self._spec_mode != "off"
-                     and sp.temperature <= 0 and not req.grammar
+                     and not req.grammar
                      and mm_pos is None
                      and not sp.logit_bias
                      and sp.repeat_penalty in (0.0, 1.0)
                      and sp.presence_penalty == 0.0
-                     and sp.frequency_penalty == 0.0)
+                     and sp.frequency_penalty == 0.0
+                     and (sp.mirostat or 0) == 0)
         if self._spec_mode == "model" and not self._paged \
                 and (common != 0 or resume is not None):
             s.spec_ok = False
@@ -4818,12 +4851,13 @@ class Engine:
                 # admission purity gates as _start_request
                 fsp = s.req.params
                 s.spec_ok = (self._spec_mode != "off"
-                             and fsp.temperature <= 0 and not s.req.grammar
+                             and not s.req.grammar
                              and s.mm_pos is None
                              and not fsp.logit_bias
                              and fsp.repeat_penalty in (0.0, 1.0)
                              and fsp.presence_penalty == 0.0
-                             and fsp.frequency_penalty == 0.0)
+                             and fsp.frequency_penalty == 0.0
+                             and (fsp.mirostat or 0) == 0)
                 if s.spec_ok and self._spec_mode == "model":
                     self._ensure_draft_cache()
             elif leader_ok and len(ids) > 1:
@@ -4834,11 +4868,12 @@ class Engine:
                 # admission; with the model drafter it additionally needs
                 # the leader's draft rows to exist so they can be forked
                 sp = s.req.params
-                pure = (sp.temperature <= 0 and not s.req.grammar
+                pure = (not s.req.grammar
                         and not sp.logit_bias
                         and sp.repeat_penalty in (0.0, 1.0)
                         and sp.presence_penalty == 0.0
-                        and sp.frequency_penalty == 0.0)
+                        and sp.frequency_penalty == 0.0
+                        and (sp.mirostat or 0) == 0)
                 if self._spec_mode == "model":
                     s.spec_ok = (pure and lsnap.spec_ok
                                  and self.dck is not None)
@@ -6276,6 +6311,14 @@ class Engine:
         traffic no longer starves greedy slots of speculation, and spec
         ticks ride the same pipelined device chain as plain bursts.
 
+        Spec rows accept greedily (accept_greedy, byte-identical to
+        plain greedy) when the slot is greedy, and via rejection
+        sampling against the filtered verify distribution
+        (accept_sampled + sampling.verify_dist, ISSUE 18 —
+        distribution-identical to plain sampling) when temperature > 0;
+        both modes share ONE compiled body so the precompile ladder and
+        the COMPILES_AFTER_WARMUP=0 gate are untouched.
+
         Pack layout [2*R*W + R + 1, S] f32: ids (R*W rows, round-major),
         logprobs (R*W), per-round emit counts (R), mu — where W =
         n_draft + 1 tokens per spec round (accepted prefix + bonus) and
@@ -6326,19 +6369,39 @@ class Engine:
             all_logits, ck, cv = self.family.prefill(
                 params, self.cfg, tin, seq, ck, cv, slot_ids, start,
                 continued=True, return_all_logits=True)
-            # greedy picks via the sampler's own top-k primitive:
-            # approx_max_k always retains the global argmax and breaks
-            # ties exactly like sampling.sample's greedy path, so the
-            # spec stream matches plain greedy bit-for-bit
-            k_top = min(sampling.SORT_K, all_logits.shape[-1])
-            _, top_idx = jax.lax.approx_max_k(
-                all_logits.reshape(S * W, -1), k_top)
-            greedy = top_idx[:, 0].astype(jnp.int32).reshape(S, W)
+            # filtered verify distribution via the sampler's own code
+            # path (sampling.filter_window under verify_dist): idx[:,:,0]
+            # is approx_max_k's retained global argmax with the same
+            # tie-breaks as sampling.sample's greedy path, so the greedy
+            # spec stream matches plain greedy bit-for-bit — and the
+            # window probs ARE the law plain sampling draws from, so
+            # rejection acceptance against them is distribution-lossless
+            vidx, vprobs = sampling.verify_dist(all_logits, sp,
+                                                use_typical=flags[1])
+            greedy = vidx[:, :, 0]
             out_spec, n_spec, _k = speculative.accept_greedy(
                 drafts, greedy, spec_active)
             logp = jax.nn.log_softmax(all_logits, axis=-1)
             lp_spec = jnp.take_along_axis(
                 logp, out_spec[:, :, None], axis=2)[:, :, 0]
+            # ISSUE 18: sampled spec rows accept via rejection sampling.
+            # Scatter the window distribution to vocab for acceptance and
+            # residual resampling (n-gram/greedy-draft proposals are
+            # deterministic, so draft_probs=None one-hot degeneration)
+            samp_active = spec_active & ~jnp.asarray(sp["greedy"])
+            V = all_logits.shape[-1]
+            rows = jnp.arange(S * W, dtype=jnp.int32)[:, None]
+            tgt = jnp.zeros((S * W, V), jnp.float32).at[
+                rows, vidx.reshape(S * W, -1)].set(
+                vprobs.reshape(S * W, -1)).reshape(S, W, V)
+            out_ss, n_ss, _ks, keys_ss = speculative.accept_sampled(
+                drafts, tgt, None, keys, samp_active)
+            lp_ss = jnp.log(jnp.clip(jnp.take_along_axis(
+                tgt, out_ss[:, :, None], axis=2)[:, :, 0], 1e-20))
+            out_spec = jnp.where(samp_active[:, None], out_ss, out_spec)
+            n_spec = jnp.where(samp_active, n_ss, n_spec)
+            lp_spec = jnp.where(samp_active[:, None], lp_ss, lp_spec)
+            keys = jnp.where(samp_active[:, None], keys_ss, keys)
             pad = jnp.zeros((S, D), jnp.int32)
             out = jnp.where(spec_mask[:, None], out_spec,
                             jnp.concatenate([ids0[:, None], pad], axis=1))
@@ -6392,7 +6455,8 @@ class Engine:
     def _plan_spec(self, included: list, infl: list):
         """Spec plan for this tick: (n_rounds, spec_mask) or None for a
         plain burst. A slot joins spec rounds iff it admitted spec_ok
-        (greedy, ungrammared) and has W = n_draft + 1 rows of headroom
+        (ungrammared, penalty/mirostat-free — greedy AND sampled since
+        ISSUE 18) and has W = n_draft + 1 rows of headroom
         past the steps already in flight; everyone else in ``included``
         rides the same tick as a plain-decode row. Round count follows
         _pick_burst's sizing discipline with spec slots charged W rows
@@ -6580,6 +6644,9 @@ class Engine:
         if plan is not None:
             b.spec_mask = spec_mask
             b.spec_width = W
+            # dispatch-time snapshot for per-mode fold attribution: the
+            # slot may be re-admitted with different params in flight
+            b.spec_greedy = self.slot_params["greedy"].copy()
             st = self._spec_stats
             st["dispatches"] += 1
             if any(not spec_mask[i] for i in included):
@@ -6670,6 +6737,16 @@ class Engine:
                     st["proposed"] += K * (Wd - 1)
                     st["accepted"] += tot - K
                     st["tokens"] += tot
+                    # ISSUE 18 per-mode split (greedy accept_greedy vs
+                    # sampled rejection acceptance), attributed from the
+                    # dispatch-time greedy snapshot
+                    mode = ("greedy" if b.spec_greedy is None
+                            or b.spec_greedy[i] else "sampled")
+                    bm = st["by_mode"][mode]
+                    bm["rounds"] += K
+                    bm["proposed"] += K * (Wd - 1)
+                    bm["accepted"] += tot - K
+                    bm["tokens"] += tot
             b.folded = True
             return
         for i in live_idx:

@@ -269,23 +269,21 @@ def feature_flags(slot_params, active=None) -> dict:
     }
 
 
-def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
-           use_penalties: bool = True, use_typical: bool = True,
-           use_mirostat: bool = True):
-    """Sample one token per slot.
+def filter_window(logits, slot_params, ring, ring_pos, logit_bias, mu=None,
+                  use_penalties: bool = True, use_typical: bool = True,
+                  use_mirostat: bool = True):
+    """Reduce full-vocab logits to the FILTERED candidate-window distribution.
 
-    logits: [S, V] fp32; ring/ring_pos: penalty state from make_ring;
-    logit_bias: [S, V] fp32; rng_keys: [S, 2] uint32 (per-slot PRNG data);
-    mu: [S] fp32 mirostat state (None = mirostat disabled everywhere).
-    use_*: STATIC feature gates (see feature_flags) — False traces the
-    block out entirely; semantics are unchanged when the corresponding
-    per-slot parameters are at their neutral values.
-    Returns (token_ids [S] int32, logprobs [S] fp32, new_rng_keys, new_mu).
-
-    Mirostat (llama.cpp mirostat v2 semantics, sample_token_mirostat_v2:
-    truncate candidates whose surprise exceeds mu, sample, then
-    mu -= eta * (observed_surprise - tau)) replaces the top-k/p/min-p
-    chain for slots with slot_params["mirostat"] > 0.
+    This is the shared front half of `sample`: the single full-vocab
+    approx_max_k, window penalties, temperature scaling, and the
+    top-k/top-p/min-p/typical-p (or mirostat) keep-mask chain. Returns
+    (idx [S, K] candidate token ids, masked [S, K] unnormalized filtered
+    log-probs — exp/normalize = the exact distribution `sample`'s
+    categorical draws from, kept rank-0 guaranteed — and vals [S, K], the
+    post-penalty pre-temperature window logits used for logprob
+    reporting). Speculative verify (verify_dist) calls this with the same
+    per-slot params as the decode path, so spec-sampled acceptance and
+    plain sampling draw from the identical law by construction.
     """
     S, V = logits.shape
     k = min(SORT_K, V)
@@ -310,8 +308,6 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
         idx = jnp.take_along_axis(top_idx, order, axis=-1)
     else:
         vals, idx = top_vals, top_idx
-
-    greedy_ids = idx[:, 0]
 
     scaled = vals / slot_params["temperature"][:, None]
     rank = jnp.arange(k, dtype=jnp.int32)[None, :]
@@ -357,6 +353,33 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
         masked = jnp.where(keep, jnp.where(miro_on, full_logp, logp), -jnp.inf)
     else:
         masked = jnp.where(keep, logp, -jnp.inf)
+    return idx, masked, vals
+
+
+def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
+           use_penalties: bool = True, use_typical: bool = True,
+           use_mirostat: bool = True):
+    """Sample one token per slot.
+
+    logits: [S, V] fp32; ring/ring_pos: penalty state from make_ring;
+    logit_bias: [S, V] fp32; rng_keys: [S, 2] uint32 (per-slot PRNG data);
+    mu: [S] fp32 mirostat state (None = mirostat disabled everywhere).
+    use_*: STATIC feature gates (see feature_flags) — False traces the
+    block out entirely; semantics are unchanged when the corresponding
+    per-slot parameters are at their neutral values.
+    Returns (token_ids [S] int32, logprobs [S] fp32, new_rng_keys, new_mu).
+
+    Mirostat (llama.cpp mirostat v2 semantics, sample_token_mirostat_v2:
+    truncate candidates whose surprise exceeds mu, sample, then
+    mu -= eta * (observed_surprise - tau)) replaces the top-k/p/min-p
+    chain for slots with slot_params["mirostat"] > 0.
+    """
+    use_mirostat = use_mirostat and mu is not None
+    idx, masked, vals = filter_window(
+        logits, slot_params, ring, ring_pos, logit_bias, mu=mu,
+        use_penalties=use_penalties, use_typical=use_typical,
+        use_mirostat=use_mirostat)
+    greedy_ids = idx[:, 0]
 
     def sample_one(key_data, logits_row):
         key = jax.random.wrap_key_data(key_data)
@@ -371,6 +394,7 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
 
     if use_mirostat:
         # observed surprise under the truncated+renormalized distribution
+        miro_on = slot_params["mirostat"][:, None] > 0
         lse = jax.nn.logsumexp(masked, axis=-1, keepdims=True)
         chosen_lp = jnp.take_along_axis(masked - lse, choices[:, None], axis=-1)[:, 0]
         obs = -chosen_lp / jnp.float32(np.log(2.0))
@@ -388,3 +412,34 @@ def sample(logits, slot_params, ring, ring_pos, logit_bias, rng_keys, mu=None,
                             jnp.zeros_like(choices[:, None]), choices[:, None])
     logprobs = jnp.take_along_axis(win_logp, chosen_rank, axis=-1)[:, 0]
     return ids, logprobs, new_keys, new_mu
+
+
+def verify_dist(all_logits, slot_params, use_typical: bool = True):
+    """Filtered target distribution at EVERY speculative-verify position.
+
+    all_logits [S, W, V] (W = n_draft+1 positions from the ragged verify
+    forward); slot_params: the per-slot vectors, broadcast across a
+    slot's W positions. Returns (idx [S, W, K] candidate ids, probs
+    [S, W, K] — the normalized post-temperature top-k/top-p/min-p window
+    distribution each position's plain `sample` call would draw from).
+
+    Runs the SAME filter_window code path as `sample` (position-major
+    flatten, params repeated per position), so rejection-sampling
+    acceptance against these probs preserves the plain-sampling law
+    exactly. Penalties / mirostat / logit_bias are traced out: spec
+    eligibility (engine spec_ok) excludes slots using them, because their
+    state evolves per emitted token and a verify round scores W positions
+    against one frozen state. Greedy picks stay exact: idx[:, :, 0] is
+    approx_max_k's retained global argmax over logits + 0.0.
+    """
+    S, W, V = all_logits.shape
+    rep = {k: jnp.repeat(jnp.asarray(v), W, axis=0)
+           for k, v in slot_params.items()}
+    flat = all_logits.reshape(S * W, V)
+    zero_bias = jnp.zeros((1, 1), flat.dtype)
+    idx, masked, _vals = filter_window(
+        flat, rep, None, None, zero_bias, mu=None,
+        use_penalties=False, use_typical=use_typical, use_mirostat=False)
+    kk = idx.shape[-1]
+    probs = jax.nn.softmax(masked, axis=-1)
+    return idx.reshape(S, W, kk), probs.reshape(S, W, kk)

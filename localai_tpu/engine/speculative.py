@@ -33,9 +33,24 @@ Since ISSUE 13 speculation is a packed citizen of the engine's fused
 decode tick (engine.py _spec_tick_body): spec-eligible slots take a
 propose+verify round while non-spec neighbors take a plain decode step
 through position 0 of the very same ragged verify forward — one chained
-dispatch, no whole-engine spec/burst alternation. Stochastic speculative
-sampling (rejection-sampling acceptance) remains a documented follow-up;
-sampled slots simply ride the tick as plain-decode rows.
+dispatch, no whole-engine spec/burst alternation.
+
+Since ISSUE 18 sampled (temperature>0) slots speculate too, via
+rejection-sampling acceptance (accept_sampled, leviathan-style): draft
+token x_j is accepted with probability min(1, p(x_j)/q(x_j)) against
+the FILTERED target distribution p (sampling.verify_dist — the exact
+law plain `sample` draws from), and the first rejection resamples from
+the residual norm(max(0, p - q)). Our drafters are deterministic (n-gram
+lookup / greedy draft model), so q is a one-hot: acceptance degenerates
+to u < p(x_j) and the residual is p with the draft token zeroed. Sampled
+speculation is lossless IN DISTRIBUTION (chi-square-tested), not
+byte-identical — the spec tick consumes the slot's RNG key on a
+different schedule (one acceptance+resample draw per round vs one
+categorical per token), so a given seed yields a different, equally
+distributed stream than spec-off — and since every executed round
+advances the key, the bytes also depend on how rounds partition into
+dispatches under load. Greedy slots keep accept_greedy and remain
+bit-identical to plain greedy decoding.
 """
 
 from __future__ import annotations
@@ -134,6 +149,110 @@ def accept_greedy(drafts, greedy, active):
                     jnp.where(pos == k[:, None], bonus[:, None], 0))
     n_out = (k + 1) * active.astype(jnp.int32)
     return out, n_out, k
+
+
+def accept_sampled(drafts, target_probs, draft_probs, rng_keys, active):
+    """Stochastic (rejection-sampling) acceptance for sampled slots.
+
+    drafts [S, D] proposals; target_probs [S, D+1, V] the FILTERED target
+    distribution at every verify position (each row sums to 1 over the
+    candidate support — sampling.verify_dist scattered to vocab);
+    draft_probs [S, D, V] the drafter's proposal distribution, or None
+    for deterministic drafters (n-gram / greedy draft model: q is a
+    one-hot at the draft token, so acceptance is u < p(x_j) and the
+    residual is p with the draft token zeroed); rng_keys [S, 2] uint32;
+    active [S] bool.
+
+    Accept draft x_j with probability min(1, p(x_j)/q(x_j)); the first
+    rejection at position j emits one token resampled from
+    norm(max(0, p_j - q_j)); full acceptance draws the bonus from
+    p_D. Exactly ONE categorical draw and D uniforms are consumed per
+    slot per round, unconditionally — the RNG schedule is data-
+    independent, so a fixed seed ladder replays bit-identically.
+    Inactive slots keep their keys untouched.
+
+    Returns (out [S, D+1] emitted tokens, n_out [S] valid counts =
+    accepted prefix + 1, k [S] accepted-draft counts, new_keys [S, 2]).
+    """
+    S, D = drafts.shape
+    pos = jnp.arange(D + 1, dtype=jnp.int32)[None, :]
+
+    def one(key_data, dr, tp, qp):
+        key = jax.random.wrap_key_data(key_data)
+        key, sub_u, sub_c = jax.random.split(key, 3)
+        u = jax.random.uniform(sub_u, (D,))
+        p_dr = jnp.take_along_axis(tp[:D], dr[:, None], axis=1)[:, 0]  # [D]
+        if qp is None:
+            ratio = p_dr
+            resid = tp[:D].at[jnp.arange(D, dtype=jnp.int32), dr].set(0.0)
+        else:
+            q_dr = jnp.take_along_axis(qp, dr[:, None], axis=1)[:, 0]
+            ratio = jnp.minimum(1.0, p_dr / jnp.clip(q_dr, 1e-20))
+            resid = jnp.clip(tp[:D] - qp, 0.0)
+        accept = (u < ratio).astype(jnp.int32)
+        k = jnp.sum(jnp.cumprod(accept))
+        # final token: residual row k on rejection, bonus row D otherwise
+        fin = jnp.where(k < D, resid[jnp.minimum(k, D - 1)], tp[D])
+        # numerically-empty residual (p==q up to rounding): fall back to
+        # the target row so the categorical stays well-defined
+        fin = jnp.where(jnp.any(fin > 0), fin, tp[jnp.minimum(k, D)])
+        fin_logits = jnp.where(fin > 0, jnp.log(fin), -jnp.inf)
+        choice = jax.random.categorical(sub_c, fin_logits).astype(jnp.int32)
+        return jax.random.key_data(key), choice, k
+
+    if draft_probs is None:
+        new_keys, final_tok, k = jax.vmap(
+            lambda kd, dr, tp: one(kd, dr, tp, None))(
+                rng_keys, drafts, target_probs)
+    else:
+        new_keys, final_tok, k = jax.vmap(one)(
+            rng_keys, drafts, target_probs, draft_probs)
+
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1)
+    out = jnp.where(pos < k[:, None], drafts_pad,
+                    jnp.where(pos == k[:, None], final_tok[:, None], 0))
+    n_out = (k + 1) * active.astype(jnp.int32)
+    new_keys = jnp.where(active[:, None], new_keys, rng_keys)
+    return out.astype(jnp.int32), n_out, k, new_keys
+
+
+def two_sample_chi2(counts_a, counts_b, min_expected: float = 5.0):
+    """Two-sample chi-square homogeneity test (host-side numpy).
+
+    counts_a/counts_b: per-category observation counts of the two
+    samples (e.g. token-id frequencies of a spec-sampled vs a
+    plain-sampled run). Categories whose combined count is below
+    ``min_expected`` are pooled into one bin so the asymptotic
+    approximation holds. Returns (stat, dof, p_value); p ~ U[0,1] when
+    both samples draw from the same law — the distribution-preservation
+    gate asserts p above a small alpha. Uses the unequal-N form
+    chi2 = sum (K1*a_i - K2*b_i)^2 / (a_i + b_i) with K1 = sqrt(NB/NA),
+    K2 = sqrt(NA/NB).
+    """
+    import numpy as np
+
+    a = np.asarray(counts_a, np.float64).ravel()
+    b = np.asarray(counts_b, np.float64).ravel()
+    tot = a + b
+    big = tot >= min_expected
+    a = np.concatenate([a[big], [a[~big].sum()]])
+    b = np.concatenate([b[big], [b[~big].sum()]])
+    keep = (a + b) > 0
+    a, b = a[keep], b[keep]
+    if len(a) < 2 or a.sum() == 0 or b.sum() == 0:
+        return 0.0, 0, 1.0
+    k1 = np.sqrt(b.sum() / a.sum())
+    k2 = np.sqrt(a.sum() / b.sum())
+    stat = float(np.sum((k1 * a - k2 * b) ** 2 / (a + b)))
+    dof = int(len(a) - 1)
+    try:
+        from scipy.stats import chi2 as _chi2
+        p = float(_chi2.sf(stat, dof))
+    except Exception:   # pragma: no cover — scipy ships with jax
+        from jax.scipy.special import gammaincc
+        p = float(gammaincc(dof / 2.0, stat / 2.0))
+    return stat, dof, p
 
 
 def spec_round(params, dparams, cfg: llama.LlamaConfig, dcfg: llama.LlamaConfig,

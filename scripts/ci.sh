@@ -145,6 +145,24 @@ if apd is None or not apd > 1.0 or line.get("spec_byte_match") is not True:
           f"(accept_per_dispatch={apd} must be > 1.0, "
           f"byte_match={line.get('spec_byte_match')} must be true)")
     sys.exit(1)
+# stochastic speculative sampling (ISSUE 18): sampled slots ride the
+# spec tick via rejection acceptance — they must ALSO emit more than
+# one token per verify dispatch, and the chi-square two-sample test
+# must not distinguish spec-on from plain sampling (losslessness for
+# sampled requests is distribution-identity, not byte-identity)
+sapd = line.get("spec_sampled_accept_per_dispatch")
+sdist = line.get("spec_sampled_dist_ok")
+print(f"SPEC_SAMPLED_ACCEPT_PER_DISPATCH={sapd} "
+      f"SPEC_SAMPLED_DIST_OK={1 if sdist else 0} "
+      f"sampled_acceptance_rate={sp.get('sampled_acceptance_rate')} "
+      f"sampled_chi2_p={sp.get('sampled_chi2_p')} "
+      f"sampled_itl_on_ms={sp.get('sampled_itl_on_ms')} "
+      f"sampled_itl_off_ms={sp.get('sampled_itl_off_ms')}")
+if sapd is None or not sapd > 1.0 or sdist is not True:
+    print(f"FAIL: stochastic speculative sampling regressed "
+          f"(sampled_accept_per_dispatch={sapd} must be > 1.0, "
+          f"sampled_dist_ok={sdist} must be true)")
+    sys.exit(1)
 # engine replica pool (ISSUE 14): the warm resubmission must route to
 # the replica holding the prefix chain (affinity hit), a forced live
 # migration must continue byte-identically to a fresh pool

@@ -139,12 +139,14 @@ def _collect(out, timeout: float = 60.0) -> list:
 
 def test_fused_mixed_tick_byte_parity_and_single_dispatch():
     """The tentpole acceptance gate: a greedy slot speculating via
-    n-gram self-drafting and a sampled slot decoding plainly ride ONE
-    fused dispatch per tick (no `_spec_turn` whole-engine alternation —
-    `mixed_dispatches` is the dispatch-count evidence), and the greedy
-    stream stays byte-identical to the speculation-off engine.  This is
-    also the mixed-traffic starvation regression: the greedy neighbor
-    keeps speculating (rounds accrue) while the sampled slot is live."""
+    n-gram self-drafting and a spec-INELIGIBLE slot (repeat penalty —
+    per-token ring evolution keeps it out of the verify round, ISSUE 18
+    widened eligibility to sampled-but-pure requests) decoding plainly
+    ride ONE fused dispatch per tick (no `_spec_turn` whole-engine
+    alternation — `mixed_dispatches` is the dispatch-count evidence),
+    and the greedy stream stays byte-identical to the speculation-off
+    engine.  This is also the mixed-traffic starvation regression: the
+    greedy neighbor keeps speculating while the plain slot is live."""
     cfg = _cfg()
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     prompt = "the rain in spain falls mainly on the plain on the plain"
@@ -173,16 +175,20 @@ def test_fused_mixed_tick_byte_parity_and_single_dispatch():
             max_new_tokens=32, ignore_eos=True))
         out_s = e.submit(eng.GenRequest(
             prompt_ids=tok.encode("something else entirely"),
-            params=sampling.SamplingParamsHost(temperature=1.0, seed=7),
+            params=sampling.SamplingParamsHost(temperature=1.0, seed=7,
+                                               repeat_penalty=1.1),
             max_new_tokens=32, ignore_eos=True))
         evs_g, evs_s = _collect(out_g), _collect(out_s)
-        assert eng.event_ids(evs_g) == ref        # lossless beside sampled
+        assert eng.event_ids(evs_g) == ref        # lossless beside plain
         assert len(eng.event_ids(evs_s)) == 32
         st = e._spec_stats
         assert st["dispatches"] > 0 and st["rounds"] > 0
         # THE dispatch-count assertion: at least one fused tick carried
         # a speculating row AND a plain row through the same dispatch
         assert st["mixed_dispatches"] > 0
+        # mode attribution: only the greedy slot speculated here
+        assert st["by_mode"]["greedy"]["rounds"] == st["rounds"]
+        assert st["by_mode"]["sampled"]["rounds"] == 0
         sp = e.metrics()["spec"]
         assert sp["mode"] == "ngram"
         assert sp["rounds"] == st["rounds"]

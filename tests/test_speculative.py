@@ -187,14 +187,19 @@ def test_mixed_traffic_keeps_per_slot_speculation():
         e.shutdown()
 
 
-def test_sampled_only_traffic_never_allocates_draft_cache():
+def test_ineligible_only_traffic_never_allocates_draft_cache():
+    # ISSUE 18 made sampled-but-pure requests spec-eligible, so the
+    # lazily-allocated draft cache now appears for them too; traffic
+    # that stays OUT of the verify round (per-token penalty-ring
+    # evolution) must still never pay for a draft KV allocation
     cfg = _cfg()
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     e = _engine(params, draft=(cfg, params))
     try:
         req = eng.GenRequest(
-            prompt_ids=ByteTokenizer().encode("sampled"),
-            params=sampling.SamplingParamsHost(temperature=0.9, seed=3),
+            prompt_ids=ByteTokenizer().encode("penalized"),
+            params=sampling.SamplingParamsHost(temperature=0.9, seed=3,
+                                               repeat_penalty=1.1),
             max_new_tokens=8, ignore_eos=True)
         e.generate_text(req)
         assert e.dck is None
