@@ -1512,6 +1512,266 @@ def bench_replicas(cfg, S, C, max_new=48):
     return out
 
 
+def bench_autoscale(cfg, S, C, max_new=32):
+    """SLO-driven replica autoscaling + predictive weight prefetch
+    (ISSUE 19), five phases on the CPU-safe smoke shape:
+
+    0. control: the SAME admission burst against a static one-replica
+       pool MUST shed — proves the load is real, not theater;
+    1. scale-out pre-shed: a burst fires the queue-fill leading
+       indicator and the pool must add a replica BEFORE any admission
+       shed (AUTOSCALE_PRE_SHED); the follow-up burst is absorbed
+       shed-free by the wider pool;
+    2. slow weight stream alongside serving: a whole-checkpoint
+       stream_llama_params load with the weight_stream_slow_ms chaos
+       fault armed runs WHILE the burst serves — the load must finish
+       degraded without stalling the serving replicas or flapping the
+       scaler;
+    3. idle scale-in with an in-flight survivor: after the burst
+       drains, the policy scales back in; the still-decoding request is
+       live-migrated off each retiring replica and its continuation
+       must byte-match a fresh pool re-admission of (prompt + emitted)
+       — SCALE_IN_BYTE_MATCH;
+    4. warm-vs-cold spin-up (the gallery model-swap path): streaming
+       the saved checkpoint with the WeightPrefetcher's parsed leaves
+       already cached must beat the cold stream by >= 2x
+       (SWAP_COLD_MS / SWAP_WARM_MS / SWAP_RATIO).
+
+    The executed decision sequence must never reverse inside the
+    cool-down window: AUTOSCALE_FLAPS stays 0 across every phase."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+    from localai_tpu.engine.pool import EnginePool
+    from localai_tpu.engine.weights import (WeightPrefetcher, random_params,
+                                            save_llama_params,
+                                            stream_llama_params)
+    from localai_tpu.services.eventlog import EVENTS
+    from localai_tpu.services.faults import FAULTS
+
+    params = random_params(cfg)
+    rng = np.random.default_rng(31)
+    pg = 8
+    plen = 16
+    base = dict(num_slots=2, max_context=C, prefill_buckets=(plen, 64),
+                decode_burst=2, kv_page_size=pg,
+                kv_pool_pages=max(32, 2 * C // pg),
+                cache_dtype=jnp.float32, max_queued_requests=6)
+
+    def make_req(ids, n):
+        return eng.GenRequest(
+            prompt_ids=list(ids), max_new_tokens=n, ignore_eos=True,
+            params=sampling.SamplingParamsHost(temperature=0.0))
+
+    def drain(o):
+        ids, err = [], None
+        while True:
+            ev = o.get()
+            if ev is None:
+                break
+            if ev.error is not None:
+                err = ev.error
+            if ev.token_ids:
+                ids.extend(ev.token_ids)
+            elif ev.token_id >= 0:
+                ids.append(ev.token_id)
+        return ids, err
+
+    def burst(pool, n, new):
+        return [pool.submit(make_req(
+            rng.integers(0, 255, size=plen).tolist(), new))
+            for _ in range(n)]
+
+    out = {"max_new": max_new}
+
+    # ---- phase 0: control — the same burst on a STATIC pool sheds ----
+    ctl = EnginePool.build(cfg, params, _ByteTokenizer(),
+                           eng.EngineConfig(**base), engines=1,
+                           eos_token_ids={cfg.vocab_size - 1})
+    ctl.start(precompile=False)
+    try:
+        errs = [drain(o)[1] for o in burst(ctl, 15, max_new)]
+        out["sheds_without_autoscale"] = sum(1 for e in errs
+                                             if e is not None)
+    finally:
+        _kv_sweep(ctl, out)
+        ctl.shutdown()
+
+    # checkpoint for the stream-load phases: bigger than the serving
+    # shape so the read/parse/stack work the prefetcher pays ahead of
+    # time dominates fixed overheads (still CPU-safe, ~50 MB f32)
+    swap_dir = tempfile.mkdtemp(prefix="localai-swap-")
+    from localai_tpu.models import llama
+    swap_cfg = llama.LlamaConfig(
+        max_position_embeddings=256, vocab_size=2048, hidden_size=512,
+        intermediate_size=1536, num_layers=4, num_heads=8,
+        num_kv_heads=8, head_dim=64)
+    save_llama_params(random_params(swap_cfg), swap_cfg, swap_dir)
+
+    # ---- main pool: autoscaling on, one replica, burst-friendly ----
+    ecfg = eng.EngineConfig(autoscale=True, autoscale_min=1,
+                            autoscale_max=3, autoscale_dwell_ms=400,
+                            autoscale_cooldown_ms=700, **base)
+    pool = EnginePool.build(cfg, params, _ByteTokenizer(), ecfg,
+                            engines=1, eos_token_ids={cfg.vocab_size - 1})
+    EVENTS.clear()
+    pool.start(precompile=False)
+    try:
+        # ---- phase 1: the ramp must scale out BEFORE any shed ----
+        outs = burst(pool, 5, max_new)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if any(ev["event"] == "scale_out" for ev in EVENTS.events()):
+                break
+            time.sleep(0.02)
+        evs = EVENTS.events()
+        first_out = next((ev for ev in evs
+                          if ev["event"] == "scale_out"), None)
+        out["scale_out_events"] = sum(1 for ev in evs
+                                      if ev["event"] == "scale_out")
+        out["sheds_before_scaleout"] = sum(
+            1 for ev in evs if ev["event"] == "shed"
+            and (first_out is None or ev["ts"] < first_out["ts"]))
+        out["pre_shed"] = bool(first_out is not None
+                               and out["sheds_before_scaleout"] == 0)
+        out["spinup_ms"] = first_out["spinup_ms"] if first_out else None
+
+        # ---- phase 2: slow weight stream must not stall serving ----
+        FAULTS.configure("weight_stream_slow_ms=25*")
+        slow = {}
+
+        def slow_load():
+            _, slow_st = stream_llama_params(swap_dir, swap_cfg)
+            slow.update(slow_st)
+
+        # let the widened pool absorb most of the phase-1 ramp first:
+        # the follow-up burst proves steady throughput under the slow
+        # stream, not a second intentional queue overrun
+        drain_by = time.monotonic() + 10.0
+        while time.monotonic() < drain_by:
+            m = pool.metrics()
+            if sum(r["queued"] for r in m["replicas"]) <= 2:
+                break
+            time.sleep(0.05)
+        t = threading.Thread(target=slow_load, daemon=True)
+        t.start()
+        for _ in range(10):
+            outs += burst(pool, 1, max_new)
+            time.sleep(0.03)
+        errs = [drain(o)[1] for o in outs]
+        t.join(timeout=120)
+        FAULTS.disarm("weight_stream_slow_ms")
+        out["burst_errors"] = sum(1 for e in errs if e is not None)
+        out["slow_stream_ms"] = round(slow.get("ms", 0.0), 1)
+        # the fault sleeps 25 ms per leaf: the load must have been
+        # degraded (seam fired) yet the serving burst stayed shed-free
+        out["slow_stream_degraded"] = bool(
+            slow.get("leaves", 0) > 0
+            and slow["ms"] >= 25.0 * slow["leaves"])
+        out["slow_stream_stall_free"] = out["burst_errors"] == 0
+
+        # ---- phase 3: idle scale-in, in-flight rider byte-gated ----
+        # keep one long decode alive on a NON-zero replica so the
+        # idle-decay scale-in exercises the live-migrate drain path; a
+        # background drainer detects the rider finishing early (smoke
+        # decodes are fast) so a fresh rider can take its place
+        long_new = min(480, C - plen - pg)
+        results: dict = {}
+
+        def ride(r, o):
+            results[r.request_id] = drain(o)
+
+        riders: list = []
+
+        def ensure_rider():
+            for _ in range(3):
+                # least-loaded routing: a short decoy parks on replica 0
+                # first so the long rider lands on a retirable replica
+                burst(pool, 1, 4)
+                # keep a pristine prompt copy: _start_resume rewrites
+                # req.prompt_ids to the full processed history, so the
+                # byte-gate reference must not read it back off the req
+                p = rng.integers(0, 255, size=plen).tolist()
+                r = make_req(p, long_new)
+                o = pool.submit(r)
+                if pool.where(r.request_id) != 0:
+                    th = threading.Thread(target=ride, args=(r, o),
+                                          daemon=True)
+                    th.start()
+                    riders.append((r, th, p))
+                    return
+                results[r.request_id] = drain(o)  # mis-routed: flush it
+
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if len(pool._routable_idx()) == 1:
+                break
+            if not any(th.is_alive() for _, th, _p in riders):
+                ensure_rider()
+            time.sleep(0.05)
+        for _, th, _p in riders:
+            th.join(timeout=60)
+        evs = EVENTS.events()
+        out["scale_in_events"] = sum(1 for ev in evs
+                                     if ev["event"] == "scale_in")
+        out["replicas_final"] = len(pool._routable_idx())
+        byte_gate = None
+        for r, _th, p in reversed(riders):
+            migs = [ev for ev in evs if ev["event"] == "migrate"
+                    and ev.get("rid") == r.request_id
+                    and ev.get("reason") == "scale_in"]
+            # the reference must splice the rider's retained chain, so
+            # the rider has to have LANDED on the surviving replica —
+            # a chain whose final home later retired is gone with it
+            if not migs or migs[-1].get("dst") != 0:
+                continue
+            ids, err = results.get(r.request_id, (None, "undrained"))
+            out["scale_in_migrations"] = len(migs)
+            if err is None and ids is not None and len(ids) == long_new:
+                k = migs[-1]["n_decoded"]
+                out["scale_in_n_decoded"] = k
+                ref, rerr = drain(pool.submit(make_req(
+                    list(p) + ids[:k], long_new - k)))
+                byte_gate = rerr is None and ids[k:] == ref
+            break
+        out["byte_gate_ok"] = byte_gate
+
+        # ---- flap accounting across every phase above ----
+        snap = pool._policy.snapshot()
+        out["flaps"] = snap["flaps"]
+        out["autoscale_decisions"] = snap["decisions"]
+        out["flaps_suppressed"] = snap["flaps_suppressed"]
+
+        # ---- phase 4: warm-vs-cold streamed spin-up ----
+        colds, warms = [], []
+        warm_hit = False
+        pf = WeightPrefetcher(budget_mb=2048)
+        for _ in range(3):
+            _, st = stream_llama_params(swap_dir, swap_cfg)
+            colds.append(st["ms"])
+            pf.prefetch(swap_dir, swap_cfg, wait=True)
+            _, st = stream_llama_params(swap_dir, swap_cfg,
+                                        prefetcher=pf)
+            warms.append(st["ms"])
+            warm_hit = warm_hit or st["prefetch_hit"]
+        out["swap_cold_ms"] = round(float(np.median(colds)), 1)
+        out["swap_warm_ms"] = round(float(np.median(warms)), 1)
+        out["swap_ratio"] = round(out["swap_cold_ms"]
+                                  / max(1e-3, out["swap_warm_ms"]), 2)
+        out["swap_prefetch_hit"] = warm_hit
+        out["weight_prefetch"] = pf.snapshot()
+    finally:
+        FAULTS.reset()
+        _kv_sweep(pool, out)
+        pool.shutdown()
+        shutil.rmtree(swap_dir, ignore_errors=True)
+    return out
+
+
 def bench_cluster(cfg, S, C, max_new=32):
     """Cross-host KV federation scenario (ISSUE 17): TWO ClusterHosts —
     each its own EnginePool + host KV tier, joined only by the KV
@@ -2925,7 +3185,7 @@ def main():
             or "--chaos" in sys.argv or "--priority" in sys.argv
             or "--slo" in sys.argv or "--spec" in sys.argv
             or "--replicas" in sys.argv or "--longcontext" in sys.argv
-            or "--cluster" in sys.argv):
+            or "--cluster" in sys.argv or "--autoscale" in sys.argv):
         # engine-direct / kernel modes own the chip in-process
         from localai_tpu.utils.jaxtools import enable_compilation_cache
 
@@ -3103,6 +3363,38 @@ def main():
                   and r.get("recovered") is True)
             print(json.dumps({
                 "metric": f"replicas_{preset}", "value": 1 if ok else 0,
+                "unit": "ok", "ok": 1 if ok else 0, **r,
+            }))
+            return
+
+        if "--autoscale" in sys.argv:
+            # SLO-driven replica autoscaling + predictive weight
+            # prefetch (ISSUE 19): f32 weights so the scale-in
+            # live-migration byte gate compares the continued stream
+            # against a fresh pool re-admission deterministically
+            import jax.numpy as jnp
+
+            cfg = llama.LlamaConfig(max_position_embeddings=2048,
+                                    dtype=jnp.float32, **PRESETS[preset])
+            S = int(os.environ.get("LOCALAI_BENCH_SLOTS", "2"))
+            # 512 so the phase-3 rider decodes long enough to stay
+            # in flight across BOTH idle scale-ins (3 -> 2 -> 1): its
+            # final migration must land on the surviving replica for
+            # the byte gate's reference splice
+            C = max(512, int(os.environ.get("LOCALAI_BENCH_CTX", "0"))
+                    or 512)
+            r = bench_autoscale(cfg, S, C)
+            ok = (r.get("sheds_without_autoscale", 0) >= 1
+                  and r.get("pre_shed") is True
+                  and r.get("scale_out_events", 0) >= 1
+                  and r.get("scale_in_events", 0) >= 1
+                  and r.get("flaps") == 0
+                  and r.get("slow_stream_degraded") is True
+                  and r.get("slow_stream_stall_free") is True
+                  and r.get("byte_gate_ok") is True
+                  and (r.get("swap_ratio") or 0) >= 2.0)
+            print(json.dumps({
+                "metric": f"autoscale_{preset}", "value": 1 if ok else 0,
                 "unit": "ok", "ok": 1 if ok else 0, **r,
             }))
             return

@@ -80,6 +80,8 @@ def _readyz_load(state) -> dict:
     """Per-model queue depth + slots-in-flight off the (cheap, native)
     GetMetrics fields, short-timeout and failure-tolerant: readiness
     must answer even when a backend is wedged."""
+    import json
+
     out = {}
     for name in state.caps.loader.list_loaded():
         lm = state.caps.loader.get(name)
@@ -90,6 +92,24 @@ def _readyz_load(state) -> dict:
             out[name] = {"queue_depth": int(m.queued),
                          "slots_in_flight": int(m.slots_active),
                          "slots_total": int(m.slots_total)}
+            # target-vs-actual replicas + last scaling decision (ISSUE
+            # 19): parsed tolerantly from the stats JSON — absent on
+            # unpooled models and non-JSON backends
+            try:
+                stats = json.loads(m.prompt_json_for_slot or "{}")
+            except (ValueError, TypeError):
+                stats = {}
+            if "engine_replicas" in stats:
+                pool = stats.get("pool") or {}
+                out[name]["replicas_alive"] = pool.get(
+                    "replicas_alive", stats["engine_replicas"])
+                out[name]["replicas_target"] = stats.get(
+                    "engine_replicas_target",
+                    pool.get("replicas_target"))
+                auto = pool.get("autoscale")
+                if auto:
+                    out[name]["last_scale_decision"] = auto.get(
+                        "last_decision")
         except Exception:
             out[name] = {"queue_depth": None, "slots_in_flight": None}
     return out
@@ -275,6 +295,9 @@ def _refresh_engine_metrics(state):
               "replica_slots_in_flight", "replica_migrations_total",
               "pool_affinity_hits_total", "pool_affinity_misses_total",
               "resume_reserve_pages",
+              "engine_replicas_target", "autoscale_decisions_total",
+              "autoscale_flaps_suppressed_total",
+              "weight_prefetch_hits_total", "weight_prefetch_bytes_total",
               "backend_respawns_total", "circuit_state"):
         METRICS.clear_instrument(g)
     # loader-owned recovery telemetry (ISSUE 7): respawn counts + breaker
@@ -374,6 +397,31 @@ def _refresh_engine_metrics(state):
             METRICS.set_counter("pool_affinity_misses_total",
                                 pool.get("affinity_misses", 0),
                                 label_str(model=name))
+            # SLO-driven autoscaling (ISSUE 19): target width + decision/
+            # suppressed-flap counters by direction. Absent unless
+            # autoscale=1 built a policy.
+            METRICS.set_gauge("engine_replicas_target",
+                              pool.get("replicas_target",
+                                       stats.get("engine_replicas", 1)),
+                              label_str(model=name))
+            auto = pool.get("autoscale")
+            if auto:
+                for d, n in (auto.get("decisions") or {}).items():
+                    METRICS.set_counter("autoscale_decisions_total", n,
+                                        label_str(model=name, direction=d))
+                for d, n in (auto.get("flaps_suppressed") or {}).items():
+                    METRICS.set_counter(
+                        "autoscale_flaps_suppressed_total", n,
+                        label_str(model=name, direction=d))
+        # streamed weight-load + in-backend prefetch stats (ISSUE 19)
+        ws = stats.get("weight_stream")
+        if ws:
+            METRICS.set_counter("weight_prefetch_hits_total",
+                                1 if ws.get("prefetch_hit") else 0,
+                                label_str(model=name, source="backend"))
+            METRICS.set_counter("weight_prefetch_bytes_total",
+                                ws.get("bytes", 0),
+                                label_str(model=name, source="backend"))
         # speculative decoding (ISSUE 13): per-round proposal/acceptance
         # totals + the derived acceptance rate, skipped when the engine
         # resolved speculation off (non-llama, lockstep, draft=0)
@@ -536,6 +584,19 @@ def _refresh_engine_metrics(state):
                                 dg.get("handoffs", 0),
                                 label_str(model=name,
                                           role=dg.get("role", "both")))
+    # frontend weight byte-warmer (ISSUE 19): OS-page-cache warm totals
+    # for predicted-next gallery models. Process-level (the warmer spans
+    # models), so labeled by source rather than model — the backend's
+    # in-process stream stats export the source="backend" twin above
+    wp = getattr(state.caps, "weight_prefetcher", None)
+    if wp is not None:
+        ws = wp.snapshot()
+        METRICS.set_counter("weight_prefetch_hits_total",
+                            ws.get("hits", 0),
+                            label_str(source="frontend"))
+        METRICS.set_counter("weight_prefetch_bytes_total",
+                            ws.get("bytes_total", 0),
+                            label_str(source="frontend"))
 
 
 async def metrics(request):
@@ -641,13 +702,23 @@ def _collect_state(state) -> dict:
     except Exception:
         loader_stats = {}
     payloads = _backend_state_payloads(state)
-    return {
+    out = {
         "uptime_s": round(time.time() - state.started_at, 1),
         "version": __version__,
         "loader": loader_stats,
         "models": {name: p.get("state") for name, p in payloads.items()},
         "eventlog": EVENTS.snapshot(),
     }
+    # predictive weight prefetch (ISSUE 19): the frontend byte-warmer's
+    # counters + the request-log scores it predicts from. Absent unless
+    # some model armed weight_prefetch=1 (the warmer is built lazily)
+    wp = getattr(state.caps, "weight_prefetcher", None)
+    if wp is not None:
+        out["weight_prefetch"] = {
+            "warmer": wp.snapshot(),
+            "requests": state.caps.model_requests.snapshot(),
+        }
+    return out
 
 
 async def debug_state(request):

@@ -156,20 +156,73 @@ class FakeServicer(BackendServicer):
             memory=pb.MemoryUsageData(total=0),
         )
 
+    def _options(self) -> dict:
+        """Parse the loaded model's proto options (one "k=v,..." string)
+        into a dict — the shape-switching seams below all key off it."""
+        opts = {}
+        raw = self.loaded.options if self.loaded is not None else ""
+        for s in str(raw).split(","):
+            if "=" in s:
+                k, v = s.split("=", 1)
+                opts[k.strip()] = v.strip()
+        return opts
+
+    def _autoscale_payload(self, opts: dict):
+        """(pool_stats, state_autoscale) mirroring EnginePool.metrics()
+        / .state_snapshot() when engines=N>1 or autoscale=1 was
+        requested (ISSUE 19), else (None, None). The HTTP layer's
+        /readyz, /metrics and /debug/state parse these shapes off the
+        real runner; the fake answers the same ones so those surfaces
+        are testable hermetically."""
+        n = int(opts.get("engines", "1") or 1)
+        auto = str(opts.get("autoscale", "0")).lower() in (
+            "1", "true", "on", "yes")
+        if n <= 1 and not auto:
+            return None, None
+        n = max(1, n)
+        stats = {
+            "engine_replicas": n,
+            "engine_replicas_target": n,
+            "replicas": [{"replica": i, "alive": True, "draining": False,
+                          "queued": 0, "slots_in_flight": 0,
+                          "slots_total": 1} for i in range(n)],
+            "pool": {"replicas_alive": n, "replicas_target": n,
+                     "affinity_hits": 0, "affinity_misses": 0,
+                     "routed": 0, "migrations": {}, "index_keys": 0},
+        }
+        last = None
+        if auto:
+            last = {"t": 0.0, "direction": "out", "from": n, "to": n,
+                    "reason": "fake", "signals": {}}
+            stats["pool"]["autoscale"] = {
+                "decisions": {"out": 0, "in": 0},
+                "flaps_suppressed": {"out": 0, "in": 0},
+                "flaps": 0, "last_decision": last,
+                "params": {"min": 1, "max": max(2, n), "burn_out": 1.0,
+                           "burn_in": 0.05, "queue_out_frac": 0.5,
+                           "dwell_s": 2.0, "cooldown_s": 4.0,
+                           "idle_in_s": 1.5},
+            }
+        state_auto = {"enabled": auto, "target": n, "replicas_alive": n,
+                      "replicas_routable": n, "last_decision": last}
+        return stats, state_auto
+
     def GetMetrics(self, request, context):
-        return pb.MetricsResponse(slots_total=1, slots_active=0)
+        stats, _ = self._autoscale_payload(self._options())
+        if stats is None:
+            return pb.MetricsResponse(slots_total=1, slots_active=0)
+        import json
+
+        return pb.MetricsResponse(
+            slots_total=len(stats["replicas"]), slots_active=0,
+            prompt_json_for_slot=json.dumps(stats))
 
     def _kv_payload(self) -> dict:
         """The GetState "kv" key (ISSUE 15): honors the model's
         kv_audit= option ({"mode": "off"} shape) and answers the
         EnginePool merged multi-replica view when engines=N>1 was
         requested — shape mirrors engine.kv_debug()/pool.kv_debug()."""
-        opts = {}
-        raw = self.loaded.options if self.loaded is not None else ""
-        for s in str(raw).split(","):    # proto options is one k=v,... string
-            if "=" in s:
-                k, v = s.split("=", 1)
-                opts[k.strip()] = v.strip()
+        opts = self._options()
         mode = opts.get("kv_audit", "on")
         if mode == "off":
             return {"mode": "off", "replica": 0}
@@ -211,16 +264,20 @@ class FakeServicer(BackendServicer):
         import json
         import time
 
+        st = {"slots": [None], "slots_active": 0, "queued": 0,
+              "warm": True,
+              "compiles": {"compiles_total": 0,
+                           "compile_seconds_total": 0.0,
+                           "compiles_after_warmup": 0,
+                           "warm": True},
+              "last_compiles": [], "watermarks": {},
+              "goodput": {"goodput_tokens_total": 0, "mfu": 0.0},
+              "weight_bytes": 0}
+        _, state_auto = self._autoscale_payload(self._options())
+        if state_auto is not None:
+            st["autoscale"] = state_auto
         return pb.Reply(message=json.dumps({
-            "state": {"slots": [None], "slots_active": 0, "queued": 0,
-                      "warm": True,
-                      "compiles": {"compiles_total": 0,
-                                   "compile_seconds_total": 0.0,
-                                   "compiles_after_warmup": 0,
-                                   "warm": True},
-                      "last_compiles": [], "watermarks": {},
-                      "goodput": {"goodput_tokens_total": 0, "mfu": 0.0},
-                      "weight_bytes": 0},
+            "state": st,
             "events": [{"ts": time.time(), "event": "admit", "seq": 1,
                         "rid": "fake0000"}],
             "kv": self._kv_payload(),

@@ -245,6 +245,14 @@ class EngineServicer(BackendServicer):
         lora_dir = request.lora_adapter
         if lora_dir and request.model_path and not os.path.isabs(lora_dir):
             lora_dir = os.path.join(request.model_path, lora_dir)
+        # parsed BEFORE the weight load: weight_prefetch=1 swaps the
+        # loader itself (ISSUE 19)
+        extra = parse_options(request.options)
+        stream_load = str(extra.get("weight_prefetch", "")
+                          ).strip().lower() in ("1", "true", "on", "yes")
+        stream_auto = str(extra.get("autoscale", "")
+                          ).strip().lower() in ("1", "true", "on", "yes")
+        self.weight_stream_stats = None
         if family is not None:
             params = family.load_hf_params(model_dir, cfg, dtype=dtype)
             # r5 (VERDICT r4 #7): mamba is no longer a single-chip
@@ -266,6 +274,19 @@ class EngineServicer(BackendServicer):
                         if "lm_head" in specs:
                             specs["lm_head"] = P(None, None)
                     params = shardlib.shard_params(mesh, params, specs=specs)
+        elif stream_load:
+            # leaf-at-a-time streaming load (ISSUE 19): bounded host-RAM
+            # chunks + per-leaf yields, so siblings serving in this
+            # process keep their cadence while a swap/scale-out loads
+            params, self.weight_stream_stats = weights.stream_llama_params(
+                model_dir, cfg, mesh=mesh, dtype=dtype,
+                quantize=request.quantization or
+                ("int8" if request.dtype == "int8" else ""),
+                lora_adapter=lora_dir, lora_scale=request.lora_scale or 1.0)
+            log.info("streamed weight load: %d leaves, %.1f MB, %.0f ms",
+                     self.weight_stream_stats["leaves"],
+                     self.weight_stream_stats["bytes"] / 1e6,
+                     self.weight_stream_stats["ms"])
         else:
             params = weights.load_llama_params(
                 model_dir, cfg, mesh=mesh, dtype=dtype,
@@ -283,7 +304,6 @@ class EngineServicer(BackendServicer):
             tok_dir = request.tokenizer or model_dir
             self.tokenizer = AutoTokenizer.from_pretrained(tok_dir)
 
-        extra = parse_options(request.options)
         ecfg = eng.EngineConfig(
             num_slots=request.num_slots or 8,
             max_context=request.context_size or min(cfg.max_position_embeddings, 4096),
@@ -471,6 +491,27 @@ class EngineServicer(BackendServicer):
             **({"disagg": dg} if (dg := str(
                 extra.get("disagg", "") or "").strip().lower()) in
                ("prefill", "decode", "both") else {}),
+            # SLO-driven replica autoscaling (ISSUE 19): autoscale=0 (the
+            # default) builds no policy object and no policy thread —
+            # bit-for-bit the static pool path. autoscale_max=0 means
+            # "twice the configured engines"; explicit 0 must pass, so
+            # isdigit. Burn thresholds are floats (>0).
+            **({"autoscale": True} if stream_auto else {}),
+            **({"autoscale_min": amn} if (amn := int(
+                extra.get("autoscale_min", 0) or 0)) > 0 else {}),
+            **({"autoscale_max": int(v)} if (v := str(
+                extra.get("autoscale_max", "")).strip()).isdigit()
+               else {}),
+            **({"autoscale_burn_out": abo} if (abo := float(
+                extra.get("autoscale_burn_out", 0) or 0)) > 0 else {}),
+            **({"autoscale_burn_in": abi} if (abi := float(
+                extra.get("autoscale_burn_in", 0) or 0)) > 0 else {}),
+            **({"autoscale_dwell_ms": adw} if (adw := int(
+                extra.get("autoscale_dwell_ms", 0) or 0)) > 0 else {}),
+            **({"autoscale_cooldown_ms": acd} if (acd := int(
+                extra.get("autoscale_cooldown_ms", 0) or 0)) > 0 else {}),
+            # predictive weight prefetch / streaming load (ISSUE 19)
+            **({"weight_prefetch": True} if stream_load else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -507,7 +548,10 @@ class EngineServicer(BackendServicer):
         # plain Engine — no pool object anywhere on the path, so single-
         # engine behavior stays bit-for-bit.
         n_engines = max(1, int(extra.get("engines", 1) or 1))
-        if n_engines > 1:
+        if n_engines > 1 or ecfg.autoscale:
+            # autoscale=1 needs the pool even at engines=1: the pool IS
+            # the actuator (resize), and its build-arg stash is what lets
+            # the policy add replicas later (ISSUE 19)
             from localai_tpu.engine.pool import EnginePool
 
             self.engine = EnginePool.build(
@@ -770,6 +814,8 @@ class EngineServicer(BackendServicer):
         if not self.engine:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no model loaded")
         m = self.engine.metrics()
+        if getattr(self, "weight_stream_stats", None):
+            m["weight_stream"] = self.weight_stream_stats
         # the engine's FULL stats dict (kv pool occupancy, prefix-cache
         # counters, TTFT decomposition, ...) rides the proto's free
         # string field as JSON: the stubs are hand-rolled (no protoc in

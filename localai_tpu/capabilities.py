@@ -112,6 +112,98 @@ def predict_metadata(overrides: Optional[dict],
     return tuple(md) or None
 
 
+def weight_prefetch_enabled(mc: ModelConfig) -> bool:
+    """Mirrors the backend's parse of the ``weight_prefetch`` option
+    (ISSUE 19) — default OFF: no request log consumers, no warmer
+    threads, nothing constructed."""
+    for o in mc.options or []:
+        s = str(o)
+        if s.startswith("weight_prefetch="):
+            return s.split("=", 1)[1].strip().lower() in (
+                "1", "true", "on", "yes")
+    return False
+
+
+class WeightByteWarmer:
+    """Frontend-side half of predictive weight prefetch (ISSUE 19,
+    PRESERVE-style): sequentially reads the predicted-next model's
+    checkpoint bytes so they sit warm in the host page cache when the
+    BACKEND process (a separate process — no parsed-leaf handoff is
+    possible across that boundary) mmaps them for its streamed load.
+    The in-process parsed-leaf cache lives in
+    engine/weights.WeightPrefetcher; this class shares its snapshot
+    shape so /metrics exports either identically."""
+
+    _EXTS = (".safetensors", ".gguf", ".bin")
+
+    def __init__(self, max_bytes: int = 8 << 30):
+        self.max_bytes = int(max_bytes)
+        self._warmed: set = set()
+        self._inflight: set = set()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.bytes_total = 0
+        self.prefetches = 0
+
+    def note_request(self, model_dir: str):
+        """A request for ``model_dir`` arrived: count a hit if its bytes
+        were warmed ahead of time (consumes the mark — re-warms happen
+        on the next prediction)."""
+        with self._lock:
+            if model_dir in self._warmed:
+                self._warmed.discard(model_dir)
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def prefetch(self, model_dir: str, wait: bool = False):
+        with self._lock:
+            if model_dir in self._warmed or model_dir in self._inflight:
+                return
+            self._inflight.add(model_dir)
+        t = threading.Thread(target=self._warm, args=(model_dir,),
+                             name="weight-byte-warm", daemon=True)
+        t.start()
+        if wait:
+            t.join()
+
+    def _warm(self, model_dir: str):
+        total = 0
+        try:
+            files = []
+            if os.path.isdir(model_dir):
+                for fn in sorted(os.listdir(model_dir)):
+                    if fn.endswith(self._EXTS):
+                        files.append(os.path.join(model_dir, fn))
+            elif os.path.isfile(model_dir):
+                files = [model_dir]
+            for path in files:
+                with open(path, "rb", buffering=0) as f:
+                    while total < self.max_bytes:
+                        chunk = f.read(16 << 20)
+                        if not chunk:
+                            break
+                        total += len(chunk)
+            if total:
+                with self._lock:
+                    self._warmed.add(model_dir)
+                    self.bytes_total += total
+                    self.prefetches += 1
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._inflight.discard(model_dir)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes_total": self.bytes_total,
+                    "prefetches": self.prefetches,
+                    "warmed": sorted(self._warmed)}
+
+
 def trace_enabled(mc: ModelConfig) -> bool:
     """Is request tracing on for this model? Mirrors the backend's
     parse of the ``trace`` option so the frontend's per-request spans
@@ -166,6 +258,14 @@ class Capabilities:
         self.loader = loader
         self.configs = configs  # name -> ModelConfig
         self._lock = threading.Lock()
+        # predictive weight prefetch feed (ISSUE 19): every model load
+        # notes its name; the warmer is built lazily on the first model
+        # that opts in (weight_prefetch=1), so default-off constructs
+        # nothing beyond the log (one dict, no threads)
+        from localai_tpu.services.gallery_service import ModelRequestLog
+
+        self.model_requests = ModelRequestLog()
+        self.weight_prefetcher: Optional[WeightByteWarmer] = None
 
     # ---- config resolution ----
 
@@ -178,7 +278,33 @@ class Capabilities:
             mc.model = model_name
         return mc
 
+    def _model_dir(self, mc: ModelConfig) -> str:
+        d = mc.model or mc.name
+        if not os.path.isabs(d):
+            d = os.path.join(self.app.models_path, d)
+        return d
+
+    def _note_request(self, mc: ModelConfig):
+        """Feed the prediction log and (when this model opted in) warm
+        the predicted-NEXT model's checkpoint bytes so a gallery-style
+        model switch finds them in the host page cache (ISSUE 19)."""
+        self.model_requests.note(mc.name)
+        if not weight_prefetch_enabled(mc):
+            return
+        if self.weight_prefetcher is None:
+            with self._lock:
+                if self.weight_prefetcher is None:
+                    self.weight_prefetcher = WeightByteWarmer()
+        self.weight_prefetcher.note_request(self._model_dir(mc))
+        nxt = self.model_requests.predict_next(exclude={mc.name})
+        if not nxt:
+            return
+        nmc = self.configs.get(nxt)
+        if nmc is not None:
+            self.weight_prefetcher.prefetch(self._model_dir(nmc))
+
     def _load(self, mc: ModelConfig):
+        self._note_request(mc)
         opts = build_model_options(mc, self.app)
         if mc.backend:
             return self.loader.backend_loader(mc.backend, mc.name, opts)

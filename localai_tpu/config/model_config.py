@@ -168,7 +168,17 @@ class ModelConfig:
     # knob (ISSUE 14) engines=N (N>1 serves the model from N engine
     # replicas behind prefix-affinity routing, sharing ONE host KV tier;
     # requires preempt=1 — pause/resume is the migration primitive.
-    # engines=1, the default, builds a plain single Engine bit-for-bit).
+    # engines=1, the default, builds a plain single Engine bit-for-bit),
+    # or the autoscaling knobs (ISSUE 19) autoscale=0|1 (default 0; 1
+    # runs the SLO-driven replica autoscaler on the pool housekeeping
+    # cadence — requires preempt=1), autoscale_min=N / autoscale_max=N
+    # (replica bounds; max 0 = twice the configured engines),
+    # autoscale_burn_out=F / autoscale_burn_in=F (short-window SLO burn
+    # thresholds for scale-out / scale-in), autoscale_dwell_ms=N /
+    # autoscale_cooldown_ms=N (hysteresis brakes) and weight_prefetch=0|1
+    # (default 0; 1 streams weight loads leaf-at-a-time and warms the
+    # predicted-next gallery model's checkpoint bytes ahead of its first
+    # request).
     # The known knobs are value-validated in validate() so a typo fails
     # at config scan instead of silently running the default.
     options: list = dataclasses.field(default_factory=list)
@@ -280,7 +290,12 @@ class ModelConfig:
                        # off / prefetch off, sink defaults to 1 page
                        "kv_window_pages",
                        "kv_sink_pages",
-                       "kv_prefetch_ahead") and not v.isdigit():
+                       "kv_prefetch_ahead",
+                       # autoscaling (ISSUE 19); autoscale_max=0 = auto
+                       # (twice the configured engines)
+                       "autoscale_max",
+                       "autoscale_dwell_ms",
+                       "autoscale_cooldown_ms") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
@@ -291,7 +306,11 @@ class ModelConfig:
                        "emitter",
                        # preemptive scheduler (ISSUE 10); 0 restores
                        # strict-FIFO admission bit-for-bit
-                       "preempt") and v.lower() not in bool_vals:
+                       "preempt",
+                       # SLO-driven autoscaling + predictive weight
+                       # prefetch (ISSUE 19); both default off
+                       "autoscale",
+                       "weight_prefetch") and v.lower() not in bool_vals:
                 problems.append(
                     f"{k} must be one of {bool_vals}, got {v!r}")
             elif k == "priority" and v.lower() not in ("high", "normal",
@@ -329,6 +348,16 @@ class ModelConfig:
             elif k == "engines" and not (v.isdigit() and int(v) > 0):
                 problems.append(
                     f"engines must be a positive integer, got {v!r}")
+            elif k == "autoscale_min" and not (v.isdigit() and int(v) > 0):
+                problems.append(
+                    f"autoscale_min must be a positive integer, got {v!r}")
+            elif k in ("autoscale_burn_out", "autoscale_burn_in"):
+                try:
+                    if float(v) <= 0:
+                        problems.append(
+                            f"{k} must be > 0, got {v!r}")
+                except ValueError:
+                    problems.append(f"{k} must be a number, got {v!r}")
             elif k == "disagg" and v not in ("both", "prefill", "decode"):
                 # prefill/decode disaggregation role (ISSUE 17)
                 problems.append(
@@ -392,6 +421,20 @@ class ModelConfig:
                 ("0", "false", "off", "no")):
             problems.append("engines>1 requires preempt=1 (pause/resume "
                             "is the pool's migration primitive)")
+        # cross-knob (ISSUE 19): the autoscaler's scale-in drains via
+        # the same pause/resume migration path
+        if opts.get("autoscale", "0").lower() in ("1", "true", "on",
+                                                  "yes"):
+            if opts.get("preempt", "1").lower() in ("0", "false", "off",
+                                                    "no"):
+                problems.append("autoscale=1 requires preempt=1 (scale-in "
+                                "drains via pause/resume migration)")
+        amin, amax = opts.get("autoscale_min", ""), opts.get(
+            "autoscale_max", "")
+        if (amin.isdigit() and amax.isdigit() and int(amax) > 0
+                and int(amin) > int(amax)):
+            problems.append(f"autoscale_min ({amin}) must be <= "
+                            f"autoscale_max ({amax})")
         # cross-knob (ISSUE 17): a disaggregated role ejects/splices via
         # the same pause/resume primitive, and ships chains through the
         # host tier — both must be armed

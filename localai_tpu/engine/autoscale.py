@@ -1,0 +1,185 @@
+"""SLO-driven replica autoscaling policy (ISSUE 19).
+
+Pure decision logic, no threads and no engine imports: the pool's
+housekeeping thread gathers an :class:`~..services.sysobs.AutoscaleSignals`
+snapshot on its normal cadence and feeds it to
+:meth:`AutoscalePolicy.sample`, which returns either a new target
+replica count or None. The pool owns the actuator
+(``EnginePool.resize``); this module owns *when* and *why*.
+
+Design rules the thresholds encode:
+
+* **Scale out strictly before the shed.** The triggers — short-window
+  SLO burn, queue fill fraction, page pressure with a backlog — are all
+  leading indicators that fire while requests are still being admitted.
+  ``queue_out_frac`` defaults to half of ``max_queued_requests``: by
+  the time admission would return Retry-After (queue full), the scaler
+  has already acted.
+* **Never flap.** Two independent brakes: a same-direction *dwell*
+  (one step, then wait for the new replica's effect to show in the
+  signals) and an opposite-direction *cool-down* (a scale-in within
+  ``cooldown_s`` of a scale-out is refused outright, and vice versa).
+  Refused decisions are counted per direction in ``flaps_suppressed``
+  — the bench gate ``AUTOSCALE_FLAPS=0`` pins that the *executed*
+  sequence never reverses inside the cool-down window.
+* **Every decision carries its evidence.** The signal snapshot that
+  justified a decision is stored on the decision record and flight-
+  recorded, so "why did we scale at 03:12" is answerable from the dump
+  directory alone.
+
+The clock is injectable so dwell/cool-down arithmetic is unit-testable
+with hand-picked timestamps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..services import sysobs
+
+
+class AutoscalePolicy:
+    """Hysteretic scale-out/scale-in decision engine.
+
+    ``sample(signals)`` returns the new target replica count when a
+    change is warranted, else None. One step per decision (N -> N+1 or
+    N -> N-1): a big enough backlog re-fires on the next sample after
+    the dwell, which is self-pacing — each added replica gets a chance
+    to move the signals before the next is paid for.
+    """
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 burn_out: float = 1.0, burn_in: float = 0.05,
+                 queue_out_frac: float = 0.5,
+                 interval_s: float = 0.25,
+                 dwell_s: float = 2.0, cooldown_s: float = 4.0,
+                 idle_in_s: float = 1.5,
+                 clock=time.monotonic, flight=None):
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.burn_out = float(burn_out)
+        self.burn_in = float(burn_in)
+        self.queue_out_frac = float(queue_out_frac)
+        self.interval_s = float(interval_s)
+        self.dwell_s = float(dwell_s)
+        self.cooldown_s = float(cooldown_s)
+        self.idle_in_s = float(idle_in_s)
+        self.clock = clock
+        self.flight = flight
+
+        self.decisions = {"out": 0, "in": 0}
+        self.flaps_suppressed = {"out": 0, "in": 0}
+        self.flaps = 0               # executed reversals inside cooldown
+        self.last_decision: Optional[dict] = None
+        self.log = deque(maxlen=16)  # recent decision records
+
+        self._t_sample = -1e18
+        self._last_change = (-1e18, "")   # (t, direction)
+        self._idle_since: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # -- decision core ---------------------------------------------------
+
+    def sample(self, sig: "sysobs.AutoscaleSignals") -> Optional[int]:
+        """Feed one signal snapshot; returns the new target replica
+        count, or None for no change. Cheap when rate-limited — callers
+        may invoke on every housekeeping tick."""
+        now = self.clock()
+        with self._lock:
+            if now - self._t_sample < self.interval_s:
+                return None
+            self._t_sample = now
+
+            n = max(1, int(sig.replicas))
+            want_out, out_reason = self._want_out(sig, n)
+            want_in, in_reason = self._want_in(sig, n, now)
+
+            if want_out:
+                return self._decide(now, "out", n, min(
+                    self.max_replicas, n + 1), out_reason, sig)
+            if want_in:
+                return self._decide(now, "in", n, max(
+                    self.min_replicas, n - 1), in_reason, sig)
+            return None
+
+    def _want_out(self, sig, n):
+        if n >= self.max_replicas:
+            return False, ""
+        if sig.burn_5m >= self.burn_out:
+            return True, f"slo_burn {sig.burn_5m:.2f} >= {self.burn_out}"
+        if sig.queue_frac >= self.queue_out_frac:
+            return True, (f"queue_frac {sig.queue_frac:.2f} >= "
+                          f"{self.queue_out_frac}")
+        if sig.free_page_frac < 0.0625 and sig.queued > 0:
+            return True, (f"page_pressure free={sig.free_page_frac:.3f} "
+                          f"queued={sig.queued}")
+        return False, ""
+
+    def _want_in(self, sig, n, now):
+        idle = (sig.queued == 0 and sig.busy_frac < 0.5
+                and sig.burn_5m <= self.burn_in)
+        if not idle:
+            self._idle_since = None
+            return False, ""
+        if self._idle_since is None:
+            self._idle_since = now
+        if n <= self.min_replicas:
+            return False, ""
+        held = now - self._idle_since
+        if held < self.idle_in_s:
+            return False, ""
+        return True, (f"idle {held:.1f}s (busy={sig.busy_frac:.2f} "
+                      f"burn={sig.burn_5m:.2f})")
+
+    def _decide(self, now, direction, cur, tgt, reason, sig):
+        if tgt == cur:
+            return None
+        t_last, d_last = self._last_change
+        if d_last and d_last != direction and now - t_last < self.cooldown_s:
+            self.flaps_suppressed[direction] += 1
+            return None
+        if d_last == direction and now - t_last < self.dwell_s:
+            self.flaps_suppressed[direction] += 1
+            return None
+        if d_last and d_last != direction and now - t_last < self.cooldown_s:
+            # Unreachable (the cooldown branch above returns) — kept as
+            # a belt-and-braces counter the AUTOSCALE_FLAPS=0 gate pins.
+            self.flaps += 1
+        self._last_change = (now, direction)
+        self._idle_since = None
+        self.decisions[direction] += 1
+        rec = {"t": round(now, 3), "direction": direction,
+               "from": cur, "to": tgt, "reason": reason,
+               "signals": sig.asdict()}
+        self.last_decision = rec
+        self.log.append(rec)
+        if self.flight is not None:
+            try:
+                self.flight.dump("autoscale_" + direction, rec,
+                                 tag="autoscale")
+            except Exception:
+                pass
+        return tgt
+
+    # -- introspection ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "decisions": dict(self.decisions),
+                "flaps_suppressed": dict(self.flaps_suppressed),
+                "flaps": self.flaps,
+                "last_decision": dict(self.last_decision)
+                if self.last_decision else None,
+                "params": {
+                    "min": self.min_replicas, "max": self.max_replicas,
+                    "burn_out": self.burn_out, "burn_in": self.burn_in,
+                    "queue_out_frac": self.queue_out_frac,
+                    "dwell_s": self.dwell_s,
+                    "cooldown_s": self.cooldown_s,
+                    "idle_in_s": self.idle_in_s,
+                },
+            }

@@ -346,6 +346,29 @@ class EngineConfig:
     # registered a "prefill" engine serves requests to completion like
     # "both" — a request is never stranded on a role knob.
     disagg: str = "both"
+    # --- SLO-driven replica autoscaling (ISSUE 19) ---
+    # 0 (default) = bit-for-bit the static pool path: no policy object,
+    # no policy thread, no prefetcher constructed. 1 = the pool's
+    # housekeeping tick feeds live signals (SLO burn, queue fill, page
+    # pressure, preemption EWMA) to engine/autoscale.AutoscalePolicy
+    # and executes the returned EnginePool.resize(n) targets.
+    autoscale: bool = False
+    autoscale_min: int = 1
+    autoscale_max: int = 0          # 0 = twice the configured engines=N
+    # scale-out fires when the worst short-window SLO burn crosses
+    # burn_out; scale-in needs sustained idle with burn under burn_in.
+    autoscale_burn_out: float = 1.0
+    autoscale_burn_in: float = 0.05
+    # hysteresis brakes: same-direction dwell and opposite-direction
+    # cool-down, both in ms (the bench rig shrinks them to seconds).
+    autoscale_dwell_ms: int = 2000
+    autoscale_cooldown_ms: int = 4000
+    # --- predictive weight prefetch (ISSUE 19, PRESERVE-style) ---
+    # 1 = model loads go through weights.stream_llama_params (leaf-at-
+    # a-time, bounded host RAM) and the frontend warms the predicted-
+    # next gallery model's parsed leaves into a host cache ahead of its
+    # first request.
+    weight_prefetch: bool = False
 
 
 @dataclasses.dataclass
@@ -3530,6 +3553,28 @@ class Engine:
         if self.ecfg.resume_reserve_pages > 0:
             return self.ecfg.resume_reserve_pages
         return self._reserve_auto
+
+    def note_pool_resize(self, n_old: int, n_new: int):
+        """Re-anchor the preemption-EWMA reserve when the pool's replica
+        count changes (ISSUE 19 satellite). The EWMA was learned under
+        the OLD replica count: a scale-out spreads the same offered load
+        over more replicas, roughly halving per-replica preemption
+        pressure, but the ~15 s EWMA time constant would keep the stale
+        reserve pinned for many seconds — pages held back from admission
+        for preemptions that will no longer happen here. Rescale the
+        rate by old/new and recompute the auto reserve immediately
+        instead of waiting for the EWMA to drift there."""
+        if n_old <= 0 or n_new <= 0 or n_old == n_new:
+            return
+        ratio = float(n_old) / float(n_new)
+        self._preempt_rate_ewma *= ratio
+        if not self._paged or self._sched is None:
+            return
+        if self.ecfg.resume_reserve_pages > 0:
+            return    # explicit knob wins, nothing derived to fix
+        cap = max(1, self._pool.num_pages // 4)
+        want = self._preempt_rate_ewma * max(1.0, self._preempt_pages_ewma)
+        self._reserve_auto = min(cap, int(round(want)))
 
     def state_snapshot(self) -> dict:
         """Live engine-state JSON for /debug/state (ISSUE 8): slots,

@@ -63,6 +63,11 @@ log = logging.getLogger(__name__)
 _MAX_PINS = 8
 
 
+class _DrainAbort(Exception):
+    """Internal: a scale-in drain couldn't place everything on siblings
+    — the step is abandoned and the replica returns to service."""
+
+
 class SharedKV:
     """The pool-scoped KV state every replica plugs into: one host-tier
     page store (created lazily by the first replica that wants one, so
@@ -167,6 +172,17 @@ class EnginePool:
         # shared host tier on the housekeeping cadence — replicas only
         # scan stores they own, so shared violations count once
         self._t_kv_audit = time.monotonic()
+        # --- dynamic resize / autoscaling (ISSUE 19) ---
+        # build() stashes its ctor args so resize() can construct fresh
+        # replicas; a pool assembled directly can't scale out.
+        self._build_args: Optional[dict] = None
+        self._precompile = False
+        self._draining: set = set()   # replicas emptying toward retire
+        self._retired: set = set()    # cleanly shut down (≠ crashed)
+        self._resize_lock = threading.Lock()
+        self._resize_thread: Optional[threading.Thread] = None
+        self.target_replicas = len(engines)
+        self._policy = None           # autoscale.AutoscalePolicy | None
 
     # ---------- construction ----------
 
@@ -179,9 +195,9 @@ class EnginePool:
         not model memory. Requires the preemptive scheduler: pause/
         resume IS the migration and crash-recovery primitive."""
         ecfg = engine_cfg or eng.EngineConfig()
-        if engines > 1 and not ecfg.preempt:
-            raise ValueError("engines>1 requires preempt=1 (pause/resume "
-                             "is the migration primitive)")
+        if (engines > 1 or ecfg.autoscale) and not ecfg.preempt:
+            raise ValueError("engines>1/autoscale=1 requires preempt=1 "
+                             "(pause/resume is the migration primitive)")
         shared = SharedKV()
         replicas = [
             eng.Engine(model_cfg, params, tokenizer, ecfg,
@@ -189,13 +205,40 @@ class EnginePool:
                        param_shardings=param_shardings, draft=draft,
                        family=family, replica_id=i, shared_kv=shared)
             for i in range(max(1, int(engines)))]
-        return cls(replicas, shared)
+        pool = cls(replicas, shared)
+        # resize() rebuilds replicas from these; params are the SAME
+        # shared device buffers, so a scale-out costs slots + a host
+        # loop, never a weight load (the weight win lives in
+        # weights.stream_llama_params on the gallery-swap path)
+        pool._build_args = dict(
+            model_cfg=model_cfg, params=params, tokenizer=tokenizer,
+            ecfg=ecfg, eos_token_ids=eos_token_ids, mesh=mesh,
+            param_shardings=param_shardings, draft=draft, family=family)
+        return pool
 
     # ---------- lifecycle ----------
 
     def start(self, precompile: bool = False):
+        self._precompile = precompile
         for e in self._engines:
             e.start(precompile=precompile)
+        ecfg = self._engines[0].ecfg
+        if ecfg.autoscale:
+            # autoscale=0 (default) constructs NOTHING here: no policy
+            # object, no extra thread — bit-for-bit the static pool
+            from localai_tpu.engine.autoscale import AutoscalePolicy
+
+            dwell = max(0.05, ecfg.autoscale_dwell_ms / 1000.0)
+            self._policy = AutoscalePolicy(
+                min_replicas=ecfg.autoscale_min,
+                max_replicas=(ecfg.autoscale_max
+                              or 2 * len(self._engines)),
+                burn_out=ecfg.autoscale_burn_out,
+                burn_in=ecfg.autoscale_burn_in,
+                dwell_s=dwell,
+                cooldown_s=max(dwell, ecfg.autoscale_cooldown_ms / 1000.0),
+                idle_in_s=max(0.2, dwell * 0.75),
+                flight=self._engines[0]._flight)
         self._hk_thread = threading.Thread(
             target=self._housekeeping, name="engine-pool", daemon=True)
         self._hk_thread.start()
@@ -204,7 +247,11 @@ class EnginePool:
         self._hk_stop.set()
         if self._hk_thread is not None:
             self._hk_thread.join(timeout=5)
-        for e in self._engines:
+        if self._resize_thread is not None:
+            self._resize_thread.join(timeout=15)
+        for i, e in enumerate(self._engines):
+            if i in self._retired:
+                continue    # scale-in already shut it down cleanly
             try:
                 e.shutdown()
             except Exception:
@@ -261,6 +308,15 @@ class EnginePool:
     def _alive_engines(self):
         return [e for i, e in enumerate(self._engines) if not self._dead[i]]
 
+    def _routable(self, i: int) -> bool:
+        """Eligible for NEW work: alive and not draining toward a
+        scale-in retire (a draining replica still finishes/migrates what
+        it has — it just stops being a routing target)."""
+        return not self._dead[i] and i not in self._draining
+
+    def _routable_idx(self) -> list:
+        return [i for i in range(len(self._engines)) if self._routable(i)]
+
     def _load(self, i: int, rank: int) -> float:
         """Replica load as seen by a class-``rank`` arrival: active
         slots + parked resumes + queue depth weighted by DRR class
@@ -278,7 +334,7 @@ class EnginePool:
 
     def _route(self, req) -> int:
         """Prefix-affinity first, least-loaded otherwise."""
-        alive = [i for i in range(len(self._engines)) if not self._dead[i]]
+        alive = self._routable_idx()
         if not alive:
             raise RuntimeError("engine pool: no live replicas")
         rank = PRIORITY_RANK.get(getattr(req, "priority", None), 1)
@@ -373,8 +429,7 @@ class EnginePool:
         src = self._where.get(request_id)
         if src is None or self._dead[src]:
             return False
-        cands = [i for i in range(len(self._engines))
-                 if i != src and not self._dead[i]]
+        cands = [i for i in self._routable_idx() if i != src]
         if not cands:
             return False
         done = threading.Event()
@@ -427,8 +482,7 @@ class EnginePool:
 
     def _adopt_on_sibling(self, rid: str, entry: ResumeEntry, src: int,
                           reason: str = "crash") -> bool:
-        cands = [i for i in range(len(self._engines))
-                 if i != src and not self._dead[i]]
+        cands = [i for i in self._routable_idx() if i != src]
         if not cands:
             return False
         rank = PRIORITY_RANK.get(entry.priority, 1)
@@ -530,7 +584,8 @@ class EnginePool:
     # ---------- housekeeping ----------
 
     def _housekeeping(self):
-        """Health checks + drain-free queue rebalancing, ~10 Hz."""
+        """Health checks + drain-free queue rebalancing + the autoscale
+        policy tick, ~10 Hz."""
         while not self._hk_stop.wait(0.1):
             try:
                 for i, e in enumerate(self._engines):
@@ -539,12 +594,182 @@ class EnginePool:
                     if not e.loop_alive and not e._stop:
                         self._recover_replica(i)
                 self._rebalance_queued()
+                self._autoscale_tick()
                 t0 = time.monotonic()
                 if t0 - self._t_kv_audit > 0.5:
                     self._t_kv_audit = t0
                     self._audit_shared()
             except Exception:
                 log.exception("engine pool housekeeping failed")
+
+    # ---------- autoscaling (ISSUE 19) ----------
+
+    def autoscale_signals(self):
+        """Policy-input snapshot over ROUTABLE replicas. Gathered on the
+        housekeeping thread from plain attribute reads — no engine locks
+        beyond what qsize()/SLO snapshots already take."""
+        from localai_tpu.services.sysobs import AutoscaleSignals
+
+        engines = [self._engines[i] for i in self._routable_idx()]
+        n = max(1, len(engines))
+        queued = sum(e._queue.qsize() for e in engines)
+        slots = sum(len(e.slots) for e in engines)
+        active = sum(e.num_active for e in engines)
+        burn = 0.0
+        free = 1.0
+        pre = 0.0
+        for e in engines:
+            if e._slo is not None and e._slo.enabled:
+                burn = max(burn, e._slo.max_burn())
+            if e._paged:
+                free = min(free, e._pool.free_pages
+                           / max(1, e._pool.num_pages))
+            pre += getattr(e, "_preempt_rate_ewma", 0.0)
+        mq = self._engines[0].ecfg.max_queued_requests
+        return AutoscaleSignals(
+            replicas=len(engines), queued=queued,
+            queue_frac=(queued / (mq * n)) if mq > 0 else 0.0,
+            busy_frac=(active / slots) if slots else 0.0,
+            burn_5m=burn, free_page_frac=free,
+            preempt_rate_per_min=pre)
+
+    def _autoscale_tick(self):
+        """Feed the policy; execute a returned target on a worker thread
+        so a multi-second spin-up/drain never blocks health checks. At
+        most one resize in flight — the policy is not sampled while one
+        runs (its signals would be mid-transition noise)."""
+        if self._policy is None:
+            return
+        if self._resize_thread is not None and \
+                self._resize_thread.is_alive():
+            return
+        tgt = self._policy.sample(self.autoscale_signals())
+        if tgt is None or tgt == len(self._routable_idx()):
+            return
+        self.target_replicas = tgt
+        self._resize_thread = threading.Thread(
+            target=self._resize_safely, args=(tgt,),
+            name="pool-resize", daemon=True)
+        self._resize_thread.start()
+
+    def _resize_safely(self, n: int):
+        try:
+            self.resize(n, reason="autoscale")
+        except Exception:
+            log.exception("engine pool: autoscale resize to %d failed", n)
+
+    def resize(self, n: int, reason: str = "manual") -> int:
+        """Bring the ROUTABLE replica count to ``n`` one step at a time;
+        returns the resulting count. Scale-out appends a freshly started
+        replica (shared device weights — no load; shared host KV tier —
+        it splices warm chains from the first affinity hit). Scale-in
+        drains the highest-index replica through the existing migrate
+        path and retires it; a drain that cannot complete aborts the
+        step and the replica returns to service (never strands work)."""
+        with self._resize_lock:
+            n = max(1, int(n))
+            n0 = len(self._routable_idx())
+            while True:
+                cur = len(self._routable_idx())
+                if cur == n:
+                    break
+                if cur < n:
+                    self._scale_out(reason)
+                else:
+                    if not self._scale_in(reason):
+                        break
+            self.target_replicas = n
+            got = len(self._routable_idx())
+            if got != n0:
+                for i in self._routable_idx():
+                    # re-anchor the preemption-EWMA reserve to the new
+                    # replica count (ISSUE 19 satellite)
+                    self._engines[i].note_pool_resize(n0, got)
+            return got
+
+    def _scale_out(self, reason: str):
+        if self._build_args is None:
+            raise RuntimeError("pool not built via EnginePool.build(); "
+                               "resize unavailable")
+        a = self._build_args
+        rid = len(self._engines)
+        t0 = time.monotonic()
+        e = eng.Engine(a["model_cfg"], a["params"], a["tokenizer"],
+                       a["ecfg"], eos_token_ids=a["eos_token_ids"],
+                       mesh=a["mesh"],
+                       param_shardings=a["param_shardings"],
+                       draft=a["draft"], family=a["family"],
+                       replica_id=rid, shared_kv=self._shared)
+        # fully started BEFORE it becomes visible to routing: _dead grows
+        # first so len(_engines) never outruns it for lock-free readers
+        e.start(precompile=self._precompile)
+        with self._lock:
+            self._dead.append(False)
+            self._engines.append(e)
+        ms = (time.monotonic() - t0) * 1000.0
+        EVENTS.emit("scale_out", replica=rid, reason=reason,
+                    spinup_ms=round(ms, 1),
+                    replicas=len(self._routable_idx()))
+        log.info("engine pool: scale-out -> replica %d (%s, %.0f ms)",
+                 rid, reason, ms)
+
+    def _scale_in(self, reason: str, timeout_s: float = 10.0) -> bool:
+        routable = self._routable_idx()
+        if len(routable) <= 1:
+            return False
+        i = routable[-1]
+        e = self._engines[i]
+        self._draining.add(i)
+        try:
+            # 1) queued work: nothing computed — plain re-route
+            while True:
+                try:
+                    r = e._queue.get_nowait()
+                except queue.Empty:
+                    break
+                tgt = self._route(r)
+                self._note_where(r.request_id, tgt)
+                self._engines[tgt].submit(r)
+            # 2) parked resumes: adopt on siblings (splice from shared)
+            if e._sched is not None:
+                parked = e._sched.drain_parked()
+                for k, entry in enumerate(parked):
+                    if not self._adopt_on_sibling(
+                            entry.req.request_id, entry, src=i,
+                            reason="scale_in"):
+                        for rest in parked[k:]:
+                            e._sched.adopt(rest)   # re-park, undrained
+                        raise _DrainAbort()
+            # 3) in-flight slots: live migration, byte-gate preserved
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                rids = [s.req.request_id for s in e.slots if s is not None]
+                if not rids:
+                    break
+                for r_id in rids:
+                    self.migrate(r_id, reason="scale_in")
+                time.sleep(0.02)
+            if any(s is not None for s in e.slots):
+                raise _DrainAbort()
+        except _DrainAbort:
+            self._draining.discard(i)
+            log.warning("engine pool: scale-in of replica %d aborted "
+                        "(drain incomplete); replica stays in service", i)
+            return False
+        # empty: retire cleanly. Its device tier goes away with it.
+        e.shutdown()
+        self._shared.index.clear_replica(i)
+        if self._shared.store is not None:
+            self._shared.store.unmap_owner(i)
+        with self._lock:
+            self._dead[i] = True
+            self._retired.add(i)
+        self._draining.discard(i)
+        EVENTS.emit("scale_in", replica=i, reason=reason,
+                    replicas=len(self._routable_idx()))
+        log.info("engine pool: scale-in retired replica %d (%s)",
+                 i, reason)
+        return True
 
     def _audit_shared(self):
         """Invariant scan of the SHARED host tier (ISSUE 15): byte
@@ -564,7 +789,7 @@ class EnginePool:
         queued request (nothing computed yet — this is the zero-risk
         half of drain-free rebalancing; active-slot migration stays
         explicit via migrate())."""
-        alive = [i for i in range(len(self._engines)) if not self._dead[i]]
+        alive = self._routable_idx()
         if len(alive) < 2:
             return
         for i in alive:
@@ -601,9 +826,11 @@ class EnginePool:
             out[k] = sum(m.get(k) or 0 for m in ms)
         out["uptime_s"] = max(m.get("uptime_s", 0) for m in ms)
         out["engine_replicas"] = len(self._engines)
+        out["engine_replicas_target"] = self.target_replicas
         out["replicas"] = [{
             "replica": i,
             "alive": not self._dead[i],
+            "draining": i in self._draining,
             "queued": m.get("queued", 0) if not self._dead[i] else 0,
             "slots_in_flight": (m.get("slots_active", 0)
                                 if not self._dead[i] else 0),
@@ -616,12 +843,15 @@ class EnginePool:
         } for i, m in enumerate(ms)]
         out["pool"] = {
             "replicas_alive": sum(1 for d in self._dead if not d),
+            "replicas_target": self.target_replicas,
             "affinity_hits": self.affinity_hits,
             "affinity_misses": self.affinity_misses,
             "routed": self._routed,
             "migrations": dict(self._migrations),
             "index_keys": len(self._shared.index),
         }
+        if self._policy is not None:
+            out["pool"]["autoscale"] = self._policy.snapshot()
         # lifecycle auditor (ISSUE 15): counters summed pool-wide (the
         # shared-store scans report through the attached auditor, so
         # they're inside one replica's snapshot already)
@@ -668,6 +898,7 @@ class EnginePool:
         (ISSUE 15)."""
         out = {
             "engine_replicas": len(self._engines),
+            "engine_replicas_target": self.target_replicas,
             "replicas": [e.kv_debug() for e in self._engines],
             "pool_index_keys": len(self._shared.index),
         }
@@ -677,7 +908,7 @@ class EnginePool:
         return out
 
     def state_snapshot(self) -> dict:
-        return {
+        out = {
             "engine_replicas": len(self._engines),
             "pool": {
                 "replicas_alive": sum(1 for d in self._dead if not d),
@@ -686,6 +917,18 @@ class EnginePool:
             },
             "replicas": [e.state_snapshot() for e in self._engines],
         }
+        # target-vs-actual + last decision for /debug/state and /readyz
+        # (ISSUE 19) — present whenever pooled so operators see the loop
+        # (or that it's off)
+        out["autoscale"] = {
+            "enabled": self._policy is not None,
+            "target": self.target_replicas,
+            "replicas_alive": sum(1 for d in self._dead if not d),
+            "replicas_routable": len(self._routable_idx()),
+            "last_decision": (self._policy.last_decision
+                              if self._policy is not None else None),
+        }
+        return out
 
     def trace_events(self) -> dict:
         out = self._engines[0].trace_events()

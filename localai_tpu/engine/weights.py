@@ -12,12 +12,17 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
+import threading
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("localai_tpu.weights")
 
 try:
     from safetensors import safe_open
@@ -63,9 +68,11 @@ _QUANT_NAMES = {"embed", "lm_head", "wq", "wk", "wv", "wo",
                 "w_gate", "w_up", "w_down"}
 
 
-def _make_put(cfg, mesh, dtype, quantize, adapter=None):
+def _make_put(cfg, mesh, dtype, quantize, adapter=None, pace=None):
     """Leaf placer: host array + pytree path -> (LoRA-merged) cast /
-    int8/int4-quantized / mesh-sharded device leaf."""
+    int8/int4-quantized / mesh-sharded device leaf. ``pace`` (streaming
+    loads, ISSUE 19) is called with each host leaf before placement —
+    the accounting/chaos/yield seam of ``stream_llama_params``."""
 
     def leaf_spec(spec_path: tuple):
         from localai_tpu.parallel import sharding as shardlib
@@ -76,6 +83,8 @@ def _make_put(cfg, mesh, dtype, quantize, adapter=None):
         return node
 
     def put(arr: np.ndarray, spec_path: tuple):
+        if pace is not None:
+            pace(arr)
         leaf_name = spec_path[-1]
         if adapter is not None and spec_path[0] == "layers" \
                 and adapter.targets_leaf(leaf_name, cfg.num_layers):
@@ -126,6 +135,97 @@ def _make_put(cfg, mesh, dtype, quantize, adapter=None):
     return put
 
 
+def _assemble(source, put) -> dict:
+    """Fold a (spec_path, host array) stream into the stacked pytree,
+    placing each leaf as it arrives and freeing the host copy — peak
+    host memory is one stacked leaf, not the dense model."""
+    params: dict = {"layers": {}}
+    for spec_path, arr in source:
+        node = params
+        for k in spec_path[:-1]:
+            node = node[k]
+        node[spec_path[-1]] = put(arr, spec_path)
+        del arr
+    return params
+
+
+def _host_leaf_source(model_dir: str, cfg, quantize: str = ""):
+    """-> (iterator of (spec_path, host np array), effective_quantize).
+
+    The single checkpoint-format front door: GGUF (dequantized host-side
+    by engine/gguf.py), HF safetensors (+GPTQ/AWQ detection, which may
+    upgrade ``quantize`` — hence it is returned), or the RANDOM-weights
+    bench gate. Iteration is leaf-at-a-time in every case; both
+    load_llama_params and the ISSUE-19 streaming loader/prefetcher
+    consume this."""
+    gguf_path = find_gguf(model_dir)
+    if gguf_path is not None:
+        from localai_tpu.engine import gguf as gguflib
+
+        g = gguflib.open_gguf(gguf_path)
+        return gguflib.iter_llama_tensors(g, cfg), quantize
+    try:
+        tensors = _open_shards(model_dir)
+    except FileNotFoundError:
+        if os.environ.get("LOCALAI_ALLOW_RANDOM_WEIGHTS") == "1":
+            # BENCH/TEST ONLY: a config.json-only dir serves random weights
+            # through the same cast/quantize/shard path — lets the full
+            # serving stack run benchmark-shaped models (e.g. 8B int8 on
+            # one chip) without writing a multi-GB checkpoint to disk.
+            # Gated: silently serving garbage from an incomplete real
+            # checkpoint would be far worse than this convenience.
+            return _iter_random_leaves(cfg), quantize
+        raise
+
+    def get(name: str) -> np.ndarray:
+        h = tensors[name]
+        return h.get_tensor(name)
+
+    from localai_tpu.engine import gptq as gptqlib
+
+    qmeta = gptqlib.detect(model_dir)
+    if qmeta is not None and not quantize:
+        # a GPTQ/AWQ checkpoint carries a memory intent; default to the
+        # TPU-native weight-only int8 so loading it doesn't silently
+        # inflate to dense bf16 (set quantization explicitly to override)
+        quantize = "int8"
+
+    L = cfg.num_layers
+
+    def linear_T(name: str) -> np.ndarray:
+        """Linear weight as [in, out]; GPTQ/AWQ-packed modules are
+        dequantized host-side (engine/gptq.py) in that orientation."""
+        base = name[: -len(".weight")]
+        if qmeta is not None and base + ".qweight" in tensors:
+            return gptqlib.dequant_linear(get, base, qmeta)
+        return get(name).T
+
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
+        mats = []
+        for i in range(L):
+            name = fmt.format(i=i)
+            mats.append(linear_T(name) if transpose else get(name))
+        return np.stack(mats)
+
+    def gen():
+        p = "model.layers.{i}."
+        yield ("embed",), get("model.embed_tokens.weight")
+        yield ("layers", "attn_norm"), stack(p + "input_layernorm.weight")
+        yield ("layers", "wq"), stack(p + "self_attn.q_proj.weight", transpose=True)
+        yield ("layers", "wk"), stack(p + "self_attn.k_proj.weight", transpose=True)
+        yield ("layers", "wv"), stack(p + "self_attn.v_proj.weight", transpose=True)
+        yield ("layers", "wo"), stack(p + "self_attn.o_proj.weight", transpose=True)
+        yield ("layers", "mlp_norm"), stack(p + "post_attention_layernorm.weight")
+        yield ("layers", "w_gate"), stack(p + "mlp.gate_proj.weight", transpose=True)
+        yield ("layers", "w_up"), stack(p + "mlp.up_proj.weight", transpose=True)
+        yield ("layers", "w_down"), stack(p + "mlp.down_proj.weight", transpose=True)
+        yield ("final_norm",), get("model.norm.weight")
+        if not cfg.tie_word_embeddings:
+            yield ("lm_head",), linear_T("lm_head.weight")
+
+    return gen(), quantize
+
+
 def load_llama_params(
     model_dir: str,
     cfg,
@@ -150,87 +250,181 @@ def load_llama_params(
     from localai_tpu.engine.lora import maybe_adapter
 
     adapter = maybe_adapter(lora_adapter, lora_scale)
-    gguf_path = find_gguf(model_dir)
-    if gguf_path is not None:
-        from localai_tpu.engine import gguf as gguflib
+    source, quantize = _host_leaf_source(model_dir, cfg, quantize)
+    return _assemble(source, _make_put(cfg, mesh, dtype, quantize, adapter))
 
-        g = gguflib.open_gguf(gguf_path)
-        put = _make_put(cfg, mesh, dtype, quantize, adapter)
-        params: dict = {"layers": {}}
-        # leaf-at-a-time: dequantize (f16 host), place on device, free —
-        # peak host memory is one stacked leaf, not the dense model
-        for spec_path, arr in gguflib.iter_llama_tensors(g, cfg):
-            node = params
-            for k in spec_path[:-1]:
-                node = node[k]
-            node[spec_path[-1]] = put(arr, spec_path)
-            del arr
-        return params
-    try:
-        tensors = _open_shards(model_dir)
-    except FileNotFoundError:
-        if os.environ.get("LOCALAI_ALLOW_RANDOM_WEIGHTS") == "1":
-            # BENCH/TEST ONLY: a config.json-only dir serves random weights
-            # through the same cast/quantize/shard path — lets the full
-            # serving stack run benchmark-shaped models (e.g. 8B int8 on
-            # one chip) without writing a multi-GB checkpoint to disk.
-            # Gated: silently serving garbage from an incomplete real
-            # checkpoint would be far worse than this convenience.
-            return _random_llama_params(
-                cfg, _make_put(cfg, mesh, dtype, quantize, adapter))
-        raise
 
-    def get(name: str) -> np.ndarray:
-        h = tensors[name]
-        return h.get_tensor(name)
+def stream_llama_params(
+    model_dir: str,
+    cfg,
+    mesh=None,
+    dtype=jnp.bfloat16,
+    quantize: str = "",
+    lora_adapter: str = "",
+    lora_scale: float = 1.0,
+    prefetcher: "Optional[WeightPrefetcher]" = None,
+) -> tuple:
+    """Streaming variant of :func:`load_llama_params` -> (params, stats).
 
-    from localai_tpu.engine import gptq as gptqlib
+    Same leaves, same cast/quantize/place path, two differences (ISSUE
+    19, the warm scale-out / gallery-swap spin-up path):
 
-    qmeta = gptqlib.detect(model_dir)
-    if qmeta is not None and not quantize:
-        # a GPTQ/AWQ checkpoint carries a memory intent; default to the
-        # TPU-native weight-only int8 so loading it doesn't silently
-        # inflate to dense bf16 (set quantization explicitly to override)
-        quantize = "int8"
+    * a per-leaf pace hook — an explicit GIL yield so serving sibling
+      threads keep their cadence while a multi-GB load streams, plus the
+      ``weight_stream_slow_ms`` chaos seam (a slow disk/NFS source must
+      degrade the LOAD, never the siblings);
+    * when ``prefetcher`` holds this model's parsed leaves (predicted
+      ahead of time from the gallery request log), the file-read /
+      GPTQ-dequant / per-layer stack work is already paid — the warm
+      path only casts and places, which is the measured SWAP_WARM_MS
+      win.
 
-    put = _make_put(cfg, mesh, dtype, quantize, adapter)
+    ``stats``: {leaves, bytes, prefetch_hit, ms}.
+    """
+    from localai_tpu.engine.lora import maybe_adapter
+    from localai_tpu.services.faults import FAULTS
 
-    L = cfg.num_layers
+    t0 = time.monotonic()
+    stats = {"leaves": 0, "bytes": 0, "prefetch_hit": False, "ms": 0.0}
 
-    def linear_T(name: str) -> np.ndarray:
-        """Linear weight as [in, out]; GPTQ/AWQ-packed modules are
-        dequantized host-side (engine/gptq.py) in that orientation."""
-        base = name[: -len(".weight")]
-        if qmeta is not None and base + ".qweight" in tensors:
-            return gptqlib.dequant_linear(get, base, qmeta)
-        return get(name).T
+    def pace(arr):
+        stats["leaves"] += 1
+        stats["bytes"] += int(arr.nbytes)
+        if FAULTS.active:
+            ms = FAULTS.take("weight_stream_slow_ms")
+            if ms:
+                time.sleep(min(30.0, float(ms) / 1000.0))
+        time.sleep(0)   # explicit GIL yield between leaves
 
-    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
-        mats = []
-        for i in range(L):
-            name = fmt.format(i=i)
-            mats.append(linear_T(name) if transpose else get(name))
-        return np.stack(mats)
+    adapter = maybe_adapter(lora_adapter, lora_scale)
+    entry = prefetcher.consume(model_dir) if prefetcher is not None else None
+    if entry is not None:
+        stats["prefetch_hit"] = True
+        source = iter(entry.leaves)
+        if not quantize:
+            quantize = entry.quantize
+    else:
+        source, quantize = _host_leaf_source(model_dir, cfg, quantize)
+    params = _assemble(
+        source, _make_put(cfg, mesh, dtype, quantize, adapter, pace=pace))
+    stats["ms"] = (time.monotonic() - t0) * 1000.0
+    return params, stats
 
-    p = "model.layers.{i}."
-    params = {
-        "embed": put(get("model.embed_tokens.weight"), ("embed",)),
-        "layers": {
-            "attn_norm": put(stack(p + "input_layernorm.weight"), ("layers", "attn_norm")),
-            "wq": put(stack(p + "self_attn.q_proj.weight", transpose=True), ("layers", "wq")),
-            "wk": put(stack(p + "self_attn.k_proj.weight", transpose=True), ("layers", "wk")),
-            "wv": put(stack(p + "self_attn.v_proj.weight", transpose=True), ("layers", "wv")),
-            "wo": put(stack(p + "self_attn.o_proj.weight", transpose=True), ("layers", "wo")),
-            "mlp_norm": put(stack(p + "post_attention_layernorm.weight"), ("layers", "mlp_norm")),
-            "w_gate": put(stack(p + "mlp.gate_proj.weight", transpose=True), ("layers", "w_gate")),
-            "w_up": put(stack(p + "mlp.up_proj.weight", transpose=True), ("layers", "w_up")),
-            "w_down": put(stack(p + "mlp.down_proj.weight", transpose=True), ("layers", "w_down")),
-        },
-        "final_norm": put(get("model.norm.weight"), ("final_norm",)),
-    }
-    if not cfg.tie_word_embeddings:
-        params["lm_head"] = put(linear_T("lm_head.weight"), ("lm_head",))
-    return params
+
+class _PrefetchEntry:
+    __slots__ = ("leaves", "quantize", "nbytes")
+
+    def __init__(self, leaves, quantize, nbytes):
+        self.leaves = leaves        # [(spec_path, host np array), ...]
+        self.quantize = quantize    # effective (GPTQ detection applied)
+        self.nbytes = nbytes
+
+
+class WeightPrefetcher:
+    """Host-side parsed-leaf cache for predicted-next models (ISSUE 19,
+    PRESERVE-style).
+
+    ``prefetch()`` parses a checkpoint into its final host leaves (file
+    reads, GPTQ dequant, per-layer stacking, cast to the serving dtype —
+    the expensive host half of a load) on a background thread, bounded
+    by ``budget_mb``; a later ``stream_llama_params(..., prefetcher=...)``
+    for that model consumes the entry and only pays device placement of
+    already-device-dtype bytes (half the volume for a bf16 load of an
+    f32 checkpoint). Entries are popped on consume (the leaves feed
+    placement directly; keeping them would double host RAM) and
+    abandoned — not trimmed — when a model exceeds the budget: a partial
+    cache can't make a load warm.
+    """
+
+    def __init__(self, budget_mb: int = 8192):
+        self.budget_bytes = max(1, int(budget_mb)) * 1024 * 1024
+        self._cache: dict = {}      # model_dir -> _PrefetchEntry
+        self._inflight: dict = {}   # model_dir -> Thread
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.bytes_total = 0        # bytes warmed into cache, lifetime
+        self.prefetches = 0         # completed warms
+        self.aborted = 0            # over-budget / failed warms
+
+    def prefetch(self, model_dir: str, cfg, quantize: str = "",
+                 dtype=jnp.bfloat16, wait: bool = False):
+        """Warm ``model_dir`` in the background (idempotent while cached
+        or in flight). ``dtype`` is the serving dtype the eventual load
+        will request — unquantized leaves are pre-cast to it host-side
+        so the consume path places the exact device bytes. ``wait=True``
+        blocks until the warm finishes — bench/test use; production
+        callers fire and forget."""
+        with self._lock:
+            t = self._inflight.get(model_dir)
+            if t is None and model_dir not in self._cache:
+                t = threading.Thread(
+                    target=self._warm,
+                    args=(model_dir, cfg, quantize, dtype),
+                    name="weight-prefetch", daemon=True)
+                self._inflight[model_dir] = t
+                t.start()
+        if wait and t is not None:
+            t.join()
+
+    def _warm(self, model_dir: str, cfg, quantize: str, dtype=None):
+        try:
+            source, q = _host_leaf_source(model_dir, cfg, quantize)
+            leaves, total = [], 0
+            for spec_path, arr in source:
+                arr = np.asarray(arr)
+                if dtype is not None and not q:
+                    # pre-cast to the serving dtype: quantized loads keep
+                    # f32 (quantize_weight wants full precision); a later
+                    # load at a different dtype just re-casts — correct,
+                    # merely not warm
+                    arr = np.ascontiguousarray(arr.astype(dtype))
+                total += int(arr.nbytes)
+                if total > self.budget_bytes:
+                    # abandon, don't trim: a partial cache still pays
+                    # the cold path and would pin host RAM for nothing
+                    self.aborted += 1
+                    log.warning("weight prefetch of %s abandoned: %d B "
+                                "exceeds budget %d B", model_dir, total,
+                                self.budget_bytes)
+                    return
+                leaves.append((spec_path, arr))
+                time.sleep(0)   # same politeness as the streaming load
+            with self._lock:
+                self._cache[model_dir] = _PrefetchEntry(leaves, q, total)
+                self.bytes_total += total
+                self.prefetches += 1
+        except Exception:
+            self.aborted += 1
+            log.warning("weight prefetch of %s failed", model_dir,
+                        exc_info=True)
+        finally:
+            with self._lock:
+                self._inflight.pop(model_dir, None)
+
+    def consume(self, model_dir: str) -> Optional[_PrefetchEntry]:
+        """Pop the cached entry for a model about to load (hit), or None
+        (miss — counted either way, exported as the hit/miss metrics)."""
+        with self._lock:
+            e = self._cache.pop(model_dir, None)
+        if e is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return e
+
+    def cached(self, model_dir: str) -> bool:
+        with self._lock:
+            return model_dir in self._cache
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            cached = {d: e.nbytes for d, e in self._cache.items()}
+        return {"hits": self.hits, "misses": self.misses,
+                "bytes_total": self.bytes_total,
+                "prefetches": self.prefetches, "aborted": self.aborted,
+                "cached": cached,
+                "budget_bytes": self.budget_bytes}
 
 
 def random_params(cfg, dtype=jnp.bfloat16, quantize: str = "") -> dict:
@@ -240,8 +434,8 @@ def random_params(cfg, dtype=jnp.bfloat16, quantize: str = "") -> dict:
     return _random_llama_params(cfg, _make_put(cfg, None, dtype, quantize))
 
 
-def _random_llama_params(cfg, put) -> dict:
-    """Leaf-at-a-time random weights (see the gate in load_llama_params)."""
+def _iter_random_leaves(cfg):
+    """Leaf-at-a-time random weights (see the gate in _host_leaf_source)."""
     rng = np.random.default_rng(0)
     hd = cfg.head_dim_
     L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
@@ -267,15 +461,12 @@ def _random_llama_params(cfg, put) -> dict:
     ]
     if not cfg.tie_word_embeddings:
         leaves.append((("lm_head",), lambda: mk((D, V), D)))
-    params: dict = {"layers": {}}
     for spec_path, gen in leaves:
-        arr = gen()
-        node = params
-        for k in spec_path[:-1]:
-            node = node[k]
-        node[spec_path[-1]] = put(arr, spec_path)
-        del arr
-    return params
+        yield spec_path, gen()
+
+
+def _random_llama_params(cfg, put) -> dict:
+    return _assemble(_iter_random_leaves(cfg), put)
 
 
 def save_llama_params(params: dict, cfg, model_dir: str):

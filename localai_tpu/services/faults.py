@@ -47,6 +47,11 @@ Injection points (grep for ``FAULTS.take``):
                                  byte in the shipped payload (the receiver's
                                  CRC recompute must reject the entry; the
                                  server's own store is untouched)
+``weight_stream_slow_ms=N``      engine/weights.py ``stream_llama_params``
+                                 pace hook: sleep N ms per streamed leaf — a
+                                 slow checkpoint source must not stall
+                                 serving siblings or flap the autoscaler
+                                 (ISSUE 19; arm ``*`` for the whole load)
 ==========================  =================================================
 """
 

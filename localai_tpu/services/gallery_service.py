@@ -8,18 +8,90 @@ polled at /models/jobs/:uuid).
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
+import time
 import uuid
 from typing import Optional
 
 log = logging.getLogger("localai_tpu.services.gallery")
 
 
+class ModelRequestLog:
+    """Recency/frequency log over model requests — the prediction feed
+    for the ISSUE-19 weight prefetcher (PRESERVE-style).
+
+    Every model-addressed request notes its model name here; the score
+    of a model is a sum of exponentially-decayed request marks
+    (``exp(-(age)/tau)``), so one burst ages out and a steadily-used
+    model keeps a high score. ``predict_next(exclude=...)`` answers
+    "while THIS model serves, which other model is most likely to be
+    asked for next" — that one's weights are worth warming. The clock is
+    injectable so decay arithmetic is unit-testable."""
+
+    def __init__(self, tau_s: float = 600.0, maxlen: int = 512,
+                 clock=time.monotonic):
+        self.tau_s = float(tau_s)
+        self.clock = clock
+        self._marks: dict = {}     # name -> deque-ish list of times
+        self._maxlen = int(maxlen)
+        self._order: list = []     # (t, name) FIFO for global trim
+        self._lock = threading.Lock()
+
+    def note(self, name: str):
+        if not name:
+            return
+        now = self.clock()
+        with self._lock:
+            self._marks.setdefault(name, []).append(now)
+            self._order.append((now, name))
+            while len(self._order) > self._maxlen:
+                t, old = self._order.pop(0)
+                marks = self._marks.get(old)
+                if marks:
+                    try:
+                        marks.remove(t)
+                    except ValueError:
+                        pass
+                    if not marks:
+                        del self._marks[old]
+
+    def scores(self) -> dict:
+        now = self.clock()
+        with self._lock:
+            return {
+                name: sum(math.exp(-max(0.0, now - t) / self.tau_s)
+                          for t in marks)
+                for name, marks in self._marks.items() if marks
+            }
+
+    def predict_next(self, exclude=()) -> str:
+        """Highest-scoring model not in ``exclude`` ('' when the log
+        knows nothing useful — prefetching on no evidence only burns
+        host RAM)."""
+        best, best_s = "", 0.0
+        for name, s in self.scores().items():
+            if name in exclude:
+                continue
+            if s > best_s:
+                best, best_s = name, s
+        return best
+
+    def snapshot(self) -> dict:
+        sc = self.scores()
+        return {"models": {k: round(v, 4) for k, v in sc.items()},
+                "tau_s": self.tau_s}
+
+
 class GalleryService:
     def __init__(self, app_config, caps):
         self.app = app_config
         self.caps = caps
+        # the prediction feed (ISSUE 19): Capabilities notes every
+        # model-addressed request into its ModelRequestLog; exposed here
+        # so gallery-layer consumers can read the same feed
+        self.requests = getattr(caps, "model_requests", None)
         self._jobs: dict[str, dict] = {}
         self._queue: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()

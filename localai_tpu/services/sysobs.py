@@ -38,6 +38,7 @@ no goodput even though they burned FLOPs.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
@@ -364,6 +365,25 @@ def parse_slo_classes(spec: str) -> dict:
         f"(high:normal:low) colon-separated values, got {len(parts)}")
 
 
+@dataclasses.dataclass
+class AutoscaleSignals:
+    """One policy-input snapshot (ISSUE 19) — everything the autoscaler
+    is allowed to see, gathered by the pool on the housekeeping cadence
+    and handed to ``AutoscalePolicy.sample()``. Kept a plain dataclass
+    so every scaling decision can flight-record ``asdict(signals)`` as
+    the evidence that justified it."""
+    replicas: int = 1            # routable (alive, non-draining) replicas
+    queued: int = 0              # queued requests summed over replicas
+    queue_frac: float = 0.0      # queued / (max_queued_requests * replicas)
+    busy_frac: float = 0.0       # active slots / total slots
+    burn_5m: float = 0.0         # worst short-window SLO burn, any class
+    free_page_frac: float = 1.0  # min over replicas (shared pool pressure)
+    preempt_rate_per_min: float = 0.0  # summed preemption EWMA
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class SLOEngine:
     """Per-(metric, class) objective tracking with windowed burn rates.
 
@@ -453,6 +473,24 @@ class SLOEngine:
                     out["classes"].setdefault(cls, {})[metric] = rec
         out["violations_total"] = total_viol
         return out
+
+    def max_burn(self, window_s: Optional[float] = None) -> float:
+        """Policy-input scalar (ISSUE 19): the WORST burn across every
+        observed (metric, class) pair over the short window (default:
+        the 5m window). This is the autoscaler's primary scale-out
+        signal — any one class burning its budget is reason to add a
+        replica, whichever metric is suffering. Pairs with no samples
+        in the window contribute nothing (an idle class is not 'fine',
+        it is silent)."""
+        wsec = float(window_s) if window_s else SLO_WINDOWS[0][1]
+        now = self.clock()
+        worst = 0.0
+        with self._lock:
+            for dq in self._samples.values():
+                burn, n = self._burn(dq, now, wsec)
+                if n and burn > worst:
+                    worst = burn
+        return worst
 
     def burn_events(self) -> list:
         """(metric, class) pairs whose SHORT-window burn is > 1 right

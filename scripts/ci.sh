@@ -17,6 +17,7 @@
 #   LOCALAI_PRIO_BUDGET_S     priority phase wall clock (default 180 here)
 #   LOCALAI_LC_BUDGET_S       long-context phase wall clock (default 300)
 #   LOCALAI_CLUSTER_BUDGET_S  cluster phase wall clock (default 300)
+#   LOCALAI_AUTOSCALE_BUDGET_S autoscale phase wall clock (default 600)
 #
 # Prints the packed-prefill TTFT numbers as a tracked line (ISSUE 4):
 # the loaded-p50 / unloaded-floor ratio from the smoke bench's packed
@@ -359,3 +360,57 @@ PY
 rm -f "$cluster_out"
 
 echo "== ci: OK =="
+
+# SLO-driven replica autoscaling + predictive weight prefetch (ISSUE
+# 19): the same admission burst that sheds on a static pool must
+# instead grow the pool BEFORE the first shed (AUTOSCALE_PRE_SHED), a
+# chaos-slowed whole-checkpoint weight stream must degrade only itself
+# (never the serving siblings), idle decay must scale back in with the
+# in-flight survivor live-migrated byte-identically
+# (SCALE_IN_BYTE_MATCH), the executed decision sequence must never
+# flap (AUTOSCALE_FLAPS=0), and the prefetch-warmed model swap must
+# beat the cold stream by >= 2x (SWAP_RATIO). rc != 0 if any gate
+# regresses.
+echo "== ci: bench autoscale =="
+autoscale_out=$(mktemp)
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=512 \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_AUTOSCALE_BUDGET_S:-600}" \
+    python bench.py --autoscale | tee "$autoscale_out"
+
+python - "$autoscale_out" <<'PY'
+import json, sys
+
+line = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if ln.startswith("{") and "metric" in ln:
+        line = json.loads(ln)
+print(f"AUTOSCALE_PRE_SHED={1 if line.get('pre_shed') else 0} "
+      f"sheds_without_autoscale={line.get('sheds_without_autoscale')} "
+      f"spinup_ms={line.get('spinup_ms')} "
+      f"scale_out_events={line.get('scale_out_events')} "
+      f"scale_in_events={line.get('scale_in_events')}")
+print(f"SWAP_COLD_MS={line.get('swap_cold_ms')} "
+      f"SWAP_WARM_MS={line.get('swap_warm_ms')} "
+      f"SWAP_RATIO={line.get('swap_ratio')} "
+      f"SCALE_IN_BYTE_MATCH={line.get('byte_gate_ok')} "
+      f"AUTOSCALE_FLAPS={line.get('flaps')}")
+kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
+print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
+if (line.get("pre_shed") is not True
+        or line.get("byte_gate_ok") is not True
+        or line.get("flaps") != 0
+        or (line.get("swap_ratio") or 0) < 2.0
+        or line.get("slow_stream_stall_free") is not True):
+    print(f"FAIL: autoscale serving regressed (pre_shed="
+          f"{line.get('pre_shed')} and byte_gate_ok="
+          f"{line.get('byte_gate_ok')} must be true, flaps="
+          f"{line.get('flaps')} must be 0, swap_ratio="
+          f"{line.get('swap_ratio')} must be >= 2, "
+          f"slow_stream_stall_free={line.get('slow_stream_stall_free')} "
+          f"must be true)")
+    sys.exit(1)
+sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
+PY
+rm -f "$autoscale_out"

@@ -1,0 +1,459 @@
+"""SLO-driven replica autoscaling + predictive weight prefetch (ISSUE 19).
+
+Three layers, mirroring the feature's own split:
+
+* AutoscalePolicy units with a hand-cranked clock — the hysteresis
+  arithmetic (dwell, cool-down, idle hold, rate limit) is pure and must
+  be provably flap-free without ever building an engine;
+* live EnginePool resize — manual scale-out/in, the scale-in live
+  migration's byte gate, and the closed policy->resize loop end to end
+  on the tiny model;
+* the warm-up half — WeightPrefetcher hit/miss/budget accounting, the
+  host-side dtype pre-cast that makes the warm path cheap, and the
+  ``weight_stream_slow_ms`` chaos seam.
+
+Byte-gate rule learned the hard way (bench --autoscale): the reference
+prompt must be a PRISTINE local copy — ``_start_resume`` rewrites
+``req.prompt_ids`` to the full processed history on re-admission, so
+reading it back off the request after a migration double-counts the
+pre-pause tokens.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling, weights
+from localai_tpu.engine.autoscale import AutoscalePolicy
+from localai_tpu.engine.pool import EnginePool
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.sysobs import AutoscaleSignals
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _greedy(tok, prompt: str, n: int = 8) -> eng.GenRequest:
+    return eng.GenRequest(
+        prompt_ids=tok.encode(prompt),
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n, ignore_eos=True)
+
+
+def _collect(out, timeout: float = 60.0) -> list:
+    events = []
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return events
+        events.append(ev)
+
+
+# ---- AutoscalePolicy units (fake clock) ----
+
+
+def _sig(replicas=1, queued=0, queue_frac=0.0, busy_frac=0.0,
+         burn=0.0, free=1.0):
+    return AutoscaleSignals(replicas=replicas, queued=queued,
+                            queue_frac=queue_frac, busy_frac=busy_frac,
+                            burn_5m=burn, free_page_frac=free)
+
+
+def _policy(**kw):
+    t = {"now": 0.0}
+    kw.setdefault("interval_s", 0.0)   # rate limit off unless under test
+    return AutoscalePolicy(clock=lambda: t["now"], **kw), t
+
+
+def test_policy_scale_out_triggers():
+    p, t = _policy(min_replicas=1, max_replicas=3,
+                   dwell_s=1.0, cooldown_s=2.0)
+    # SLO burn fires a step out
+    assert p.sample(_sig(replicas=1, burn=1.0)) == 2
+    assert p.decisions["out"] == 1
+    assert "slo_burn" in p.last_decision["reason"]
+    # queue fill fires the next step after the dwell
+    t["now"] = 5.0
+    assert p.sample(_sig(replicas=2, queue_frac=0.5)) == 3
+    assert "queue_frac" in p.last_decision["reason"]
+    # blocked at max — not a decision, not a suppression
+    t["now"] = 10.0
+    assert p.sample(_sig(replicas=3, burn=9.0)) is None
+    assert p.decisions["out"] == 2 and p.flaps_suppressed["out"] == 0
+    # page pressure needs a backlog behind it
+    q, _ = _policy(max_replicas=4)
+    assert q.sample(_sig(free=0.05, queued=0)) is None
+    assert q.sample(_sig(free=0.05, queued=1)) == 2
+    assert "page_pressure" in q.last_decision["reason"]
+
+
+def test_policy_scale_in_requires_sustained_idle():
+    p, t = _policy(min_replicas=1, max_replicas=4, idle_in_s=1.5,
+                   dwell_s=0.5, cooldown_s=0.5)
+    idle = _sig(replicas=2, queued=0, busy_frac=0.1, burn=0.0)
+    t["now"] = 10.0
+    assert p.sample(idle) is None          # idle clock starts here
+    t["now"] = 11.0
+    assert p.sample(idle) is None          # held 1.0 s < 1.5 s
+    t["now"] = 11.6
+    assert p.sample(idle) == 1             # held long enough
+    assert p.decisions["in"] == 1 and "idle" in p.last_decision["reason"]
+    # a busy sample resets the idle clock
+    t["now"] = 20.0
+    assert p.sample(idle) is None
+    t["now"] = 20.5
+    assert p.sample(_sig(replicas=2, busy_frac=0.9)) is None
+    t["now"] = 21.6                        # idle clock restarts HERE
+    assert p.sample(idle) is None
+    t["now"] = 22.2                        # held 0.6 s — still too soon
+    assert p.sample(idle) is None
+    t["now"] = 23.2
+    assert p.sample(idle) == 1
+    # at the floor, idle never scales below min
+    q, tq = _policy(min_replicas=1)
+    one = _sig(replicas=1, queued=0, busy_frac=0.0)
+    for tq["now"] in (0.0, 5.0, 50.0):
+        assert q.sample(one) is None
+    assert q.decisions["in"] == 0
+
+
+def test_policy_hysteresis_never_flaps():
+    p, t = _policy(min_replicas=1, max_replicas=4, idle_in_s=1.5,
+                   dwell_s=2.0, cooldown_s=4.0)
+    assert p.sample(_sig(replicas=1, burn=2.0)) == 2        # out at t=0
+    # same-direction re-fire inside the dwell: suppressed
+    t["now"] = 1.0
+    assert p.sample(_sig(replicas=2, burn=2.0)) is None
+    assert p.flaps_suppressed["out"] == 1
+    # opposite direction inside the cool-down: suppressed, even though
+    # the idle hold is satisfied
+    idle = _sig(replicas=2, queued=0, busy_frac=0.0, burn=0.0)
+    t["now"] = 1.5
+    assert p.sample(idle) is None          # idle clock starts
+    t["now"] = 3.5
+    assert p.sample(idle) is None          # held 2.0 s, but cooldown
+    assert p.flaps_suppressed["in"] == 1
+    # past the cool-down the scale-in executes — and the executed
+    # sequence never reversed inside the window
+    t["now"] = 4.5
+    assert p.sample(idle) == 1
+    assert p.flaps == 0
+    assert p.decisions == {"out": 1, "in": 1}
+
+
+def test_policy_rate_limit_and_snapshot():
+    class Flight:
+        def __init__(self):
+            self.dumps = []
+
+        def dump(self, name, rec, tag=None):
+            self.dumps.append((name, tag))
+
+    fl = Flight()
+    t = {"now": 0.0}
+    p = AutoscalePolicy(interval_s=10.0, dwell_s=0.0, cooldown_s=0.0,
+                        max_replicas=8, clock=lambda: t["now"], flight=fl)
+    assert p.sample(_sig(replicas=1, burn=2.0)) == 2
+    t["now"] = 5.0                          # inside the sample interval
+    assert p.sample(_sig(replicas=2, burn=2.0)) is None
+    t["now"] = 10.0
+    assert p.sample(_sig(replicas=2, burn=2.0)) == 3
+    assert p.decisions["out"] == 2
+    # every decision carries its evidence and hits the flight recorder
+    snap = p.snapshot()
+    assert set(snap) == {"decisions", "flaps_suppressed", "flaps",
+                         "last_decision", "params"}
+    last = snap["last_decision"]
+    assert last["direction"] == "out" and last["from"] == 2
+    assert last["to"] == 3 and last["signals"]["burn_5m"] == 2.0
+    assert set(snap["params"]) == {"min", "max", "burn_out", "burn_in",
+                                   "queue_out_frac", "dwell_s",
+                                   "cooldown_s", "idle_in_s"}
+    assert fl.dumps == [("autoscale_out", "autoscale")] * 2
+    assert len(p.log) == 2
+
+
+# ---- knob validation ----
+
+
+def test_autoscale_option_validation():
+    from localai_tpu.config.model_config import ModelConfig
+
+    ok = ModelConfig(name="m", options=[
+        "autoscale=1", "preempt=1", "autoscale_min=1", "autoscale_max=4",
+        "autoscale_burn_out=1.5", "weight_prefetch=1"])
+    assert not ok.validate()
+    no_pre = ModelConfig(name="m", options=["autoscale=1", "preempt=0"])
+    assert any("preempt" in p for p in no_pre.validate())
+    bad_min = ModelConfig(name="m", options=["autoscale_min=0"])
+    assert any("autoscale_min" in p for p in bad_min.validate())
+    inverted = ModelConfig(name="m",
+                           options=["autoscale_min=3", "autoscale_max=2"])
+    assert any("autoscale_min" in p for p in inverted.validate())
+    bad_burn = ModelConfig(name="m", options=["autoscale_burn_out=warm"])
+    assert any("autoscale_burn_out" in p for p in bad_burn.validate())
+    bad_bool = ModelConfig(name="m", options=["weight_prefetch=2"])
+    assert any("weight_prefetch" in p for p in bad_bool.validate())
+
+
+def test_pool_build_rejects_autoscale_without_preempt(tiny_llama,
+                                                      byte_tokenizer):
+    cfg, params = tiny_llama
+    with pytest.raises(ValueError, match="preempt"):
+        EnginePool.build(cfg, params, byte_tokenizer,
+                         eng.EngineConfig(num_slots=1, max_context=96,
+                                          prefill_buckets=(16, 64),
+                                          preempt=False, autoscale=True),
+                         engines=1)
+
+
+# ---- live pool: manual resize + the scale-in byte gate ----
+
+
+def test_pool_scale_in_live_migration_byte_match(tiny_llama,
+                                                 byte_tokenizer):
+    """resize(1) drains the top replica through the migrate path: the
+    rider's stream never closes and its continuation equals a FRESH
+    pool re-admission of (pristine prompt + tokens emitted before the
+    pause); resize(2) spins a warm sibling back up."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=2, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=8)
+    pool = EnginePool.build(cfg, params, byte_tokenizer, ecfg, engines=2)
+    pool.start()
+    try:
+        EVENTS.clear()
+        n = 64
+        prompts = ["scale-in must carry me home",
+                   "unrelated sibling keeps running"]
+        reqs, outs, firsts = [], [], []
+        for pr in prompts:   # sequential: least-loaded puts one on each
+            r = _greedy(byte_tokenizer, pr, n)
+            o = pool.submit(r)
+            first = o.get(timeout=60.0)
+            assert first.error is None
+            reqs.append(r)
+            outs.append(o)
+            firsts.append(first)
+        homes = [pool.where(r.request_id) for r in reqs]
+        assert sorted(homes) == [0, 1]
+        ridx = homes.index(1)              # the one the drain evicts
+        rider, prompt = reqs[ridx], prompts[ridx]
+        assert pool.resize(1, reason="test") == 1
+        evs = [[firsts[i]] + _collect(outs[i]) for i in range(2)]
+        assert all(e.error is None for es in evs for e in es)
+        ids = eng.event_ids(evs[ridx])
+        assert len(ids) == n
+        pre = [ev for ev in EVENTS.events()
+               if ev["event"] == "preempt"
+               and ev["rid"] == rider.request_id]
+        assert any(ev.get("why") == "migrate" for ev in pre), \
+            "scale-in must pause via the preemption primitive"
+        # the resume contract anchors at the LAST pause: a later
+        # page-pressure preempt re-prefills and may differ in the last
+        # ulps from rows the earlier reference would splice
+        k = pre[-1]["n_decoded"]
+        assert 0 < k < n
+        mig = [ev for ev in EVENTS.events()
+               if ev["event"] == "migrate"
+               and ev["rid"] == rider.request_id]
+        assert mig and mig[-1]["reason"] == "scale_in"
+        assert mig[-1]["dst"] == 0
+        sin = [ev for ev in EVENTS.events() if ev["event"] == "scale_in"]
+        assert sin and sin[-1]["replicas"] == 1
+        # byte gate — pristine prompt, NEVER rider.prompt_ids (resume
+        # rewrote it to the full processed history)
+        ref = eng.event_ids(list(pool.generate(eng.GenRequest(
+            prompt_ids=byte_tokenizer.encode(prompt) + ids[:k],
+            params=sampling.SamplingParamsHost(temperature=0.0),
+            max_new_tokens=n - k, ignore_eos=True))))
+        assert ids[k:] == ref
+        # warm scale-out: shared device weights, no load — the replica
+        # is routable again and serves
+        assert pool.resize(2, reason="test") == 2
+        sout = [ev for ev in EVENTS.events() if ev["event"] == "scale_out"]
+        assert sout and sout[-1]["spinup_ms"] >= 0
+        again = _greedy(byte_tokenizer, "post scale-out sanity", 8)
+        assert all(e.error is None for e in _collect(pool.submit(again)))
+        assert pool.metrics()["pool"]["replicas_alive"] == 2
+    finally:
+        pool.shutdown()
+
+
+@pytest.mark.slow
+def test_pool_autoscale_closed_loop(tiny_llama, byte_tokenizer):
+    """The whole loop on a live pool: a queue backlog scales 1 -> 2
+    before admission sheds, sustained idle scales back to the floor,
+    and the executed sequence never flaps."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=2, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=8, max_queued_requests=8,
+                            autoscale=True, autoscale_min=1,
+                            autoscale_max=2, autoscale_dwell_ms=300,
+                            autoscale_cooldown_ms=600)
+    pool = EnginePool.build(cfg, params, byte_tokenizer, ecfg, engines=1)
+    pool.start()
+    try:
+        EVENTS.clear()
+        # 8 requests on a 2-slot replica: the queue fill fraction crosses
+        # queue_out_frac while everything is still admitted (pre-shed)
+        outs = [pool.submit(_greedy(byte_tokenizer, f"backlog {i}", 48))
+                for i in range(8)]
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if pool.metrics()["pool"]["replicas_alive"] == 2:
+                break
+            time.sleep(0.05)
+        assert pool.metrics()["pool"]["replicas_alive"] == 2
+        assert pool.target_replicas == 2
+        for o in outs:                       # nothing was shed or broken
+            assert all(e.error is None for e in _collect(o))
+        deadline = time.monotonic() + 30.0   # idle -> back to the floor
+        while time.monotonic() < deadline:
+            if pool.metrics()["pool"]["replicas_alive"] == 1:
+                break
+            time.sleep(0.05)
+        m = pool.metrics()
+        assert m["pool"]["replicas_alive"] == 1
+        auto = m["pool"]["autoscale"]
+        assert auto["decisions"]["out"] >= 1
+        assert auto["decisions"]["in"] >= 1
+        assert auto["flaps"] == 0
+        assert auto["last_decision"]["direction"] == "in"
+    finally:
+        pool.shutdown()
+
+
+# ---- resume-reserve re-anchor on resize (ISSUE 19 satellite) ----
+
+
+def test_note_pool_resize_reanchors_reserve(tiny_llama, byte_tokenizer):
+    cfg, params = tiny_llama
+    e = eng.Engine(cfg, params, byte_tokenizer,
+                   eng.EngineConfig(num_slots=2, max_context=96,
+                                    prefill_buckets=(16, 64),
+                                    kv_page_size=8))
+    e._preempt_rate_ewma = 4.0               # learned under 1 replica
+    e._preempt_pages_ewma = 4.0
+    cap = max(1, e._pool.num_pages // 4)
+    # scale-out halves the per-replica rate and recomputes NOW — no
+    # waiting for the ~15 s EWMA to drift there
+    e.note_pool_resize(1, 2)
+    assert e._preempt_rate_ewma == pytest.approx(2.0)
+    assert e._reserve_auto == min(cap, 8)    # round(2.0 * 4 pages)
+    # scale-in doubles it back
+    e.note_pool_resize(2, 1)
+    assert e._preempt_rate_ewma == pytest.approx(4.0)
+    assert e._reserve_auto == min(cap, 16)
+    # degenerate inputs are no-ops
+    r0 = e._reserve_auto
+    e.note_pool_resize(2, 2)
+    e.note_pool_resize(0, 2)
+    e.note_pool_resize(2, 0)
+    assert e._reserve_auto == r0
+    assert e._preempt_rate_ewma == pytest.approx(4.0)
+    # the explicit knob still wins: the rate is re-anchored but the
+    # derived reserve is left alone and the effective value is the knob
+    e.ecfg.resume_reserve_pages = 3
+    e.note_pool_resize(1, 4)
+    assert e._preempt_rate_ewma == pytest.approx(1.0)
+    assert e._reserve_auto == r0
+    assert e.resume_reserve_effective == 3
+
+
+# ---- predictive weight prefetch + the slow-stream chaos seam ----
+
+
+@pytest.fixture(scope="module")
+def saved_tiny(tiny_llama, tmp_path_factory):
+    cfg, params = tiny_llama
+    d = tmp_path_factory.mktemp("ckpt")
+    weights.save_llama_params(params, cfg, str(d))
+    return str(d), cfg
+
+
+def test_weight_prefetch_warm_hit(saved_tiny):
+    d, cfg = saved_tiny
+    wp = weights.WeightPrefetcher(budget_mb=64)
+    wp.prefetch(d, cfg, wait=True)
+    assert wp.cached(d)
+    snap = wp.snapshot()
+    assert snap["prefetches"] == 1 and snap["bytes_total"] > 0
+    # unquantized leaves are pre-cast host-side: the warm load only
+    # pays device placement of already-serving-dtype bytes
+    assert all(a.dtype == jnp.bfloat16
+               for _, a in wp._cache[d].leaves)
+    warm, wstats = weights.stream_llama_params(d, cfg, prefetcher=wp)
+    assert wstats["prefetch_hit"]
+    assert wstats["leaves"] > 0 and wstats["bytes"] > 0
+    assert not wp.cached(d)                  # consume pops the entry
+    cold, cstats = weights.stream_llama_params(d, cfg, prefetcher=wp)
+    assert not cstats["prefetch_hit"]        # miss falls back cold
+    assert cstats["leaves"] == wstats["leaves"]
+    s = wp.snapshot()
+    assert s["hits"] == 1 and s["misses"] == 1
+    np.testing.assert_array_equal(np.asarray(warm["embed"]),
+                                  np.asarray(cold["embed"]))
+    np.testing.assert_array_equal(
+        np.asarray(warm["layers"]["wq"]), np.asarray(cold["layers"]["wq"]))
+
+
+def test_weight_prefetch_budget_abandon(saved_tiny):
+    d, cfg = saved_tiny
+    wp = weights.WeightPrefetcher(budget_mb=1)
+    wp.budget_bytes = 1024                   # force over-budget
+    wp.prefetch(d, cfg, wait=True)
+    assert not wp.cached(d)                  # abandoned, not trimmed
+    assert wp.snapshot()["aborted"] == 1
+    params, stats = weights.stream_llama_params(d, cfg, prefetcher=wp)
+    assert not stats["prefetch_hit"] and stats["leaves"] > 0
+    assert params["embed"].shape[0] == cfg.vocab_size
+
+
+def test_weight_stream_slow_fault_paces_only_the_load(saved_tiny):
+    d, cfg = saved_tiny
+    _, base = weights.stream_llama_params(d, cfg)
+    FAULTS.arm("weight_stream_slow_ms", "200", count=4)
+    _, slow = weights.stream_llama_params(d, cfg)
+    # 4 leaves each slept ~200 ms inside the per-leaf pace hook
+    assert slow["ms"] - base["ms"] >= 500
+    assert not FAULTS.active                 # armed count fully consumed
+    assert slow["leaves"] == base["leaves"]
+
+
+# ---- fake backend answers the same shapes (hermetic HTTP tests) ----
+
+
+def test_fake_backend_autoscale_shapes():
+    from localai_tpu.backend.fake import FakeServicer
+
+    fs = FakeServicer()
+    fs.loaded = types.SimpleNamespace(options="engines=2,autoscale=1")
+    stats, state_auto = fs._autoscale_payload(fs._options())
+    assert stats["engine_replicas_target"] == 2
+    assert stats["pool"]["replicas_target"] == 2
+    auto = stats["pool"]["autoscale"]
+    assert set(auto) == {"decisions", "flaps_suppressed", "flaps",
+                         "last_decision", "params"}
+    assert auto["flaps"] == 0
+    assert state_auto["enabled"] and state_auto["target"] == 2
+    st = json.loads(fs.GetState(None, None).message.decode())["state"]
+    assert st["autoscale"]["last_decision"]["direction"] == "out"
+    # autoscale off, one replica: no payload — the static shapes stay
+    # bit-for-bit what they were before ISSUE 19
+    fs.loaded = types.SimpleNamespace(options="")
+    assert fs._autoscale_payload(fs._options()) == (None, None)
