@@ -1973,6 +1973,148 @@ def bench_cluster(cfg, S, C, max_new=32):
         FAULTS.reset()
         _kv_sweep(router, out)
         router.shutdown()
+    # ---- phase 4: real-process remote hosts (ISSUE 20) ----
+    # The control plane's three contracts, each against a SPAWNED OS
+    # process (not a thread): a slow host is depreferred, never killed
+    # (CLUSTER_SLOW_NOT_KILLED); graceful drain hands live streams to a
+    # sibling byte-identically and the child exits 0
+    # (CLUSTER_DRAIN_BYTE_MATCH); kill -9 mid-stream recovers
+    # byte-identically on the sibling (CLUSTER_PROC_RECOVERED).
+    from localai_tpu.services.cluster_rpc import RemoteHostHandle
+
+    mcfg = {k: int(getattr(cfg, k)) for k in
+            ("vocab_size", "hidden_size", "intermediate_size",
+             "num_layers", "num_heads", "num_kv_heads",
+             "max_position_embeddings")}
+    if getattr(cfg, "head_dim", None):
+        mcfg["head_dim"] = int(cfg.head_dim)
+    spec = {
+        "host_id": 1, "role": "both", "engines": 1,
+        # param_dtype bf16 = random_params' default, so the child's
+        # weights are bit-identical to this process's `params`
+        "model": {"kind": "llama-random", "dtype": "float32",
+                  "param_dtype": "bfloat16", "config": mcfg},
+        "tokenizer": "byte256",
+        "engine": {"num_slots": 2, "max_context": C,
+                   "prefill_buckets": [32, 128], "decode_burst": 4,
+                   "kv_page_size": pg, "cache_dtype": "float32",
+                   "kv_audit": "on"},
+        "precompile": False, "drain_grace_s": 8.0, "drain_linger_s": 0.5,
+    }
+    env = dict(os.environ)
+    if "JAX_PLATFORMS" not in env:
+        import jax
+        env["JAX_PLATFORMS"] = jax.default_backend()
+
+    def spawn(dead_ms):
+        return RemoteHostHandle.spawn(spec, env=env, heartbeat_ms=100,
+                                      suspect_ms=400, dead_ms=dead_ms)
+
+    # spawn A: slow phase, then graceful drain. dead_ms is generous so
+    # GIL pauses in THIS process can't walk the detector to sticky DEAD
+    # — a slow child must end the phase alive.
+    t0 = time.monotonic()
+    hA = spawn(dead_ms=6000)
+    out["proc_spawn_s"] = round(time.monotonic() - t0, 1)
+    router = ClusterRouter([
+        ClusterHost.build(cfg, params, _ByteTokenizer(), ecfg,
+                          host_id=0, engines=1, role="both"), hA])
+    router.start(precompile=True)
+    try:
+        p4 = rng.integers(0, 255, size=plen).tolist()
+        _, _, werr = drain(router.submit(make_req(p4, 4), host=1))
+        out["proc_warm_ok"] = werr is None
+
+        # slow != dead: 600 ms RPC delay on every frame (> suspect_ms
+        # 400) holds the rtt-EWMA SUSPECT rung once it converges
+        hA.fault("cluster_rpc_delay_ms=600*")
+        sus = wait_for(
+            lambda: hA.heartbeat_telemetry()["rtt_ewma_ms"] > 500, 25)
+        states = set()
+        tend = time.monotonic() + 1.5
+        while time.monotonic() < tend:
+            states.add(hA.state)
+            time.sleep(0.05)
+        routed_away = []
+        for _ in range(3):
+            r = make_req(rng.integers(0, 255, size=plen).tolist(), 2)
+            drain(router.submit(r))
+            routed_away.append(router.where(r.request_id) == 0)
+        hA.fault("reset")
+        rec = wait_for(lambda: hA.state == "alive", 15)
+        out["slow_states"] = sorted(states)
+        out["slow_routed_away"] = sum(routed_away)
+        out["slow_not_killed"] = bool(sus and states == {"suspect"}
+                                      and all(routed_away) and rec)
+
+        # graceful drain mid-stream: handoff -> sibling re-adopts the
+        # continuation byte-identically, child exits 0
+        EVENTS.clear()
+        p5 = rng.integers(0, 255, size=plen).tolist()
+        victim = make_req(p5, max_new)
+        o = router.submit(victim, host=1)
+        first = o.get()
+        router.drain_host(1)
+        ids, _, derr = drain(o, first_ev=first)
+        migs = [ev for ev in EVENTS.events() if ev["event"] == "migrate"
+                and ev["rid"] == victim.request_id]
+        k = migs[0]["n_decoded"] if migs else 0
+        out["drain_reason"] = migs[0]["reason"] if migs else None
+        out["drain_n_decoded"] = k
+        dmatch = False
+        if derr is None and len(ids) == max_new and 0 < k < max_new \
+                and router.where(victim.request_id) == 0:
+            ref, _, rerr = drain(router.submit(
+                make_req(list(p5) + ids[:k], max_new - k), host=0))
+            dmatch = rerr is None and ids[k:] == ref
+        exited = wait_for(lambda: hA.proc.poll() is not None, 30)
+        out["drain_child_exit"] = hA.proc.poll() if exited else None
+        out["drain_byte_match"] = bool(dmatch
+                                       and out["drain_child_exit"] == 0)
+    finally:
+        FAULTS.reset()
+        _kv_sweep(router, out)
+        router.shutdown()
+
+    # spawn B: kill -9 mid-stream. Tight dead_ms — detection speed is
+    # the point here, and no compile runs between kill and failover.
+    hB = spawn(dead_ms=1500)
+    router = ClusterRouter([
+        ClusterHost.build(cfg, params, _ByteTokenizer(), ecfg,
+                          host_id=0, engines=1, role="both"), hB])
+    router.start(precompile=True)
+    try:
+        drain(router.submit(make_req(  # child pays its compile now
+            rng.integers(0, 255, size=plen).tolist(), 4), host=1))
+        EVENTS.clear()
+        p6 = rng.integers(0, 255, size=plen).tolist()
+        victim = make_req(p6, max_new)
+        o = router.submit(victim, host=1)
+        first = o.get()
+        hB.kill()
+        ids, _, cerr = drain(o, first_ev=first)
+        migs = [ev for ev in EVENTS.events() if ev["event"] == "migrate"
+                and ev["rid"] == victim.request_id]
+        k = migs[0]["n_decoded"] if migs else 0
+        out["proc_crash_reason"] = migs[0]["reason"] if migs else None
+        out["proc_crash_n_decoded"] = k
+        pmatch = False
+        if cerr is None and len(ids) == max_new and 0 < k < max_new \
+                and router.where(victim.request_id) == 0:
+            ref, _, rerr = drain(router.submit(
+                make_req(list(p6) + ids[:k], max_new - k), host=0))
+            pmatch = rerr is None and ids[k:] == ref
+        m = router.metrics()["cluster"]
+        out["proc_remote_recovered"] = m.get("remote_recovered", 0)
+        out["proc_host_states"] = m.get("host_states")
+        out["proc_recovered"] = bool(
+            pmatch and m.get("remote_recovered", 0) >= 1
+            and m.get("host_states", {}).get("1") == "dead")
+    finally:
+        FAULTS.reset()
+        _kv_sweep(router, out)
+        router.shutdown()
+
     out["recovered"] = bool(out.get("crash_stream_ok")
                             and out.get("crash_byte_match")
                             and out.get("host_recovered") == 1
@@ -3416,6 +3558,9 @@ def main():
                   and r.get("stream_byte_match") is True
                   and r.get("disagg_byte_match") is True
                   and r.get("recovered") is True
+                  and r.get("proc_recovered") is True
+                  and r.get("drain_byte_match") is True
+                  and r.get("slow_not_killed") is True
                   and r.get("kv_audit_violations") == 0)
             print(json.dumps({
                 "metric": f"cluster_{preset}", "value": 1 if ok else 0,

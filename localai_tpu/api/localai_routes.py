@@ -291,6 +291,9 @@ def _refresh_engine_metrics(state):
               *(f"kv_stream_{m}_total" for _k, m in _KV_STREAM_COUNTERS),
               "kv_stream_inflight", "kv_stream_peers_online",
               "cluster_hosts", "disagg_handoffs_total",
+              "engine_queue_limit", "cluster_host_state",
+              "cluster_heartbeat_rtt_ms", "cluster_rpc_retries_total",
+              "cluster_rpc_timeouts_total",
               "engine_replicas", "replica_queue_depth",
               "replica_slots_in_flight", "replica_migrations_total",
               "pool_affinity_hits_total", "pool_affinity_misses_total",
@@ -573,11 +576,37 @@ def _refresh_engine_metrics(state):
             METRICS.set_gauge("kv_stream_peers_online",
                               ks.get("peers_online", 0),
                               label_str(model=name))
+        # admission capacity after autoscale co-scaling (ISSUE 20): the
+        # effective queue limit tracks live width, so shed behavior is
+        # observable next to queue_depth
+        if "queue_limit" in stats:
+            METRICS.set_gauge("engine_queue_limit",
+                              stats.get("queue_limit", 0),
+                              label_str(model=name))
         # cluster width + prefill/decode disaggregation handoffs
         cl = stats.get("cluster")
         if cl:
             METRICS.set_gauge("cluster_hosts", cl.get("hosts_alive", 0),
                               label_str(model=name))
+            # process-mode control plane (ISSUE 20): failure-detector
+            # states, heartbeat RTT, and the RPC retry/timeout ledger
+            for st in ("alive", "suspect", "dead"):
+                METRICS.set_gauge(
+                    "cluster_host_state",
+                    sum(1 for v in (cl.get("host_states") or {}).values()
+                        if v == st),
+                    label_str(model=name, state=st))
+            for hid, hb in (cl.get("heartbeat") or {}).items():
+                METRICS.set_gauge("cluster_heartbeat_rtt_ms",
+                                  hb.get("rtt_ms", 0.0),
+                                  label_str(model=name, host=hid))
+            rpc = cl.get("rpc") or {}
+            for op, n in (rpc.get("retries") or {}).items():
+                METRICS.set_counter("cluster_rpc_retries_total", n,
+                                    label_str(model=name, op=op))
+            for op, n in (rpc.get("timeouts") or {}).items():
+                METRICS.set_counter("cluster_rpc_timeouts_total", n,
+                                    label_str(model=name, op=op))
         dg = stats.get("disagg")
         if dg:
             METRICS.set_counter("disagg_handoffs_total",

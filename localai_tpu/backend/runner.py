@@ -512,6 +512,37 @@ class EngineServicer(BackendServicer):
                 extra.get("autoscale_cooldown_ms", 0) or 0)) > 0 else {}),
             # predictive weight prefetch / streaming load (ISSUE 19)
             **({"weight_prefetch": True} if stream_load else {}),
+            # federated KV stream timing (ISSUE 20, formerly hardcoded):
+            # peer cooldown / negative-cache TTL / connect timeout.
+            # Explicit 0 is meaningful (no cooldown / no negative
+            # cache), so isdigit passes it through.
+            **({"kv_stream_cooldown_ms": int(v)} if (v := str(
+                extra.get("kv_stream_cooldown_ms", "")).strip()).isdigit()
+               else {}),
+            **({"kv_stream_negcache_ms": int(v)} if (v := str(
+                extra.get("kv_stream_negcache_ms", "")).strip()).isdigit()
+               else {}),
+            **({"kv_stream_connect_timeout_ms": cto} if (cto := int(
+                extra.get("kv_stream_connect_timeout_ms", 0) or 0)) > 0
+               else {}),
+            # cluster control plane (ISSUE 20): host placement + the
+            # failure-detector / retry schedule knobs
+            **({"cluster_mode": cm} if (cm := str(
+                extra.get("cluster_mode", "") or "").strip().lower()) in
+               ("inproc", "process") else {}),
+            **({"cluster_heartbeat_ms": chb} if (chb := int(
+                extra.get("cluster_heartbeat_ms", 0) or 0)) > 0 else {}),
+            **({"cluster_suspect_ms": csu} if (csu := int(
+                extra.get("cluster_suspect_ms", 0) or 0)) > 0 else {}),
+            **({"cluster_dead_ms": cde} if (cde := int(
+                extra.get("cluster_dead_ms", 0) or 0)) > 0 else {}),
+            **({"cluster_rpc_timeout_ms": crt} if (crt := int(
+                extra.get("cluster_rpc_timeout_ms", 0) or 0)) > 0 else {}),
+            **({"cluster_rpc_retries": int(v)} if (v := str(
+                extra.get("cluster_rpc_retries", "")).strip()).isdigit()
+               else {}),
+            **({"cluster_rpc_backoff_ms": crb} if (crb := int(
+                extra.get("cluster_rpc_backoff_ms", 0) or 0)) > 0 else {}),
         )
         # chaos harness: a faults=... model option arms the in-process
         # fault table (same spec format as the LOCALAI_FAULTS env var,
@@ -606,8 +637,14 @@ class EngineServicer(BackendServicer):
                                                               KVStreamClient)
 
                     self.kv_fed = FederatedKV(store, [
-                        KVStreamClient(a, store.scope, store.page_size)
-                        for a in kv_peers]).attach()
+                        KVStreamClient(
+                            a, store.scope, store.page_size,
+                            timeout_s=ecfg.kv_stream_connect_timeout_ms
+                            / 1e3,
+                            cooldown_s=ecfg.kv_stream_cooldown_ms / 1e3)
+                        for a in kv_peers],
+                        neg_ttl_s=ecfg.kv_stream_negcache_ms / 1e3,
+                    ).attach()
                     log.info("kv federated tier attached: %d peer(s)",
                                 len(kv_peers))
         self._embed = request.embeddings

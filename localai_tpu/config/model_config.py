@@ -295,7 +295,22 @@ class ModelConfig:
                        # (twice the configured engines)
                        "autoscale_max",
                        "autoscale_dwell_ms",
-                       "autoscale_cooldown_ms") and not v.isdigit():
+                       "autoscale_cooldown_ms",
+                       # federated KV stream timing (ISSUE 20, formerly
+                       # hardcoded): peer cooldown / negative-cache TTL /
+                       # connect timeout, all in ms
+                       "kv_stream_cooldown_ms",
+                       "kv_stream_negcache_ms",
+                       "kv_stream_connect_timeout_ms",
+                       # cluster control plane (ISSUE 20): heartbeat
+                       # cadence, failure-detector windows, per-op
+                       # deadline + retry schedule
+                       "cluster_heartbeat_ms",
+                       "cluster_suspect_ms",
+                       "cluster_dead_ms",
+                       "cluster_rpc_timeout_ms",
+                       "cluster_rpc_retries",
+                       "cluster_rpc_backoff_ms") and not v.isdigit():
                 problems.append(
                     f"{k} must be a non-negative integer "
                     f"(0 = engine default), got {v!r}")
@@ -362,6 +377,10 @@ class ModelConfig:
                 # prefill/decode disaggregation role (ISSUE 17)
                 problems.append(
                     f"disagg must be both|prefill|decode, got {v!r}")
+            elif k == "cluster_mode" and v not in ("inproc", "process"):
+                # cluster host placement (ISSUE 20)
+                problems.append(
+                    f"cluster_mode must be inproc|process, got {v!r}")
             elif k == "kv_peers":
                 # peer wire addresses, |-separated (the options wire
                 # splits on commas): host:port[|host:port...]
@@ -435,6 +454,15 @@ class ModelConfig:
                 and int(amin) > int(amax)):
             problems.append(f"autoscale_min ({amin}) must be <= "
                             f"autoscale_max ({amax})")
+        # cross-knob (ISSUE 20): the failure-detector ladder only works
+        # if the SUSPECT window opens strictly before the DEAD one — a
+        # slow host must be able to sit in SUSPECT without dying
+        sus, ded = opts.get("cluster_suspect_ms", ""), opts.get(
+            "cluster_dead_ms", "")
+        if (sus.isdigit() and ded.isdigit()
+                and int(sus) >= int(ded) and int(ded) > 0):
+            problems.append(f"cluster_suspect_ms ({sus}) must be < "
+                            f"cluster_dead_ms ({ded})")
         # cross-knob (ISSUE 17): a disaggregated role ejects/splices via
         # the same pause/resume primitive, and ships chains through the
         # host tier — both must be armed

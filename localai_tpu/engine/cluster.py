@@ -34,8 +34,22 @@ hosts with the wire as the only new mechanism:
   wire are DECLARED EXTRAS, never leaks — ``kv_audit_sweep`` folds
   every host's sweep and checks all transports are quiesced.
 
+* PROCESS MODE (ISSUE 20): hosts may run as real OS processes behind
+  the control plane (services/cluster_rpc.py). The router drives a
+  ``RemoteHostHandle`` through the exact same facade as an in-process
+  ``ClusterHost`` (submit / cancel / chain_keys / metrics_snapshot /
+  kv_audit_sweep / load / alive) — it is agnostic to whether a host is
+  a thread or a PID. Remote liveness comes from a phi-accrual heartbeat
+  detector: SUSPECT hosts (slow, answering late) are DE-PREFERRED in
+  routing and skipped as KV-streaming targets but keep their streams;
+  DEAD hosts (silent past ``cluster_dead_ms``, or the process exited)
+  trigger recovery — each lost stream re-admits (prompt + delivered
+  tokens) on a sibling, byte-identical by the PR-10 contract.
+
 ``cluster=off`` (the default) never constructs any of this — the
-single-host PR-16 path is untouched, bit-for-bit.
+single-host PR-16 path is untouched, bit-for-bit. ``cluster_mode=
+inproc`` (the default) builds only in-process hosts: no heartbeats, no
+RPC, bit-for-bit PR-17.
 """
 
 from __future__ import annotations
@@ -51,6 +65,7 @@ from localai_tpu.engine import engine as eng
 from localai_tpu.engine.kv_stream import FederatedKV, KVStreamClient
 from localai_tpu.engine.pool import EnginePool
 from localai_tpu.engine.scheduler import PRIORITY_RANK, ResumeEntry
+from localai_tpu.services.cluster_rpc import FailureDetector
 from localai_tpu.services.eventlog import EVENTS
 from localai_tpu.services.faults import FAULTS
 from localai_tpu.services.kv_wire import KVWireServer, WireError
@@ -73,6 +88,8 @@ class ClusterHost:
     packed prefill only; finished prefills retire to the transport) or
     ``decode`` (receives disagg handoffs; the router keeps fresh
     arrivals away when a prefill host is alive)."""
+
+    remote = False
 
     def __init__(self, host_id: int, pool: EnginePool, role: str = "both",
                  bind: str = "127.0.0.1"):
@@ -139,9 +156,15 @@ class ClusterHost:
         """Attach the federated tier: this host's store misses consult
         these peers (every other host's wire address)."""
         store = self.pool._shared.store
-        peers = [KVStreamClient(a, store.scope, store.page_size)
+        ecfg = self.pool._engines[0].ecfg
+        peers = [KVStreamClient(
+                     a, store.scope, store.page_size,
+                     timeout_s=ecfg.kv_stream_connect_timeout_ms / 1e3,
+                     cooldown_s=ecfg.kv_stream_cooldown_ms / 1e3)
                  for a in addresses if a and a != self.address]
-        self.fed = FederatedKV(store, peers).attach()
+        self.fed = FederatedKV(store, peers,
+                               neg_ttl_s=ecfg.kv_stream_negcache_ms / 1e3
+                               ).attach()
         return self.fed
 
     def shutdown(self):
@@ -185,6 +208,41 @@ class ClusterHost:
                    for i in range(len(self.pool._engines))
                    if not self.pool._dead[i])
 
+    # ---------- uniform host facade (ISSUE 20) ----------
+    # The router drives every host — in-process or behind the control
+    # plane — through exactly these methods, so it is agnostic to
+    # whether a host is a thread or a PID.
+
+    @property
+    def state(self) -> str:
+        return (FailureDetector.DEAD if not self.alive
+                else FailureDetector.ALIVE)
+
+    def submit(self, req) -> "queue.Queue":
+        return self.pool.submit(req)
+
+    def cancel(self, rid: str):
+        self.pool.cancel(rid)
+
+    def chain_keys(self, ids) -> list:
+        pc = self.pool._engines[0]._pcache
+        return list(pc.chain_keys(ids)) if pc is not None else []
+
+    def metrics_snapshot(self) -> dict:
+        return {
+            "pool": self.pool.metrics(),
+            "kv_stream": (self.fed.stats() if self.fed is not None else {}),
+            "kv_stream_served": (self.server.stats()
+                                 if self.server is not None else {}),
+            "kv_debug": self.pool.kv_debug(),
+        }
+
+    def kv_audit_sweep(self, drained: bool = False) -> dict:
+        out = dict(self.pool.kv_audit_sweep(drained=drained))
+        out["stream_inflight"] = (self.fed.inflight
+                                  if self.fed is not None else 0)
+        return out
+
 
 class ClusterRouter:
     """Front door over N ClusterHosts: cross-host prefix-affinity
@@ -209,6 +267,11 @@ class ClusterRouter:
         self.disagg_handoffs = 0
         self.hosts_recovered = 0
         self._routed = 0
+        # remote (process-mode) host bookkeeping: streams re-adopted
+        # after a crash/drain, idempotence guard per request id
+        self.remote_recovered = 0
+        self.drains = 0
+        self._recovering: set = set()
         self._hk_stop = threading.Event()
         self._hk_thread: Optional[threading.Thread] = None
 
@@ -218,15 +281,25 @@ class ClusterRouter:
         addrs = [h.start(precompile=precompile) for h in self.hosts]
         for h in self.hosts:
             h.connect_peers(addrs)
-        store = self.hosts[0].pool._shared.store
         # the router's own digest/stats connections ride the same wire
         # the federated tier uses — affinity data is whatever a peer
-        # could learn, no in-process shortcuts
-        self._clients = [KVStreamClient(a, store.scope, store.page_size)
-                         for a in addrs]
+        # could learn, no in-process shortcuts. Remote hosts answer
+        # DIGEST over the control plane instead (the idempotent-retry
+        # path); their failover callbacks land here.
+        for i, h in enumerate(self.hosts):
+            if h.remote:
+                h.on_stream_lost = self._remote_stream_lost
+                h.on_state_change = self._remote_state_change
+            else:
+                store = h.pool._shared.store
+                e0 = h.pool._engines[0].ecfg
+                self._clients[i] = KVStreamClient(
+                    addrs[i], store.scope, store.page_size,
+                    timeout_s=e0.kv_stream_connect_timeout_ms / 1e3,
+                    cooldown_s=e0.kv_stream_cooldown_ms / 1e3)
         # prefill-role engines hand finished chains to the router
         for i, h in enumerate(self.hosts):
-            if h.role == "prefill":
+            if h.role == "prefill" and not h.remote:
                 for e in h.pool._engines:
                     e.disagg_handoff = self._make_handoff(i)
         self._hk_thread = threading.Thread(
@@ -271,17 +344,34 @@ class ClusterRouter:
     def _poll_digests(self):
         """Refresh the per-host chain-key sets used for affinity. A
         host that fails to answer keeps its last digest — stale beats
-        empty, and the federated fetch at admission is the backstop."""
+        empty, and the federated fetch at admission is the backstop.
+        SUSPECT remote hosts are skipped entirely: a slow peer keeps
+        its streams but gets no new probe traffic from the router."""
         for i in self._alive_hosts():
-            c = self._clients[i]
-            if c is None or not c.online():
-                continue
-            try:
-                d = c.digest()
-            except (OSError, WireError):
-                continue
+            h = self.hosts[i]
+            if h.remote:
+                if h.state != FailureDetector.ALIVE:
+                    continue
+                try:
+                    d = h.digest()
+                except (OSError, WireError):
+                    continue
+            else:
+                c = self._clients[i]
+                if c is None or not c.online():
+                    continue
+                try:
+                    d = c.digest()
+                except (OSError, WireError):
+                    continue
             self._digests[i] = {bytes.fromhex(k)
                                 for k in d.get("keys", ())}
+
+    def _penalty(self, i: int) -> int:
+        """Routing de-preference: a SUSPECT host (slow but answering)
+        sorts behind every healthy host at any load — degraded, not
+        excluded; it still serves if it is all that's left."""
+        return 0 if self.hosts[i].state == FailureDetector.ALIVE else 1
 
     def _match_depth(self, keys: list, digest: set) -> int:
         d = 0
@@ -309,27 +399,28 @@ class ClusterRouter:
         rank = PRIORITY_RANK.get(getattr(req, "priority", None), 1)
         self._routed += 1
         if len(cands) > 1 and getattr(req, "prompt_ids", None):
-            pc = self.hosts[cands[0]].pool._engines[0]._pcache
-            if pc is not None:
-                keys = list(pc.chain_keys(req.prompt_ids))
-                best_i, best_d = None, 0
-                for i in cands:
-                    d = self._match_depth(keys, self._digests[i])
-                    if d > best_d or (d == best_d and d > 0
-                                      and best_i is not None
-                                      and self.hosts[i].load(rank)
-                                      < self.hosts[best_i].load(rank)):
-                        best_i, best_d = i, d
-                if best_i is not None and best_d > 0:
-                    self.affinity_hits += 1
-                    return best_i
-                self.affinity_misses += 1
-        return min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+            keys = self.hosts[cands[0]].chain_keys(req.prompt_ids)
+            best_i, best_d = None, 0
+            for i in cands:
+                if self._penalty(i):
+                    continue            # a SUSPECT host never wins
+                d = self._match_depth(keys, self._digests[i])
+                if d > best_d or (d == best_d and d > 0
+                                  and best_i is not None
+                                  and self.hosts[i].load(rank)
+                                  < self.hosts[best_i].load(rank)):
+                    best_i, best_d = i, d
+            if best_i is not None and best_d > 0:
+                self.affinity_hits += 1
+                return best_i
+            self.affinity_misses += 1
+        return min(cands, key=lambda i: (self._penalty(i),
+                                         self.hosts[i].load(rank), i))
 
     def submit(self, req, host: Optional[int] = None) -> "queue.Queue":
         i = self._route(req, host=host)
         self._note_where(req.request_id, i)
-        return self.hosts[i].pool.submit(req)
+        return self.hosts[i].submit(req)
 
     def generate(self, req, host: Optional[int] = None):
         out = self.submit(req, host=host)
@@ -342,10 +433,10 @@ class ClusterRouter:
     def cancel(self, request_id: str):
         i = self._where.get(request_id)
         if i is not None and not self._dead[i]:
-            self.hosts[i].pool.cancel(request_id)
+            self.hosts[i].cancel(request_id)
         else:
             for i in self._alive_hosts():
-                self.hosts[i].pool.cancel(request_id)
+                self.hosts[i].cancel(request_id)
 
     # ---------- chain pinning ----------
 
@@ -392,9 +483,14 @@ class ClusterRouter:
             self._place_disagg(src, entry, keys)
 
     def _place_disagg(self, src: int, entry: ResumeEntry, keys: list):
+        # ResumeEntry adoption is an in-process move (live slot state);
+        # remote hosts receive work as fresh submissions only, so they
+        # are never disagg targets. SUSPECT hosts are de-preferred: the
+        # router stops placing KV-streaming work on a slow peer.
         rid = entry.req.request_id
         cands = [i for i in self._alive_hosts()
-                 if i != src and self.hosts[i].role != "prefill"]
+                 if i != src and not self.hosts[i].remote
+                 and self.hosts[i].role != "prefill"]
         if not cands:
             # no decode host: hand the request back — the source engine
             # decodes it to completion (never strand a client stream)
@@ -402,13 +498,15 @@ class ClusterRouter:
             if not self._dead[src] and self._adopt_on(src, rid, entry):
                 return
             for i in self._alive_hosts():
-                if self._adopt_on(i, rid, entry):
+                if not self.hosts[i].remote \
+                        and self._adopt_on(i, rid, entry):
                     return
             self.hosts[src].pool._fail_stream(
                 entry.req, "disagg: no host can adopt")
             return
         rank = PRIORITY_RANK.get(entry.priority, 1)
-        tgt = min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+        tgt = min(cands, key=lambda i: (self._penalty(i),
+                                        self.hosts[i].load(rank), i))
         host = self.hosts[tgt]
         # stream the prefilled chain over BEFORE admission so the decode
         # host splices local, verified bytes (prefetch > demand-fetch:
@@ -459,7 +557,10 @@ class ClusterRouter:
         history. Client streams never close — the StreamEvent queues
         ride the ResumeEntries (pool._recover_replica, one level up)."""
         host = self.hosts[i]
-        self._dead[i] = True
+        with self._lock:
+            if self._dead[i]:
+                return              # another thread already harvesting
+            self._dead[i] = True
         host.pool._hk_stop.set()    # no same-host recovery races
         self._digests[i] = set()
         EVENTS.emit("cluster_host_down", host=i, role=host.role)
@@ -532,13 +633,16 @@ class ClusterRouter:
     def _adopt_on_sibling_host(self, rid: str, entry: ResumeEntry,
                                src: int) -> bool:
         cands = [i for i in self._alive_hosts()
-                 if i != src and self.hosts[i].role != "prefill"]
+                 if i != src and not self.hosts[i].remote
+                 and self.hosts[i].role != "prefill"]
         if not cands:
-            cands = [i for i in self._alive_hosts() if i != src]
+            cands = [i for i in self._alive_hosts()
+                     if i != src and not self.hosts[i].remote]
         if not cands:
             return False
         rank = PRIORITY_RANK.get(entry.priority, 1)
-        tgt = min(cands, key=lambda i: (self.hosts[i].load(rank), i))
+        tgt = min(cands, key=lambda i: (self._penalty(i),
+                                        self.hosts[i].load(rank), i))
         host = self.hosts[tgt]
         pc = host.pool._engines[0]._pcache
         keys = list(pc.chain_keys(entry.ids)) if pc is not None else []
@@ -555,13 +659,172 @@ class ClusterRouter:
                     n_decoded=entry.n_decoded)
         return True
 
+    # ---------- remote (process-mode) failure handling ----------
+
+    def _remote_state_change(self, handle, prev: str, state: str):
+        """Heartbeat-thread callback: failure-detector transitions.
+        SUSPECT needs no action here — routing reads ``state`` live and
+        de-prefers; DEAD marks the host down (its own heartbeat thread
+        aborts the streams, which fail over via _remote_stream_lost)."""
+        try:
+            i = self.hosts.index(handle)
+        except ValueError:
+            return
+        EVENTS.emit("cluster_host_state", host=i, prev=prev, state=state,
+                    phi=round(handle.detector.phi(), 3))
+        if state == FailureDetector.DEAD:
+            self._mark_remote_dead(i)
+
+    def _mark_remote_dead(self, i: int):
+        with self._lock:
+            if self._dead[i]:
+                return
+            self._dead[i] = True
+        self._digests[i] = set()
+        self.hosts_recovered += 1
+        EVENTS.emit("cluster_host_down", host=i,
+                    role=self.hosts[i].role, remote=True)
+        log.warning("cluster: remote host %d declared dead; streams "
+                    "fail over as they surface", i)
+
+    def _remote_stream_lost(self, handle, req, emitted: list, reason: str):
+        """A remote stream ended without EOF — the host crashed, hung
+        past ``cluster_dead_ms``, or drained. Recovery is the PR-10
+        contract from the CLIENT side: re-admit (prompt + delivered
+        tokens) as a fresh continuation on a sibling and bridge its
+        events into the original stream — byte-identical, because
+        resume ≡ fresh re-admission. SUBMIT is never auto-retried; this
+        path is the one and only re-drive, idempotent per request."""
+        rid = req.request_id
+        with self._lock:
+            if rid in self._recovering:
+                return
+            self._recovering.add(rid)
+        try:
+            i = self.hosts.index(handle)
+        except ValueError:
+            i = -1
+        remaining = int(req.max_new_tokens) - len(emitted)
+        if remaining <= 0:
+            # every token was delivered (and the last one carried the
+            # finish reason); only the EOF marker was lost
+            req.out.put(None)
+            return
+        cands = [j for j in self._alive_hosts()
+                 if j != i and self.hosts[j].role != "prefill"]
+        if not cands:
+            cands = [j for j in self._alive_hosts() if j != i]
+        if not cands:
+            self._fail_remote_stream(req, f"cluster host "
+                                     f"{handle.host_id} lost ({reason}); "
+                                     f"no live sibling")
+            return
+        rank = PRIORITY_RANK.get(getattr(req, "priority", None), 1)
+        tgt = min(cands, key=lambda j: (self._penalty(j),
+                                        self.hosts[j].load(rank), j))
+        host = self.hosts[tgt]
+        hist = list(req.prompt_ids) + [int(t) for t in emitted]
+        cont = eng.GenRequest(
+            prompt_ids=hist, params=req.params,
+            max_new_tokens=remaining,
+            stop_sequences=list(req.stop_sequences or []),
+            ignore_eos=req.ignore_eos, grammar=req.grammar,
+            priority=req.priority,
+            request_id=f"{rid}~r{len(emitted)}")
+        # warm-chain pull: the dead host's wire server may survive a
+        # drain (and a hang); a kill -9 lost it too — then the fetch
+        # fails fast and the continuation re-prefills the identical
+        # history. Correct either way, warm when possible.
+        if not host.remote:
+            keys = host.chain_keys(hist)
+            if keys:
+                self._pin(tgt, rid, keys)
+                if host.fed is not None:
+                    host.fed.prefetch(keys)
+        self._note_where(rid, tgt)
+        try:
+            out = host.submit(cont)
+        except Exception as e:
+            self._fail_remote_stream(req, f"cluster: continuation "
+                                     f"submit failed: {e}")
+            return
+        self.remote_recovered += 1
+        EVENTS.emit("migrate", rid=rid, src=("host", i),
+                    dst=("host", tgt),
+                    reason=("host_drain" if reason == "drain"
+                            else "host_crash"),
+                    kind="readmit", n_decoded=len(emitted))
+        t = threading.Thread(
+            target=self._bridge_continuation,
+            args=(req, out, len(emitted)),
+            name=f"cluster-bridge-{rid[:8]}", daemon=True)
+        t.start()
+
+    def _bridge_continuation(self, req, out: "queue.Queue", k: int):
+        """Pump continuation events into the ORIGINAL stream, with
+        counters shifted so the client sees one uninterrupted request
+        (completion tokens continue from the crash point; prompt size
+        stays the original prompt, not prompt + delivered)."""
+        plen = len(req.prompt_ids)
+        while True:
+            ev = out.get()
+            if ev is None:
+                req.out.put(None)
+                return
+            if ev.completion_tokens:
+                ev = dataclasses.replace(
+                    ev, completion_tokens=ev.completion_tokens + k,
+                    prompt_tokens=plen)
+            req.out.put(ev)
+
+    def _fail_remote_stream(self, req, msg: str):
+        log.warning("cluster: %s", msg)
+        req.out.put(eng.StreamEvent(token_id=-1, text="", logprob=0.0,
+                                    error=msg, error_kind="stall"))
+        req.out.put(None)
+
+    def drain_host(self, i: int, deadline_s: float = 30.0) -> dict:
+        """Graceful drain (the clean half of the crash path): the host
+        stops admissions, checkpoints active chains, and hands every
+        stream off; continuations re-adopt on siblings through the same
+        byte-gated path a crash uses. The host leaves routing."""
+        h = self.hosts[i]
+        self.drains += 1
+        if h.remote:
+            out = h.drain(deadline_s=deadline_s)
+            with self._lock:
+                self._dead[i] = True
+            self._digests[i] = set()
+            EVENTS.emit("cluster_host_drained", host=i, **{
+                k: v for k, v in out.items() if isinstance(v, int)})
+            return out
+        # in-process: there is no admission surface to refuse through;
+        # stop the loops cooperatively and let the loop-death recovery
+        # path re-adopt the streams (same ResumeEntry machinery)
+        h.kill()
+        deadline = time.monotonic() + 5.0
+        while h.alive and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if not self._dead[i]:
+            self._recover_host(i)
+        return {"streams": 0, "handed_off": 0}
+
     # ---------- housekeeping ----------
 
     def _housekeeping(self):
         while not self._hk_stop.wait(0.05):
             try:
                 for i, h in enumerate(self.hosts):
-                    if not self._dead[i] and not h.alive:
+                    if self._dead[i]:
+                        continue
+                    if h.remote:
+                        # belt-and-braces: the heartbeat thread owns
+                        # DEAD transitions, but a process that exited
+                        # between beats is caught here
+                        if h.state == FailureDetector.DEAD:
+                            self._mark_remote_dead(i)
+                            h.abort_streams("crash")
+                    elif not h.alive:
                         self._recover_host(i)
                 self._drain_disagg()
                 t0 = time.monotonic()
@@ -582,14 +845,21 @@ class ClusterRouter:
                "leaked_pages": 0, "ledger_events": 0,
                "stream_inflight": 0}
         for i in self._alive_hosts():
-            snap = self.hosts[i].pool.kv_audit_sweep(drained=drained)
+            try:
+                snap = self.hosts[i].kv_audit_sweep(drained=drained)
+            except (OSError, WireError):
+                continue            # a dead remote host has no sweep
             if snap.get("mode") != "off":
                 out["mode"] = snap["mode"]
                 for k in ("checks", "violations", "leaked_pages",
                           "ledger_events"):
                     out[k] += snap.get(k, 0)
-        for h in self.hosts:
-            if h.fed is not None:
+            out["stream_inflight"] += snap.get("stream_inflight", 0)
+        for i, h in enumerate(self.hosts):
+            # dead IN-PROCESS hosts still hold a federated tier whose
+            # in-flight fetches count against quiescence (the carcass
+            # keeps serving); a dead remote host has no reachable tier
+            if self._dead[i] and not h.remote and h.fed is not None:
                 out["stream_inflight"] += h.fed.inflight
         if drained:
             if out["stream_inflight"]:
@@ -600,60 +870,97 @@ class ClusterRouter:
 
     # ---------- observability ----------
 
+    def _host_snapshots(self) -> list:
+        snaps = []
+        for i, h in enumerate(self.hosts):
+            if self._dead[i]:
+                snaps.append(None)
+                continue
+            try:
+                snaps.append(h.metrics_snapshot())
+            except (OSError, WireError):
+                snaps.append(None)  # unreachable remote: skip this poll
+        return snaps
+
     def metrics(self) -> dict:
-        ms = [h.pool.metrics() if not self._dead[i] else None
-              for i, h in enumerate(self.hosts)]
-        live = [m for m in ms if m is not None]
+        snaps = self._host_snapshots()
+        live = [s["pool"] for s in snaps if s is not None]
         out = dict(live[0]) if live else {}
-        for k in ("slots_total", "slots_active", "queued",
+        for k in ("slots_total", "slots_active", "queued", "queue_limit",
                   "total_tokens_generated", "prompt_tokens_reused"):
             out[k] = sum(m.get(k) or 0 for m in live)
         stream = {"fetches": 0, "hits": 0, "misses": 0, "pages": 0,
                   "bytes": 0, "pushes": 0, "pushed_pages": 0,
                   "corrupt_rejected": 0, "inflight": 0}
         served = {"serves": 0, "pages_out": 0, "bytes_out": 0}
-        for h in self.hosts:
-            if h.fed is not None:
-                fs = h.fed.stats()
-                for k in stream:
-                    stream[k] += fs.get(k, 0)
-            if h.server is not None:
-                ss = h.server.stats()
-                for k in served:
-                    served[k] += ss.get(k, 0)
+        rpc = {"retries": {}, "timeouts": {}, "reconnects": 0}
+        states, heartbeat = {}, {}
+        for i, h in enumerate(self.hosts):
+            s = snaps[i]
+            if not h.remote:
+                # dead in-process hosts keep their transport counters
+                # (the carcass store served the recovery streams)
+                fs = h.fed.stats() if h.fed is not None else {}
+                ss = h.server.stats() if h.server is not None else {}
+            else:
+                fs = (s or {}).get("kv_stream") or {}
+                ss = (s or {}).get("kv_stream_served") or {}
+            for k in stream:
+                stream[k] += fs.get(k, 0)
+            for k in served:
+                served[k] += ss.get(k, 0)
+            states[str(h.host_id)] = (FailureDetector.DEAD
+                                      if self._dead[i] else h.state)
+            if h.remote:
+                heartbeat[str(h.host_id)] = h.heartbeat_telemetry()
+                hs = h.rpc_stats()
+                for k in ("retries", "timeouts"):
+                    for op, n in hs[k].items():
+                        rpc[k][op] = rpc[k].get(op, 0) + n
+                rpc["reconnects"] += hs["reconnects"]
         out["kv_stream"] = stream
         out["kv_stream_served"] = served
         out["cluster"] = {
             "hosts": len(self.hosts),
             "hosts_alive": len(self._alive_hosts()),
             "hosts_recovered": self.hosts_recovered,
+            "remote_recovered": self.remote_recovered,
+            "drains": self.drains,
             "routed": self._routed,
             "affinity_hits": self.affinity_hits,
             "affinity_misses": self.affinity_misses,
             "disagg_handoffs": self.disagg_handoffs
                                + sum(e.disagg_handoffs
-                                     for h in self.hosts
+                                     for h in self.hosts if not h.remote
                                      for e in h.pool._engines),
             "roles": {str(h.host_id): h.role for h in self.hosts},
+            "host_states": states,
+            "rpc": rpc,
+            "heartbeat": heartbeat,
         }
         out["hosts"] = [{
             "host": h.host_id,
             "role": h.role,
             "alive": not self._dead[i],
+            "remote": bool(h.remote),
+            "state": states[str(h.host_id)],
             "address": h.address,
-            "kv_stream": (h.fed.stats() if h.fed is not None else {}),
+            "kv_stream": (h.fed.stats()
+                          if not h.remote and h.fed is not None
+                          else ((snaps[i] or {}).get("kv_stream") or {})),
         } for i, h in enumerate(self.hosts)]
         return out
 
     def kv_debug(self) -> dict:
+        snaps = self._host_snapshots()
         return {
             "cluster_hosts": len(self.hosts),
             "hosts": [{
                 "host": h.host_id, "role": h.role,
                 "alive": not self._dead[i], "address": h.address,
-                **h.pool.kv_debug(),
-                "kv_stream": (h.fed.stats() if h.fed is not None else {}),
-                "kv_serve": (h.server.stats()
-                             if h.server is not None else {}),
+                **((snaps[i] or {}).get("kv_debug") or {}),
+                "kv_stream": ((snaps[i] or {}).get("kv_stream") or {}),
+                "kv_serve": ((snaps[i] or {}).get("kv_stream_served")
+                             or {}),
             } for i, h in enumerate(self.hosts)],
         }

@@ -369,6 +369,34 @@ class EngineConfig:
     # next gallery model's parsed leaves into a host cache ahead of its
     # first request.
     weight_prefetch: bool = False
+    # --- cluster control plane (ISSUE 20) ---
+    # "inproc" (default) = every cluster host is an in-process handle:
+    # no RPC server, no heartbeats — bit-for-bit the PR-17 path.
+    # "process" = hosts run as separate OS processes behind
+    # services/cluster_rpc.py, driven through RemoteHostHandle.
+    cluster_mode: str = "inproc"
+    # heartbeat probe cadence, and the failure-detector windows: a host
+    # with no successful beat (or only slow beats) for suspect_ms is
+    # SUSPECT (de-preferred in routing, no new KV-streaming work, its
+    # streams stay alive); silent past dead_ms it is DEAD (byte-gated
+    # stream recovery on siblings). suspect < dead, always.
+    cluster_heartbeat_ms: int = 250
+    cluster_suspect_ms: int = 1000
+    cluster_dead_ms: int = 3000
+    # control-plane per-op deadline + full-jitter retry schedule
+    # (idempotent ops only: DIGEST/METRICS/HEARTBEAT/AUDIT; SUBMIT is
+    # never auto-retried — recovery re-admits instead)
+    cluster_rpc_timeout_ms: int = 2000
+    cluster_rpc_retries: int = 3
+    cluster_rpc_backoff_ms: int = 50
+    # --- federated KV stream timing (ISSUE 20, was hardcoded) ---
+    # a failed peer sits out cooldown_ms before being re-tried; negative
+    # membership probes cache for negcache_ms; connect/IO timeout for
+    # peer stream sockets. Tune together with the detector windows so
+    # the KV tier and the control plane agree on peer health.
+    kv_stream_cooldown_ms: int = 5000
+    kv_stream_negcache_ms: int = 500
+    kv_stream_connect_timeout_ms: int = 5000
 
 
 @dataclasses.dataclass
@@ -678,6 +706,10 @@ class Engine:
     ):
         self.cfg = model_cfg
         self.ecfg = engine_cfg or EngineConfig()
+        # effective admission limit (ISSUE 20): identical to the
+        # configured knob for a standalone engine; EnginePool.resize()
+        # rescales it proportionally with live replica width
+        self.maxq_effective = self.ecfg.max_queued_requests
         # replica-pool membership (ISSUE 14): standalone engines are
         # replica 0 of a pool of one and OWN their host tier (shutdown
         # persists it); pool members share ONE HostPageStore the pool
@@ -3210,7 +3242,11 @@ class Engine:
         # admission control (ISSUE 7): shed at the door instead of queuing
         # unboundedly — the caller gets a structured "shed" event on the
         # normal output queue within microseconds, not a growing sojourn.
-        maxq = self.ecfg.max_queued_requests
+        # maxq_effective tracks the configured limit until the pool
+        # rescales it with replica width (ISSUE 20): a scaled-in pool
+        # sheds at the narrower width's limit instead of promising the
+        # full fleet's queue depth.
+        maxq = self.maxq_effective
         if maxq > 0 and self._queue.qsize() >= maxq:
             # queue-wait-aware shed fairness (ISSUE 10, closes the PR-7
             # follow-up): a full queue sheds the longest-queued request
@@ -3412,10 +3448,14 @@ class Engine:
         with self._lc_lock:
             lc = dict(self._lc)
         lc["max_queued_requests"] = self.ecfg.max_queued_requests
+        lc["queue_limit_effective"] = self.maxq_effective
         lc["max_queue_wait_ms"] = self.ecfg.max_queue_wait_ms
         lc["request_timeout_ms"] = self.ecfg.request_timeout_ms
         lc["dispatch_stall_ms"] = self.ecfg.dispatch_stall_ms
         out["lifecycle"] = lc
+        # effective admission limit -> localai_engine_queue_limit (the
+        # pool overrides this with the co-scaled routable sum)
+        out["queue_limit"] = self.maxq_effective
         # event-driven emission (ISSUE 9)
         if self._emitter is not None:
             out["emitter"] = {"enabled": True,

@@ -33,6 +33,7 @@ import logging
 import socket
 import threading
 import time
+from typing import Optional
 
 from localai_tpu.services.kv_wire import (OP_DIGEST, OP_ERR, OP_FETCH,
                                           OP_HAS, OP_HELLO, OP_OK, OP_PUSH,
@@ -43,10 +44,14 @@ from localai_tpu.services.kv_wire import (OP_DIGEST, OP_ERR, OP_FETCH,
 
 log = logging.getLogger(__name__)
 
-# a failed peer sits out this long before being retried
+# a failed peer sits out this long before being retried (default for
+# the kv_stream_cooldown_ms knob — tune it together with the ISSUE-20
+# failure-detector windows so the KV tier and the control plane agree
+# on how long a flaky peer sits out)
 PEER_COOLDOWN_S = 5.0
 # negative membership answers are cached this long (admission probes of
-# a cold chain must not ask the same peer the same question per page)
+# a cold chain must not ask the same peer the same question per page);
+# default for the kv_stream_negcache_ms knob
 NEG_TTL_S = 0.5
 
 
@@ -59,20 +64,23 @@ class KVStreamClient:
     the next request after any failure."""
 
     def __init__(self, address: str, scope: bytes, page_size: int,
-                 timeout_s: float = 5.0):
+                 timeout_s: float = 5.0,
+                 cooldown_s: float = PEER_COOLDOWN_S):
         host, _, port = address.rpartition(":")
         self.address = address
         self._addr = (host or "127.0.0.1", int(port))
         self.scope = scope
         self.page_size = int(page_size)
         self.timeout_s = float(timeout_s)
+        self.cooldown_s = float(cooldown_s)
         self._lock = threading.Lock()
         self._sock = None
         self.failed_at = 0.0
         self.peer_host = -1
 
-    def online(self, cooldown_s: float = PEER_COOLDOWN_S) -> bool:
-        return (time.monotonic() - self.failed_at) > cooldown_s
+    def online(self, cooldown_s: Optional[float] = None) -> bool:
+        cd = self.cooldown_s if cooldown_s is None else cooldown_s
+        return (time.monotonic() - self.failed_at) > cd
 
     # ---- transport ----
 
@@ -163,9 +171,11 @@ class FederatedKV:
     outstanding fetch/push round-trips and must read zero once the
     cluster is quiesced (ClusterRouter.kv_audit_sweep enforces it)."""
 
-    def __init__(self, store, peers: list):
+    def __init__(self, store, peers: list,
+                 neg_ttl_s: float = NEG_TTL_S):
         self.store = store
         self.peers = list(peers)
+        self.neg_ttl_s = float(neg_ttl_s)
         self._lock = threading.Lock()
         self._neg: dict = {}         # key -> monotonic stamp of last miss
         self.inflight = 0
@@ -198,12 +208,13 @@ class FederatedKV:
 
     def peer_has(self, key: bytes) -> bool:
         """Does ANY online peer hold this chain key? Negative answers
-        are cached for NEG_TTL_S; positives are not cached at all — the
-        follow-up get() lands the entry locally, which IS the cache."""
+        are cached for ``neg_ttl_s``; positives are not cached at all —
+        the follow-up get() lands the entry locally, which IS the
+        cache."""
         now = time.monotonic()
         with self._lock:
             t = self._neg.get(key)
-            if t is not None and now - t < NEG_TTL_S:
+            if t is not None and now - t < self.neg_ttl_s:
                 return False
             self.has_queries += 1
         for p in self.peers:

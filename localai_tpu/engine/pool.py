@@ -183,6 +183,14 @@ class EnginePool:
         self._resize_thread: Optional[threading.Thread] = None
         self.target_replicas = len(engines)
         self._policy = None           # autoscale.AutoscalePolicy | None
+        # admission-limit co-scaling (ISSUE 20): max_queued_requests is
+        # a PER-REPLICA knob, so the pool's effective admission budget
+        # is width-proportional — resize() rescales each live replica's
+        # maxq_effective against the CONFIGURED width, so a scaled-in
+        # pool sheds at the narrower width's limit instead of promising
+        # the full fleet's queue depth
+        self._configured_width = len(engines)
+        self._maxq_base = self._engines[0].ecfg.max_queued_requests
 
     # ---------- construction ----------
 
@@ -611,7 +619,6 @@ class EnginePool:
         from localai_tpu.services.sysobs import AutoscaleSignals
 
         engines = [self._engines[i] for i in self._routable_idx()]
-        n = max(1, len(engines))
         queued = sum(e._queue.qsize() for e in engines)
         slots = sum(len(e.slots) for e in engines)
         active = sum(e.num_active for e in engines)
@@ -625,10 +632,13 @@ class EnginePool:
                 free = min(free, e._pool.free_pages
                            / max(1, e._pool.num_pages))
             pre += getattr(e, "_preempt_rate_ewma", 0.0)
-        mq = self._engines[0].ecfg.max_queued_requests
+        # effective (co-scaled) admission budget, not the static knob:
+        # a scaled-in pool's queue reads proportionally fuller, so the
+        # scale-out trigger fires at the same relative pressure
+        cap = sum(e.maxq_effective for e in engines)
         return AutoscaleSignals(
             replicas=len(engines), queued=queued,
-            queue_frac=(queued / (mq * n)) if mq > 0 else 0.0,
+            queue_frac=(queued / cap) if cap > 0 else 0.0,
             busy_frac=(active / slots) if slots else 0.0,
             burn_5m=burn, free_page_frac=free,
             preempt_rate_per_min=pre)
@@ -685,7 +695,26 @@ class EnginePool:
                     # re-anchor the preemption-EWMA reserve to the new
                     # replica count (ISSUE 19 satellite)
                     self._engines[i].note_pool_resize(n0, got)
+                self._rescale_admission(got)
             return got
+
+    def _rescale_admission(self, width: int):
+        """Admission-limit co-scaling (ISSUE 20): each live replica's
+        effective max_queued_requests scales with live width over
+        CONFIGURED width, so a scaled-in pool sheds at the narrower
+        width's limit (half the replicas -> half the queue promise per
+        survivor) instead of buffering the full fleet's depth behind
+        fewer engines. At the configured width this is exactly the
+        configured knob — bit-for-bit the static-pool behavior."""
+        if self._maxq_base <= 0:
+            return                  # unbounded stays unbounded
+        eff = max(1, round(self._maxq_base * width
+                           / max(1, self._configured_width)))
+        for i in self._routable_idx():
+            self._engines[i].maxq_effective = eff
+        EVENTS.emit("queue_limit_rescaled", width=width,
+                    configured=self._configured_width,
+                    per_replica=eff, pool=eff * max(1, width))
 
     def _scale_out(self, reason: str):
         if self._build_args is None:
@@ -827,6 +856,10 @@ class EnginePool:
         out["uptime_s"] = max(m.get("uptime_s", 0) for m in ms)
         out["engine_replicas"] = len(self._engines)
         out["engine_replicas_target"] = self.target_replicas
+        # effective (co-scaled) pool admission budget ->
+        # localai_engine_queue_limit (ISSUE 20)
+        out["queue_limit"] = sum(self._engines[i].maxq_effective
+                                 for i in self._routable_idx())
         out["replicas"] = [{
             "replica": i,
             "alive": not self._dead[i],

@@ -1,0 +1,1218 @@
+"""Cluster control plane: real-process hosts behind a framed RPC surface.
+
+ISSUE 20. PR 17 made KV location-independent across hosts, but every
+"host" was an in-process ``ClusterHost`` handle — the only failure mode
+the cluster could exercise was a cooperative ``kill()``. This module
+gives each host a CONTROL PLANE so it can run as its own OS process
+(spawned via ``scripts/cluster_host.py``) and fail the way real hosts
+fail: crash (kill -9), hang (alive but unresponsive), and run slow
+(answering, late). The KV data plane (services/kv_wire.py) is untouched
+— it was already process-agnostic; this is the half the router needed.
+
+Same framing discipline as the KV wire (length-prefixed frames, a
+versioned HELLO that pins protocol version + store scope + page size,
+refusal on any mismatch), with typed control ops::
+
+    SUBMIT     start a generation; the server owns a seq-numbered
+               event buffer for the request
+    EVENTS     long-poll the buffer from the last ACKED sequence
+               number — after a severed connection the client simply
+               reconnects and re-polls from its ack, so a mid-stream
+               RPC disconnect costs latency, never tokens
+    CANCEL     cancel by request id
+    DIGEST     chain-key routing digest (same payload the KV wire
+               serves; proxied here so the router needs ONE plane)
+    METRICS    pool metrics + transport stats snapshot
+    AUDIT      cluster-wide KV invariant sweep (ISSUE 15)
+    HEARTBEAT  liveness + load + RTT sample for the failure detector
+    DRAIN      graceful drain: stop admissions, checkpoint active
+               chains, hand streams off with a ``handoff`` marker
+    PEERS      attach the host's federated KV tier to peer addresses
+    FAULT      arm a chaos fault in the host process (test rigs only)
+
+Robustness is the point, not the transport:
+
+* Every op carries a DEADLINE (socket timeout = remaining budget).
+* Failed IDEMPOTENT ops (DIGEST / METRICS / HEARTBEAT / AUDIT) retry
+  with full-jitter exponential backoff (``RetryPolicy``). SUBMIT is
+  NEVER auto-retried — a retried submit could double-admit; the router
+  re-adopts through the recovery path instead (resume ≡ fresh
+  re-admission of prompt + delivered tokens, the PR-10 contract).
+  EVENTS is its own retry loop by construction (resume-from-ack).
+* A phi-accrual-style failure detector distinguishes SLOW from DEAD:
+  heartbeats that succeed but arrive late (or a suspicion value past
+  the phi threshold) move a host to SUSPECT — the router de-prefers it
+  and stops placing KV-streaming work on it but keeps its streams
+  alive; only ``cluster_dead_ms`` without ANY successful beat (or the
+  process exiting) declares DEAD and triggers the byte-gated recovery.
+
+Chaos hooks (services/faults.py): ``cluster_rpc_delay_ms`` stalls the
+server before each frame (a slow peer — must reach SUSPECT, never
+DEAD), ``cluster_rpc_drop`` severs one control connection mid-request
+(the event stream must resume from the last acked seq), and
+``cluster{N}_hang`` makes host N swallow heartbeats while the process
+lives (must be declared DEAD and recovered byte-identically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import queue
+import random
+import socket
+import socketserver
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.kv_wire import (WireError, _jdump, _jload,
+                                          recv_frame, send_frame)
+
+log = logging.getLogger(__name__)
+
+RPC_VERSION = 1
+
+# control ops (disjoint numbering from kv_wire on purpose: a client
+# that dials the wrong port gets a typed refusal, not silent nonsense)
+OP_HELLO = 32
+OP_OK = 33
+OP_ERR = 34
+OP_SUBMIT = 35
+OP_CANCEL = 36
+OP_EVENTS = 37
+OP_DIGEST = 38
+OP_METRICS = 39
+OP_AUDIT = 40
+OP_HEARTBEAT = 41
+OP_DRAIN = 42
+OP_PEERS = 43
+OP_FAULT = 44
+
+OP_NAMES = {OP_HELLO: "hello", OP_SUBMIT: "submit", OP_CANCEL: "cancel",
+            OP_EVENTS: "events", OP_DIGEST: "digest",
+            OP_METRICS: "metrics", OP_AUDIT: "audit",
+            OP_HEARTBEAT: "heartbeat", OP_DRAIN: "drain",
+            OP_PEERS: "peers", OP_FAULT: "fault"}
+
+# the retry matrix: ONLY read-only, side-effect-free ops may auto-retry
+# on a transport failure. SUBMIT must never be retried (double-admit);
+# CANCEL/DRAIN/PEERS/FAULT are issued once and re-driven by their
+# caller; EVENTS is a resume-from-ack loop — its retry is explicit.
+RETRYABLE_OPS = frozenset({OP_DIGEST, OP_METRICS, OP_HEARTBEAT, OP_AUDIT})
+
+# server-side event buffer bound: a client that stops acking cannot
+# pin unbounded history (the stream is failed instead)
+MAX_BUFFERED_EVENTS = 16384
+
+
+# --------------- retry policy ---------------
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Full-jitter exponential backoff (AWS-style): attempt ``a`` sleeps
+    ``uniform(0, min(cap, base * 2**a))``. Deterministic under an
+    injected ``rng``; the schedule is pure so tests assert it."""
+
+    base_ms: float = 50.0
+    cap_ms: float = 2000.0
+    attempts: int = 4          # total tries (1 first call + retries)
+
+    def backoff_s(self, attempt: int, rng: Callable[[], float]) -> float:
+        """Sleep before retry number ``attempt`` (0-based)."""
+        span = min(self.cap_ms, self.base_ms * (2 ** attempt))
+        return rng() * span / 1e3
+
+
+# --------------- failure detector ---------------
+
+
+class FailureDetector:
+    """Phi-accrual-style heartbeat failure detector with hard bounds.
+
+    ALIVE -> SUSPECT when the suspicion level phi crosses
+    ``phi_suspect``, when no successful beat lands within
+    ``suspect_ms``, or when the beats that DO land are slower than
+    ``suspect_ms`` (RTT EWMA) — the slow-peer rung: answering late is
+    degraded, not dead. SUSPECT is recoverable; a healthy beat returns
+    the host to ALIVE.
+
+    SUSPECT -> DEAD only after ``dead_ms`` without ANY successful beat
+    (or an explicit ``declare_dead()`` — e.g. the process exited).
+    DEAD is sticky: recovery is byte-gated and fires exactly once.
+
+    phi uses the exponential inter-arrival model of the phi-accrual
+    paper: ``phi = log10(e) * elapsed / mean_interval`` — suspicion
+    grows continuously with silence, scaled by the OBSERVED cadence, so
+    a detector configured for a slow heartbeat period does not cry wolf.
+    """
+
+    ALIVE = "alive"
+    SUSPECT = "suspect"
+    DEAD = "dead"
+
+    def __init__(self, suspect_ms: float = 1000.0, dead_ms: float = 3000.0,
+                 phi_suspect: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.suspect_ms = float(suspect_ms)
+        self.dead_ms = float(dead_ms)
+        self.phi_suspect = float(phi_suspect)
+        self._clock = clock
+        self._lock = threading.Lock()
+        now = clock()
+        self._last_ok = now
+        self._mean_interval_s = 0.0    # EWMA of inter-beat gaps
+        self._rtt_ewma_ms = 0.0
+        self._beats = 0
+        self._failures = 0
+        self._dead = False
+
+    # ---- inputs ----
+
+    def beat(self, rtt_ms: float) -> None:
+        """A successful heartbeat round-trip."""
+        with self._lock:
+            now = self._clock()
+            gap = now - self._last_ok
+            self._last_ok = now
+            a = 0.2
+            if self._beats:
+                self._mean_interval_s = ((1 - a) * self._mean_interval_s
+                                         + a * gap)
+            self._rtt_ewma_ms = (rtt_ms if not self._beats
+                                 else (1 - a) * self._rtt_ewma_ms
+                                 + a * float(rtt_ms))
+            self._beats += 1
+
+    def failure(self) -> None:
+        """A failed/timed-out probe (telemetry; the timers decide)."""
+        with self._lock:
+            self._failures += 1
+
+    def declare_dead(self) -> None:
+        """External hard evidence (process exited)."""
+        with self._lock:
+            self._dead = True
+
+    # ---- outputs ----
+
+    def phi(self) -> float:
+        with self._lock:
+            elapsed = self._clock() - self._last_ok
+            mean = self._mean_interval_s
+        if mean <= 0:
+            return 0.0
+        return 0.4342944819 * elapsed / mean      # log10(e) * t / mean
+
+    def state(self) -> str:
+        with self._lock:
+            if self._dead:
+                return self.DEAD
+            elapsed_ms = (self._clock() - self._last_ok) * 1e3
+            slow = self._beats > 0 and self._rtt_ewma_ms > self.suspect_ms
+        if elapsed_ms >= self.dead_ms:
+            with self._lock:
+                self._dead = True
+            return self.DEAD
+        if (elapsed_ms >= self.suspect_ms or slow
+                or self.phi() >= self.phi_suspect):
+            return self.SUSPECT
+        return self.ALIVE
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"beats": self._beats, "failures": self._failures,
+                    "rtt_ewma_ms": round(self._rtt_ewma_ms, 3),
+                    "mean_interval_ms":
+                        round(self._mean_interval_s * 1e3, 3),
+                    "dead": self._dead}
+
+
+# --------------- request / event (de)serialization ---------------
+
+
+def req_to_dict(req) -> dict:
+    """GenRequest -> JSON-safe dict. The control plane carries the text
+    serving surface (prompt ids, sampling, stops, priority); multimodal
+    vectors and prompt-cache paths stay host-local concerns."""
+    p = dataclasses.asdict(req.params)
+    p["logit_bias"] = {str(k): float(v)
+                       for k, v in (p.get("logit_bias") or {}).items()}
+    return {"prompt_ids": [int(t) for t in req.prompt_ids],
+            "max_new_tokens": int(req.max_new_tokens),
+            "stop_sequences": list(req.stop_sequences or []),
+            "ignore_eos": bool(req.ignore_eos),
+            "grammar": req.grammar or "",
+            "priority": req.priority or "",
+            "request_id": req.request_id,
+            "params": p}
+
+
+def req_from_dict(d: dict):
+    from localai_tpu.engine import engine as eng
+    from localai_tpu.engine import sampling
+
+    p = dict(d.get("params") or {})
+    p["logit_bias"] = {int(k): float(v)
+                       for k, v in (p.pop("logit_bias", None) or {}).items()}
+    return eng.GenRequest(
+        prompt_ids=[int(t) for t in d["prompt_ids"]],
+        params=sampling.SamplingParamsHost(**p),
+        max_new_tokens=int(d.get("max_new_tokens", 256)),
+        stop_sequences=list(d.get("stop_sequences") or []),
+        ignore_eos=bool(d.get("ignore_eos", False)),
+        grammar=d.get("grammar", ""),
+        priority=d.get("priority", ""),
+        request_id=d.get("request_id", ""))
+
+
+def event_to_dict(ev) -> dict:
+    d = {"t": int(ev.token_id), "x": ev.text, "lp": float(ev.logprob)}
+    if ev.finish_reason is not None:
+        d["fin"] = ev.finish_reason
+    if ev.prompt_tokens:
+        d["pt"] = int(ev.prompt_tokens)
+    if ev.completion_tokens:
+        d["ct"] = int(ev.completion_tokens)
+    if ev.error is not None:
+        d["err"] = str(ev.error)
+    if ev.error_kind is not None:
+        d["ek"] = str(ev.error_kind)
+    if ev.retry_after_s:
+        d["ra"] = float(ev.retry_after_s)
+    if ev.token_ids:
+        d["ts"] = [int(t) for t in ev.token_ids]
+    if ev.logprobs:
+        d["lps"] = [float(v) for v in ev.logprobs]
+    return d
+
+
+def event_from_dict(d: dict):
+    from localai_tpu.engine import engine as eng
+
+    return eng.StreamEvent(
+        token_id=int(d.get("t", -1)), text=d.get("x", ""),
+        logprob=float(d.get("lp", 0.0)), finish_reason=d.get("fin"),
+        prompt_tokens=int(d.get("pt", 0)),
+        completion_tokens=int(d.get("ct", 0)),
+        error=d.get("err"), error_kind=d.get("ek"),
+        retry_after_s=float(d.get("ra", 0.0)),
+        token_ids=d.get("ts"), logprobs=d.get("lps"))
+
+
+# --------------- client ---------------
+
+
+class RpcClient:
+    """One framed, reconnecting control connection with per-op
+    deadlines and the idempotent-only retry matrix.
+
+    Deadlines: each call computes an absolute budget; the socket
+    timeout is re-armed to the REMAINING budget before every blocking
+    step, so a slow server cannot stretch one op past its deadline.
+    Retries: only ``RETRYABLE_OPS`` re-dial after a transport failure,
+    sleeping a full-jitter backoff between attempts; a server-reported
+    OP_ERR never retries (the server answered — retrying cannot help).
+    Clock/sleep/rng are injectable so the schedule is unit-testable."""
+
+    def __init__(self, address: str, scope: Optional[bytes] = None,
+                 timeout_s: float = 2.0, connect_timeout_s: float = 2.0,
+                 retry: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] = random.random):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self._addr = (host or "127.0.0.1", int(port))
+        self.scope = scope              # None = adopt the server's
+        self.timeout_s = float(timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.retry = retry or RetryPolicy()
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng
+        self._lock = threading.Lock()
+        self._sock = None
+        self.hello: dict = {}
+        self._stats_lock = threading.Lock()
+        self.retries: dict = {}         # op name -> count
+        self.timeouts: dict = {}        # op name -> count
+        self.reconnects = 0
+
+    # ---- transport ----
+
+    def _connect_locked(self, deadline: float):
+        budget = max(0.05, deadline - self._clock())
+        s = socket.create_connection(
+            self._addr, timeout=min(self.connect_timeout_s, budget))
+        try:
+            s.settimeout(max(0.05, deadline - self._clock()))
+            hello = {"version": RPC_VERSION}
+            if self.scope is not None:
+                hello["scope"] = self.scope.hex()
+            send_frame(s, OP_HELLO, _jdump(hello))
+            op, payload = recv_frame(s)
+            info = _jload(payload)
+            if op != OP_OK:
+                raise WireError(f"HELLO refused: {info}")
+            if self.scope is None and info.get("scope"):
+                self.scope = bytes.fromhex(info["scope"])
+            self.hello = info
+        except Exception:
+            s.close()
+            raise
+        self._sock = s
+        with self._stats_lock:
+            self.reconnects += 1
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._lock:
+            self._close_locked()
+
+    def _roundtrip(self, op: int, payload: bytes, deadline: float) -> dict:
+        """One send/recv on the (re)connected socket. Raises
+        OSError/WireError on transport failure; WireError (non-retried)
+        on a server OP_ERR."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect_locked(deadline)
+                self._sock.settimeout(max(0.05, deadline - self._clock()))
+                send_frame(self._sock, op, payload)
+                rop, rpayload = recv_frame(self._sock)
+            except (OSError, WireError):
+                self._close_locked()
+                raise
+        body = _jload(rpayload)
+        if rop == OP_ERR:
+            raise RpcRefused(str(body.get("error", "?")), body)
+        return body
+
+    def call(self, op: int, obj: Optional[dict] = None,
+             deadline_s: Optional[float] = None) -> dict:
+        """One RPC with deadline + (idempotent-only) retry."""
+        payload = _jdump(obj or {})
+        budget = self.timeout_s if deadline_s is None else float(deadline_s)
+        name = OP_NAMES.get(op, str(op))
+        attempts = self.retry.attempts if op in RETRYABLE_OPS else 1
+        last = None
+        for attempt in range(attempts):
+            deadline = self._clock() + budget
+            try:
+                return self._roundtrip(op, payload, deadline)
+            except RpcRefused:
+                raise               # the server answered: never retry
+            except (OSError, WireError) as e:
+                last = e
+                if isinstance(e, socket.timeout):
+                    with self._stats_lock:
+                        self.timeouts[name] = self.timeouts.get(name, 0) + 1
+                if attempt + 1 >= attempts:
+                    break
+                with self._stats_lock:
+                    self.retries[name] = self.retries.get(name, 0) + 1
+                self._sleep(self.retry.backoff_s(attempt, self._rng))
+        raise last
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {"retries": dict(self.retries),
+                    "timeouts": dict(self.timeouts),
+                    "reconnects": self.reconnects}
+
+    # ---- convenience ops ----
+
+    def submit(self, reqdict: dict, deadline_s: float = 10.0) -> dict:
+        return self.call(OP_SUBMIT, {"req": reqdict}, deadline_s)
+
+    def events(self, rid: str, ack: int, wait_ms: int = 250,
+               deadline_s: Optional[float] = None) -> dict:
+        if deadline_s is None:
+            deadline_s = self.timeout_s + wait_ms / 1e3
+        return self.call(OP_EVENTS, {"rid": rid, "ack": int(ack),
+                                     "wait_ms": int(wait_ms)}, deadline_s)
+
+    def cancel(self, rid: str) -> dict:
+        return self.call(OP_CANCEL, {"rid": rid})
+
+    def digest(self) -> dict:
+        return self.call(OP_DIGEST)
+
+    def metrics(self) -> dict:
+        return self.call(OP_METRICS)
+
+    def audit(self, drained: bool = False) -> dict:
+        return self.call(OP_AUDIT, {"drained": bool(drained)})
+
+    def heartbeat(self, deadline_s: Optional[float] = None) -> dict:
+        return self.call(OP_HEARTBEAT, {"t": self._clock()}, deadline_s)
+
+    def drain(self, deadline_s: float = 30.0) -> dict:
+        return self.call(OP_DRAIN, {"exit": True}, deadline_s)
+
+    def peers(self, addrs: list) -> dict:
+        return self.call(OP_PEERS, {"addrs": list(addrs)})
+
+    def fault(self, spec: str) -> dict:
+        return self.call(OP_FAULT, {"spec": spec})
+
+
+class RpcRefused(WireError):
+    """The server answered with a typed error (NOT a transport failure
+    — never retried)."""
+
+    def __init__(self, msg: str, body: Optional[dict] = None):
+        super().__init__(msg)
+        self.body = body or {}
+
+
+# --------------- server ---------------
+
+
+class _Stream:
+    """Server-side seq-numbered event buffer for one request. Events
+    are retained until the client ACKS them, so a reconnecting client
+    resumes exactly where it left off — mid-stream delivery survives a
+    severed control connection."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.cond = threading.Condition()
+        self.buf: list = []            # [(seq, dict)]
+        self.seq = 0
+        self.acked = 0
+        self.done = False
+        self.handoff = False
+        self.failed = ""
+
+    def append(self, evdict: dict):
+        with self.cond:
+            self.seq += 1
+            self.buf.append((self.seq, evdict))
+            if len(self.buf) > MAX_BUFFERED_EVENTS:
+                self.failed = "event buffer overflow (client not acking)"
+            self.cond.notify_all()
+
+    def finish(self):
+        with self.cond:
+            self.done = True
+            self.cond.notify_all()
+
+    def poll(self, ack: int, wait_s: float) -> dict:
+        with self.cond:
+            self.acked = max(self.acked, int(ack))
+            self.buf = [(s, d) for s, d in self.buf if s > self.acked]
+            if not self.buf and not self.done and not self.failed:
+                self.cond.wait(wait_s)
+            evs = [dict(d, seq=s) for s, d in self.buf if s > ack]
+            out = {"events": evs, "last": self.seq, "eof": self.done,
+                   "handoff": self.handoff}
+            if self.failed:
+                out["failed"] = self.failed
+            return out
+
+    def drained(self, ack_grace_s: float) -> bool:
+        """True once every event was delivered AND acked."""
+        deadline = time.monotonic() + ack_grace_s
+        while time.monotonic() < deadline:
+            with self.cond:
+                if self.done and self.acked >= self.seq:
+                    return True
+            time.sleep(0.02)
+        return False
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: ClusterHostServer = self.server.rpc  # type: ignore[attr-defined]
+        hello = False
+        try:
+            while True:
+                op, payload = recv_frame(self.request)
+                if FAULTS.active:
+                    v = FAULTS.take("cluster_rpc_delay_ms")
+                    if v is not None:
+                        # chaos: a slow peer — every frame stalls, but
+                        # every frame is ANSWERED (SUSPECT, never DEAD)
+                        time.sleep(int(v) / 1e3)
+                    if FAULTS.take("cluster_rpc_drop") is not None:
+                        # chaos: sever the control connection with no
+                        # reply — the event stream must resume from the
+                        # last acked seq on the client's reconnect
+                        try:
+                            self.request.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        return
+                if op == OP_HELLO:
+                    hello = srv._handle_hello(self.request, payload)
+                    continue
+                if not hello:
+                    send_frame(self.request, OP_ERR,
+                               _jdump({"error": "HELLO required first"}))
+                    return
+                if not srv._dispatch(self.request, op, payload):
+                    return
+        except (WireError, OSError):
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ClusterHostServer:
+    """The control-plane server for ONE cluster host: wraps a
+    ``ClusterHost`` (EnginePool + KV wire server) and serves the typed
+    ops above. Runs wherever the host runs — its own process under
+    ``scripts/cluster_host.py`` (cluster_mode=process) or in-process in
+    unit tests (the protocol doesn't care)."""
+
+    def __init__(self, host, bind: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self._bind = (bind, int(port))
+        self.address = ""
+        self._srv = None
+        self._thread = None
+        self._lock = threading.Lock()
+        self._streams: dict = {}
+        self.draining = False
+        self.exit_event = threading.Event()
+        self._hb_seq = 0
+        self.submits = 0
+        self.drains = 0
+
+    # ---- lifecycle ----
+
+    def start(self) -> str:
+        self._srv = _Server(self._bind, _RpcHandler)
+        self._srv.rpc = self        # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="cluster-rpc", daemon=True)
+        self._thread.start()
+        h, p = self._srv.server_address[:2]
+        self.address = f"{h}:{p}"
+        log.info("cluster rpc server host=%d (%s) listening on %s",
+                 self.host.host_id, self.host.role, self.address)
+        return self.address
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+    # ---- HELLO ----
+
+    def _scopes(self) -> tuple:
+        store = self.host.pool._shared.store
+        pc = self.host.pool._engines[0]._pcache
+        return store, pc
+
+    def _handle_hello(self, sock, payload) -> bool:
+        req = _jload(payload)
+        store, pc = self._scopes()
+        if int(req.get("version", -1)) != RPC_VERSION:
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": f"rpc version {req.get('version')} != "
+                          f"{RPC_VERSION}", "version": RPC_VERSION}))
+            return False
+        if req.get("scope") is not None \
+                and req["scope"] != store.scope.hex():
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": "scope mismatch (different model or layout)",
+                 "scope": store.scope.hex()}))
+            return False
+        send_frame(sock, OP_OK, _jdump(
+            {"version": RPC_VERSION, "host": self.host.host_id,
+             "role": self.host.role, "pid": os.getpid(),
+             "scope": store.scope.hex(),
+             "chain_scope": pc.scope.hex() if pc is not None else "",
+             "page_size": store.page_size,
+             "kv": self.host.address}))
+        return True
+
+    # ---- dispatch ----
+
+    def _dispatch(self, sock, op: int, payload: bytes) -> bool:
+        if op == OP_HEARTBEAT:
+            if FAULTS.active and FAULTS.value(
+                    f"cluster{self.host.host_id}_hang") is not None:
+                # chaos: the host process LIVES but stops answering
+                # heartbeats — the detector must walk SUSPECT -> DEAD
+                # and the router must recover byte-identically
+                return True
+            return self._reply(sock, self._heartbeat(_jload(payload)))
+        if op == OP_SUBMIT:
+            return self._handle_submit(sock, _jload(payload))
+        if op == OP_EVENTS:
+            return self._handle_events(sock, _jload(payload))
+        if op == OP_CANCEL:
+            rid = _jload(payload).get("rid", "")
+            self.host.cancel(rid)
+            return self._reply(sock, {"cancelled": rid})
+        if op == OP_DIGEST:
+            d = (self.host.server.digest()
+                 if self.host.server is not None else {"keys": []})
+            return self._reply(sock, d)
+        if op == OP_METRICS:
+            return self._reply(sock, self.host.metrics_snapshot())
+        if op == OP_AUDIT:
+            drained = bool(_jload(payload).get("drained"))
+            return self._reply(sock,
+                               self.host.kv_audit_sweep(drained=drained))
+        if op == OP_DRAIN:
+            want_exit = bool(_jload(payload).get("exit", True))
+            t = threading.Thread(target=self.drain,
+                                 kwargs={"exit_after": want_exit},
+                                 name="cluster-drain", daemon=True)
+            t.start()
+            return self._reply(sock, {"draining": True})
+        if op == OP_PEERS:
+            addrs = _jload(payload).get("addrs") or []
+            self.host.connect_peers(addrs)
+            return self._reply(sock, {"peers": len(addrs)})
+        if op == OP_FAULT:
+            # chaos control seam for test rigs: arm the HOST process's
+            # fault table remotely (bench drives slow/hang phases here)
+            spec = _jload(payload).get("spec", "")
+            if spec == "reset":
+                FAULTS.reset()
+            else:
+                FAULTS.configure(spec)
+            return self._reply(sock, {"armed": spec})
+        send_frame(sock, OP_ERR, _jdump({"error": f"unknown op {op}"}))
+        return True
+
+    def _reply(self, sock, obj: dict) -> bool:
+        send_frame(sock, OP_OK, _jdump(obj))
+        return True
+
+    def _heartbeat(self, req: dict) -> dict:
+        with self._lock:
+            self._hb_seq += 1
+            seq = self._hb_seq
+        return {"t": req.get("t"), "seq": seq,
+                "load": self.host.load(1),
+                "active": self.host.pool.num_active,
+                "draining": self.draining}
+
+    # ---- streaming ----
+
+    def _handle_submit(self, sock, body: dict) -> bool:
+        if self.draining:
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": "host draining", "draining": True}))
+            return True
+        try:
+            req = req_from_dict(body["req"])
+        except Exception as e:
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": f"bad request: {type(e).__name__}: {e}"}))
+            return True
+        stream = _Stream(req.request_id)
+        with self._lock:
+            self._streams[req.request_id] = stream
+            self.submits += 1
+        out = self.host.submit(req)
+        t = threading.Thread(target=self._pump, args=(out, stream),
+                             name=f"rpc-pump-{req.request_id[:8]}",
+                             daemon=True)
+        t.start()
+        return self._reply(sock, {"rid": req.request_id, "seq0": 0})
+
+    def _pump(self, out: "queue.Queue", stream: _Stream):
+        while True:
+            ev = out.get()
+            if ev is None:
+                stream.finish()
+                return
+            stream.append(event_to_dict(ev))
+
+    def _handle_events(self, sock, body: dict) -> bool:
+        rid = body.get("rid", "")
+        with self._lock:
+            stream = self._streams.get(rid)
+        if stream is None:
+            send_frame(sock, OP_ERR, _jdump(
+                {"error": f"unknown stream {rid!r}"}))
+            return True
+        wait_s = min(2.0, max(0.0, int(body.get("wait_ms", 250)) / 1e3))
+        out = stream.poll(int(body.get("ack", 0)), wait_s)
+        if out["eof"] and out["last"] <= stream.acked:
+            with self._lock:            # fully delivered + acked: GC
+                self._streams.pop(rid, None)
+        return self._reply(sock, out)
+
+    # ---- graceful drain (SIGTERM / OP_DRAIN) ----
+
+    def drain(self, grace_s: float = 10.0, linger_s: float = 2.0,
+              exit_after: bool = True) -> dict:
+        """The clean half of the crash path: stop admissions, eject
+        every active stream at a known point (its delivered tokens ARE
+        the handoff state — resume ≡ fresh re-admission), checkpoint
+        chains to the host tier where the KV wire serves them, and wait
+        for clients to ack before signalling exit. The ``handoff``
+        marker (instead of ``eof``) tells the router-side puller to
+        re-adopt the continuation on a sibling."""
+        with self._lock:
+            if self.draining:
+                return {"draining": True}
+            self.draining = True
+            self.drains += 1
+            streams = [s for s in self._streams.values()
+                       if not s.done]
+        log.info("cluster host %d: draining (%d active streams)",
+                 self.host.host_id, len(streams))
+        for s in streams:
+            s.handoff = True
+            self.host.cancel(s.rid)
+        handed = sum(1 for s in streams if s.drained(grace_s))
+        # release-time checkpointing retains each ejected chain in the
+        # host tier asynchronously; linger so the adopting sibling can
+        # stream it off this process's KV wire before we exit
+        if linger_s > 0:
+            time.sleep(linger_s)
+        out = {"streams": len(streams), "handed_off": handed}
+        log.info("cluster host %d: drain done %s", self.host.host_id, out)
+        if exit_after:
+            self.exit_event.set()
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"address": self.address, "submits": self.submits,
+                    "streams_open": len(self._streams),
+                    "draining": self.draining, "drains": self.drains}
+
+
+# --------------- remote host handle ---------------
+
+
+class RemoteHostHandle:
+    """A cluster host that lives behind the control plane — possibly in
+    another PROCESS. Presents the same facade as the in-process
+    ``ClusterHost`` (submit / cancel / metrics_snapshot / chain_keys /
+    kv_audit_sweep / load / alive), so ``ClusterRouter`` is agnostic to
+    whether a host is a thread or a PID.
+
+    Liveness is the handle's own job: a heartbeat thread probes on
+    ``heartbeat_ms`` cadence (idempotent — retries with backoff inside
+    the deadline), feeds the phi-accrual detector, and on DEAD aborts
+    every live stream so its pullers fail over through
+    ``on_stream_lost(req, emitted_ids, reason)`` — the router installs
+    that callback and re-adopts each continuation on a sibling.
+
+    Token delivery: one puller thread per request long-polls EVENTS
+    with the last ACKED seq; a transient disconnect (severed socket,
+    chaos ``cluster_rpc_drop``) reconnects and resumes from the ack —
+    no token is ever delivered twice or dropped. SUBMIT itself is never
+    auto-retried."""
+
+    remote = True
+
+    def __init__(self, control_address: str, proc=None,
+                 host_id: int = 0, role: str = "both",
+                 scope: Optional[bytes] = None,
+                 heartbeat_ms: int = 250, suspect_ms: int = 1000,
+                 dead_ms: int = 3000, rpc_timeout_ms: int = 2000,
+                 retry: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.control_address = control_address
+        self.proc = proc
+        self.host_id = int(host_id)
+        self.role = role
+        self.address = ""               # kv wire address (from HELLO)
+        self.chain_scope = b""
+        self.page_size = 0
+        self.heartbeat_s = max(0.02, heartbeat_ms / 1e3)
+        self.rpc_timeout_s = max(0.1, rpc_timeout_ms / 1e3)
+        # a heartbeat must be allowed to finish SLOWLY without dying:
+        # its deadline sits between the suspect and dead bounds so a
+        # delayed-but-answering host lands beats (SUSPECT), while a
+        # hung one times out every probe until dead_ms declares it
+        self.heartbeat_deadline_s = max(self.rpc_timeout_s,
+                                        1.6 * suspect_ms / 1e3)
+        self.detector = FailureDetector(suspect_ms=suspect_ms,
+                                        dead_ms=dead_ms, clock=clock)
+        self._retry = retry or RetryPolicy()
+        self._clock = clock
+        self._ctl = RpcClient(control_address, scope=scope,
+                              timeout_s=self.rpc_timeout_s,
+                              retry=self._retry, clock=clock)
+        self._hb = RpcClient(control_address, scope=scope,
+                             timeout_s=self.heartbeat_deadline_s,
+                             retry=RetryPolicy(attempts=1), clock=clock)
+        self._lock = threading.Lock()
+        self._pullers: dict = {}        # rid -> _RemoteStream
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_load = 0.0
+        self._last_rtt_ms = 0.0
+        self.on_stream_lost: Optional[Callable] = None
+        self.on_state_change: Optional[Callable] = None
+        self._reported_state = FailureDetector.ALIVE
+        self.killed = False
+
+    # ---- construction ----
+
+    @classmethod
+    def spawn(cls, spec: dict, script: str = "", timeout_s: float = 180.0,
+              env: Optional[dict] = None, **kw) -> "RemoteHostHandle":
+        """Spawn ``scripts/cluster_host.py`` with ``spec`` and attach to
+        the control address it announces on stdout. The child inherits
+        the environment (so LOCALAI_FAULTS / JAX_PLATFORMS propagate,
+        same contract as BackendProcess)."""
+        if not script:
+            script = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "scripts", "cluster_host.py")
+        f = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False,
+                                        prefix="cluster-host-")
+        json.dump(spec, f)
+        f.close()
+        proc = subprocess.Popen(
+            [sys.executable, script, "--spec", f.name],
+            stdout=subprocess.PIPE, stderr=None,
+            env=dict(env) if env is not None else None, text=True)
+        ready = None
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"cluster host process exited rc={proc.returncode} "
+                        f"before READY")
+                time.sleep(0.05)
+                continue
+            line = line.strip()
+            if line.startswith("{") and '"ready"' in line:
+                ready = json.loads(line)
+                break
+        if ready is None:
+            proc.kill()
+            raise RuntimeError("cluster host process never became ready")
+        # keep draining child stdout so it can't block on a full pipe
+        threading.Thread(target=_drain_pipe, args=(proc.stdout,),
+                         daemon=True).start()
+        h = cls(ready["control"], proc=proc,
+                host_id=int(spec.get("host_id", 0)),
+                role=spec.get("role", "both"), **kw)
+        return h
+
+    # ---- ClusterHost facade ----
+
+    def start(self, precompile: bool = False) -> str:
+        # the first real op performs HELLO lazily; force it now so the
+        # kv address and scopes are known before routing begins. The
+        # roundtrip is also the detector's FIRST beat: monitoring
+        # starts here, not at construction, so a sibling's slow
+        # build/precompile between spawn() and start() cannot count
+        # as silence and walk a fresh host straight to sticky DEAD.
+        t0 = self._clock()
+        hb = self._ctl.heartbeat(deadline_s=self.rpc_timeout_s)
+        del hb
+        self.detector.beat((self._clock() - t0) * 1e3)
+        hello = self._ctl.hello
+        self.address = hello.get("kv", "")
+        self.role = hello.get("role", self.role)
+        self.chain_scope = bytes.fromhex(hello.get("chain_scope", "") or "")
+        self.page_size = int(hello.get("page_size", 0))
+        self.pid = hello.get("pid")
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"hb-host{self.host_id}", daemon=True)
+        self._hb_thread.start()
+        return self.address
+
+    def connect_peers(self, addresses: list):
+        addrs = [a for a in addresses if a and a != self.address]
+        if addrs:
+            self._ctl.peers(addrs)
+
+    def submit(self, req) -> "queue.Queue":
+        self._ctl.submit(req_to_dict(req))
+        puller = _RemoteStream(self, req)
+        with self._lock:
+            self._pullers[req.request_id] = puller
+        puller.start()
+        return req.out
+
+    def cancel(self, rid: str):
+        try:
+            self._ctl.cancel(rid)
+        except (OSError, WireError):
+            pass
+
+    def metrics_snapshot(self) -> dict:
+        snap = self._ctl.metrics()
+        snap.setdefault("rpc", {})
+        snap["rpc"]["client"] = self.rpc_stats()
+        return snap
+
+    def kv_debug(self) -> dict:
+        try:
+            return self.metrics_snapshot().get("kv_debug", {})
+        except (OSError, WireError):
+            return {}
+
+    def kv_audit_sweep(self, drained: bool = False) -> dict:
+        return self._ctl.audit(drained=drained)
+
+    def chain_keys(self, ids) -> list:
+        """Pure chain hashing (PR-2 block hashes are location-
+        independent): the handle computes the same keys the remote
+        host's prefix cache would, from the HELLO-pinned scope."""
+        if not self.chain_scope or not self.page_size:
+            return []
+        from localai_tpu.ops import kvcache
+
+        pg = self.page_size
+        parent = kvcache.PAGE_HASH_ROOT
+        out = []
+        for i in range(len(ids) // pg):
+            parent = kvcache.page_chain_hash(
+                parent, ids[i * pg:(i + 1) * pg], self.chain_scope)
+            out.append(parent)
+        return out
+
+    def load(self, rank: int = 1) -> float:
+        return self._last_load
+
+    def digest(self) -> dict:
+        return self._ctl.digest()
+
+    @property
+    def state(self) -> str:
+        if self.proc is not None and self.proc.poll() is not None:
+            self.detector.declare_dead()
+        return self.detector.state()
+
+    @property
+    def alive(self) -> bool:
+        return self.state != FailureDetector.DEAD
+
+    # ---- heartbeating ----
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(self.heartbeat_s):
+            t0 = self._clock()
+            try:
+                r = self._hb.heartbeat(deadline_s=self.heartbeat_deadline_s)
+                rtt = (self._clock() - t0) * 1e3
+                self.detector.beat(rtt)
+                with self._lock:
+                    self._last_load = float(r.get("load", 0.0))
+                    self._last_rtt_ms = rtt
+            except (OSError, WireError):
+                self.detector.failure()
+            st = self.state
+            if st != self._reported_state:
+                prev, self._reported_state = self._reported_state, st
+                log.warning("cluster host %d: %s -> %s (phi=%.2f)",
+                            self.host_id, prev, st, self.detector.phi())
+                if self.on_state_change is not None:
+                    try:
+                        self.on_state_change(self, prev, st)
+                    except Exception:
+                        log.exception("on_state_change failed")
+            if st == FailureDetector.DEAD:
+                self.abort_streams("crash")
+                return
+
+    def heartbeat_telemetry(self) -> dict:
+        with self._lock:
+            return {"state": self._reported_state,
+                    "rtt_ms": round(self._last_rtt_ms, 3),
+                    "load": self._last_load,
+                    **self.detector.snapshot()}
+
+    def rpc_stats(self) -> dict:
+        """Fold the control + heartbeat + per-stream clients' retry/
+        timeout counters (-> localai_cluster_rpc_{retries,timeouts})."""
+        out = {"retries": {}, "timeouts": {}, "reconnects": 0}
+        with self._lock:
+            clients = [self._ctl, self._hb] + \
+                [p.rpc for p in self._pullers.values()]
+        for c in clients:
+            s = c.stats()
+            for k in ("retries", "timeouts"):
+                for op, n in s[k].items():
+                    out[k][op] = out[k].get(op, 0) + n
+            out["reconnects"] += s["reconnects"]
+        return out
+
+    # ---- failure / drain handling ----
+
+    def abort_streams(self, reason: str):
+        with self._lock:
+            pullers = list(self._pullers.values())
+        for p in pullers:
+            p.abort(reason)
+
+    def _stream_done(self, rid: str):
+        with self._lock:
+            self._pullers.pop(rid, None)
+
+    def drain(self, deadline_s: float = 30.0) -> dict:
+        return self._ctl.drain(deadline_s=deadline_s)
+
+    def fault(self, spec: str) -> dict:
+        """Arm (or ``"reset"``) the HOST process's chaos table over
+        OP_FAULT — how bench drives slow/hang phases in a real child."""
+        return self._ctl.fault(spec)
+
+    def kill(self):
+        """Chaos: SIGKILL the host process (the crash the control plane
+        exists for). In-proc handles implement the PR-17 loop-death
+        kill; a real process loses its KV wire too — recovery degrades
+        to re-prefill of (prompt + delivered), still byte-identical."""
+        self.killed = True
+        if self.proc is not None:
+            self.proc.kill()
+
+    def terminate(self):
+        if self.proc is not None:
+            self.proc.terminate()
+
+    def shutdown(self):
+        self._hb_stop.set()
+        self.abort_streams("shutdown")
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self._ctl.close()
+        self._hb.close()
+
+
+class _RemoteStream:
+    """Client-side puller for one remote request: long-polls EVENTS
+    with the last acked seq, feeds the request's own out queue, and
+    tracks the delivered token ids (the handoff/recovery state)."""
+
+    def __init__(self, handle: RemoteHostHandle, req):
+        self.h = handle
+        self.req = req
+        self.rpc = RpcClient(handle.control_address,
+                             scope=handle._ctl.scope,
+                             timeout_s=handle.rpc_timeout_s,
+                             retry=RetryPolicy(attempts=1),
+                             clock=handle._clock)
+        self.ack = 0
+        self.emitted: list = []
+        self._abort = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name=f"pull-{self.req.request_id[:8]}",
+            daemon=True)
+        self._thread.start()
+
+    def abort(self, reason: str):
+        self._abort = reason
+
+    def _lost(self, reason: str):
+        self.h._stream_done(self.req.request_id)
+        cb = self.h.on_stream_lost
+        if cb is not None:
+            cb(self.h, self.req, list(self.emitted), reason)
+        else:
+            # no router to adopt us: fail the stream honestly
+            from localai_tpu.engine import engine as eng
+
+            self.req.out.put(eng.StreamEvent(
+                token_id=-1, text="", logprob=0.0,
+                error=f"cluster host {self.h.host_id} lost ({reason})",
+                error_kind="stall"))
+            self.req.out.put(None)
+
+    def _run(self):
+        backoff = 0
+        while True:
+            if self._abort:
+                self._lost(self._abort)
+                return
+            try:
+                r = self.rpc.events(self.req.request_id, self.ack,
+                                    wait_ms=250)
+                backoff = 0
+            except RpcRefused as e:
+                if self._abort:
+                    self._lost(self._abort)
+                else:
+                    self._lost(f"refused: {e}")
+                return
+            except (OSError, WireError):
+                # transient disconnect: reconnect + resume from ack —
+                # unless the host is gone, in which case fail over
+                if self.h.state == FailureDetector.DEAD or self._abort:
+                    self._lost(self._abort or "crash")
+                    return
+                time.sleep(self.h._retry.backoff_s(
+                    min(backoff, 5), random.random))
+                backoff += 1
+                continue
+            for ed in r.get("events", ()):
+                seq = int(ed.get("seq", 0))
+                if seq <= self.ack:
+                    continue            # duplicate after a resume
+                self.ack = seq
+                ev = event_from_dict(ed)
+                if ev.token_ids:
+                    self.emitted.extend(int(t) for t in ev.token_ids)
+                elif ev.token_id >= 0:
+                    self.emitted.append(int(ev.token_id))
+                self.req.out.put(ev)
+            if r.get("failed"):
+                self._lost(str(r["failed"]))
+                return
+            if self.ack >= int(r.get("last", 0)):
+                if r.get("handoff"):
+                    # graceful drain: delivered tokens are the handoff
+                    # state; one final ack releases the server buffer
+                    try:
+                        self.rpc.events(self.req.request_id, self.ack,
+                                        wait_ms=0)
+                    except (OSError, WireError):
+                        pass
+                    self._lost("drain")
+                    return
+                if r.get("eof"):
+                    try:
+                        self.rpc.events(self.req.request_id, self.ack,
+                                        wait_ms=0)
+                    except (OSError, WireError):
+                        pass
+                    self.h._stream_done(self.req.request_id)
+                    self.req.out.put(None)
+                    self.rpc.close()
+                    return
+
+
+def _drain_pipe(pipe):
+    try:
+        for _ in pipe:
+            pass
+    except Exception:
+        pass

@@ -52,6 +52,23 @@ Injection points (grep for ``FAULTS.take``):
                                  slow checkpoint source must not stall
                                  serving siblings or flap the autoscaler
                                  (ISSUE 19; arm ``*`` for the whole load)
+``cluster_rpc_delay_ms=N``       services/cluster_rpc.py dispatch: sleep N ms
+                                 before answering each control frame — a SLOW
+                                 peer. Heartbeats land, late: the failure
+                                 detector must hold SUSPECT (routing
+                                 de-preference), never walk to DEAD (arm
+                                 ``*`` to keep the host slow; ISSUE 20)
+``cluster_rpc_drop``             services/cluster_rpc.py dispatch: sever one
+                                 control connection with no reply — the event
+                                 stream must resume from the last ACKED
+                                 sequence number after the client reconnects
+                                 (no token delivered twice or dropped)
+``clusterN_hang``                services/cluster_rpc.py heartbeat handler:
+                                 host N swallows heartbeat frames while the
+                                 process lives (arm ``*``) — it must be
+                                 declared DEAD after ``cluster_dead_ms`` and
+                                 its streams recovered byte-identically on
+                                 siblings
 ==========================  =================================================
 """
 

@@ -38,6 +38,12 @@ connection mid-FETCH instead of replying (the requester sees a dead
 peer and degrades to local re-prefill); ``kv_stream_corrupt`` flips a
 byte in the outgoing COPY of a fetched page so the receiver's CRC check
 must reject it (the server's own store is never touched).
+
+This module is the cluster's DATA plane. The CONTROL plane
+(services/cluster_rpc.py, ISSUE 20) reuses the same framing helpers —
+``send_frame``/``recv_frame`` and the HELLO-first session discipline —
+on a DISJOINT op-number range (32+), so a client that dials the wrong
+port gets a typed refusal instead of a silent mis-parse.
 """
 
 from __future__ import annotations

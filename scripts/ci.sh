@@ -318,12 +318,16 @@ rm -f "$lc_out"
 # prefill->decode handoff must continue byte-identically on the decode
 # host, killing a host mid-stream must re-adopt on the sibling without
 # closing the client stream, and the cluster-wide audit must stay
-# clean. rc != 0 if any gate regresses.
+# clean. The process phases (ISSUE 20) run the same contracts against
+# SPAWNED host processes over the RPC control plane: kill -9 recovery
+# (CLUSTER_PROC_RECOVERED), graceful drain handoff + child exit 0
+# (CLUSTER_DRAIN_BYTE_MATCH), and slow-is-SUSPECT-not-DEAD
+# (CLUSTER_SLOW_NOT_KILLED). rc != 0 if any gate regresses.
 echo "== ci: bench cluster =="
 cluster_out=$(mktemp)
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
 LOCALAI_BENCH_PRESET=smoke LOCALAI_BENCH_SLOTS=2 LOCALAI_BENCH_CTX=128 \
-LOCALAI_BENCH_BUDGET_S="${LOCALAI_CLUSTER_BUDGET_S:-300}" \
+LOCALAI_BENCH_BUDGET_S="${LOCALAI_CLUSTER_BUDGET_S:-480}" \
     python bench.py --cluster | tee "$cluster_out"
 
 python - "$cluster_out" <<'PY'
@@ -342,6 +346,13 @@ print(f"KV_STREAM_HITS={line.get('kv_stream_hits')} "
       f"warm_ttft_ms={line.get('warm_ttft_ms')} "
       f"crash_byte_match={line.get('crash_byte_match')} "
       f"itl_wave_ratio={line.get('itl_wave_ratio')}")
+proc = {k: line.get(k) for k in
+        ("proc_recovered", "drain_byte_match", "slow_not_killed")}
+print(f"CLUSTER_PROC_RECOVERED={1 if proc['proc_recovered'] else 0} "
+      f"CLUSTER_DRAIN_BYTE_MATCH={1 if proc['drain_byte_match'] else 0} "
+      f"CLUSTER_SLOW_NOT_KILLED={1 if proc['slow_not_killed'] else 0} "
+      f"proc_spawn_s={line.get('proc_spawn_s')} "
+      f"drain_child_exit={line.get('drain_child_exit')}")
 kv_v, kv_l = line.get("kv_audit_violations"), line.get("kv_leaked_pages")
 print(f"KV_AUDIT_VIOLATIONS={kv_v} KV_LEAKED_PAGES={kv_l}")
 hits = line.get("kv_stream_hits")
@@ -354,6 +365,12 @@ if (hits is None or not hits >= 1
           f"and disagg_byte_match={line.get('disagg_byte_match')} must "
           f"be true, host_recovered={line.get('host_recovered')} must "
           f"be 1)")
+    sys.exit(1)
+if not all(v is True for v in proc.values()):
+    print(f"FAIL: cluster control plane regressed "
+          f"(proc_recovered={proc['proc_recovered']}, "
+          f"drain_byte_match={proc['drain_byte_match']}, "
+          f"slow_not_killed={proc['slow_not_killed']} must all be true)")
     sys.exit(1)
 sys.exit(0 if line.get("value") == 1 and kv_v == 0 and kv_l == 0 else 1)
 PY

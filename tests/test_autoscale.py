@@ -293,6 +293,67 @@ def test_pool_scale_in_live_migration_byte_match(tiny_llama,
         pool.shutdown()
 
 
+def test_pool_resize_coscales_admission_limit(tiny_llama,
+                                              byte_tokenizer):
+    """Admission co-scaling (ISSUE 20): each live replica's effective
+    max_queued_requests tracks live width over CONFIGURED width — a
+    scaled-in pool sheds at the narrower width's limit instead of
+    promising the full fleet's queue depth — and scaling back restores
+    the configured knob bit-for-bit."""
+    cfg, params = tiny_llama
+    ecfg = eng.EngineConfig(num_slots=2, max_context=96,
+                            prefill_buckets=(16, 64), decode_burst=4,
+                            kv_page_size=8, max_queued_requests=8)
+    pool = EnginePool.build(cfg, params, byte_tokenizer, ecfg, engines=2)
+    pool.start()
+    try:
+        assert all(e.maxq_effective == 8 for e in pool._engines)
+        assert pool.metrics()["queue_limit"] == 16
+        EVENTS.clear()
+        assert pool.resize(1, reason="test") == 1
+        live = [pool._engines[i] for i in pool._routable_idx()]
+        assert [e.maxq_effective for e in live] == [4]
+        assert pool.metrics()["queue_limit"] == 4
+        ev = [e for e in EVENTS.events()
+              if e["event"] == "queue_limit_rescaled"]
+        assert ev and ev[-1]["per_replica"] == 4
+        assert ev[-1]["configured"] == 2
+        # the autoscaler's backlog signal renormalizes to the co-scaled
+        # capacity, not the configured fleet's
+        assert pool.autoscale_signals().queue_frac == 0.0
+        assert pool.resize(2, reason="test") == 2
+        assert all(pool._engines[i].maxq_effective == 8
+                   for i in pool._routable_idx())
+        assert pool.metrics()["queue_limit"] == 16
+    finally:
+        pool.shutdown()
+
+
+def test_engine_submit_sheds_at_effective_limit(tiny_llama,
+                                                byte_tokenizer):
+    """Engine.submit reads maxq_effective (the co-scaled limit), not
+    the configured knob: narrowing it sheds earlier, with the same
+    structured shed event the static limit produces."""
+    cfg, params = tiny_llama
+    e = eng.Engine(cfg, params, byte_tokenizer,
+                   eng.EngineConfig(num_slots=2, max_context=96,
+                                    prefill_buckets=(16, 64),
+                                    max_queued_requests=4))
+    # never started: submissions stay queued, so the backlog is exact
+    assert e.maxq_effective == 4
+    for k in range(4):
+        e.submit(_greedy(byte_tokenizer, f"queued number {k}", 4))
+    e.maxq_effective = 2            # what EnginePool._rescale_admission
+    shed = e.submit(_greedy(byte_tokenizer, "one too many", 4))  # does
+    evs = _collect(shed, timeout=5)
+    assert evs and evs[-1].error_kind == "shed"
+    assert "overloaded" in evs[-1].error
+    assert "2 requests" in evs[-1].error, "shed at the EFFECTIVE limit"
+    assert e.metrics()["queue_limit"] == 2
+    assert e.metrics()["lifecycle"]["queue_limit_effective"] == 2
+    assert e.metrics()["lifecycle"]["max_queued_requests"] == 4
+
+
 @pytest.mark.slow
 def test_pool_autoscale_closed_loop(tiny_llama, byte_tokenizer):
     """The whole loop on a live pool: a queue backlog scales 1 -> 2

@@ -1,0 +1,620 @@
+"""Cluster control plane (services/cluster_rpc.py, ISSUE 20): the RPC
+protocol's framing/HELLO discipline, the idempotent-only retry matrix
+with its full-jitter schedule, the phi-accrual failure-detector ladder
+(ALIVE -> SUSPECT -> DEAD, slow != dead), mid-stream seq-resume after a
+severed control connection, the graceful-drain handoff byte gate, and a
+real two-process kill -9 smoke.
+
+Protocol units run against an in-process ``ClusterHostServer`` wrapped
+by a ``RemoteHostHandle`` — the protocol cannot tell (and must not care)
+whether the host is a thread or a PID; only the smoke test pays for a
+real spawned process. Byte gates are PR-10's resume contract over the
+control plane: recovery re-admits (pristine prompt + delivered tokens)
+and the continuation must equal a fresh run of the same."""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+
+import pytest
+
+from localai_tpu.engine import engine as eng
+from localai_tpu.engine import sampling
+from localai_tpu.engine.cluster import ClusterHost, ClusterRouter
+from localai_tpu.services import cluster_rpc as crpc
+from localai_tpu.services.cluster_rpc import (
+    OP_DIGEST, OP_ERR, OP_HEARTBEAT, OP_HELLO, OP_OK, OP_SUBMIT,
+    RETRYABLE_OPS, RPC_VERSION, ClusterHostServer, FailureDetector,
+    RemoteHostHandle, RetryPolicy, RpcClient, RpcRefused)
+from localai_tpu.services.eventlog import EVENTS
+from localai_tpu.services.faults import FAULTS
+from localai_tpu.services.kv_wire import (
+    WireError, _jdump, _jload, recv_frame, send_frame)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---- pure units: retry schedule ----
+
+
+def test_retry_policy_full_jitter_schedule():
+    """The backoff is uniform(0, min(cap, base * 2**a)) — pure under an
+    injected rng, capped, and zero at rng=0 (full jitter floors at 0)."""
+    p = RetryPolicy(base_ms=50.0, cap_ms=2000.0, attempts=4)
+    one = lambda: 1.0   # noqa: E731
+    assert p.backoff_s(0, one) == pytest.approx(0.050)
+    assert p.backoff_s(1, one) == pytest.approx(0.100)
+    assert p.backoff_s(2, one) == pytest.approx(0.200)
+    assert p.backoff_s(5, one) == pytest.approx(1.600)
+    assert p.backoff_s(6, one) == pytest.approx(2.000)   # capped
+    assert p.backoff_s(60, one) == pytest.approx(2.000)  # no overflow
+    assert p.backoff_s(3, lambda: 0.5) == pytest.approx(0.200)
+    assert p.backoff_s(3, lambda: 0.0) == 0.0
+
+
+def test_retry_matrix_idempotent_ops_only():
+    """Transport failures retry DIGEST/METRICS/HEARTBEAT/AUDIT up to
+    ``attempts`` total tries; SUBMIT fails on the FIRST transport error
+    (double-admit is worse than a routed retry); a server-answered
+    OP_ERR (RpcRefused) never retries any op."""
+    assert OP_SUBMIT not in RETRYABLE_OPS
+    sleeps = []
+    c = RpcClient("127.0.0.1:1", retry=RetryPolicy(attempts=3),
+                  sleep=sleeps.append, rng=lambda: 1.0)
+    calls = {"n": 0}
+
+    def flaky(op, payload, deadline):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("severed")
+        return {"ok": 1}
+
+    c._roundtrip = flaky
+    assert c.call(OP_DIGEST) == {"ok": 1}
+    assert calls["n"] == 3
+    assert c.stats()["retries"] == {"digest": 2}
+    assert sleeps == [pytest.approx(0.050), pytest.approx(0.100)]
+
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        c.call(OP_SUBMIT, {"req": {}})
+    assert calls["n"] == 1                      # never auto-retried
+
+    def refused(op, payload, deadline):
+        calls["n"] += 1
+        raise RpcRefused("scope mismatch")
+
+    calls["n"] = 0
+    c._roundtrip = refused
+    with pytest.raises(RpcRefused):
+        c.call(OP_HEARTBEAT)                    # retryable op, but the
+    assert calls["n"] == 1                      # server ANSWERED: no retry
+
+
+def test_retry_exhaustion_raises_last_error():
+    c = RpcClient("127.0.0.1:1", retry=RetryPolicy(attempts=2),
+                  sleep=lambda s: None, rng=lambda: 0.0)
+
+    def down(op, payload, deadline):
+        raise OSError("still down")
+
+    c._roundtrip = down
+    with pytest.raises(OSError, match="still down"):
+        c.call(OP_DIGEST)
+    assert c.stats()["retries"] == {"digest": 1}
+
+
+# ---- pure units: failure detector ----
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_failure_detector_ladder():
+    """ALIVE with a steady beat; SUSPECT past suspect_ms of silence
+    (recoverable); DEAD past dead_ms — and DEAD is sticky: a late beat
+    cannot resurrect a host whose recovery already fired."""
+    clk = _Clock()
+    d = FailureDetector(suspect_ms=1000, dead_ms=3000, clock=clk)
+    for _ in range(10):
+        clk.t += 0.1
+        d.beat(rtt_ms=5.0)
+    assert d.state() == FailureDetector.ALIVE
+
+    clk.t += 1.5                        # silence past suspect_ms
+    assert d.state() == FailureDetector.SUSPECT
+    d.beat(rtt_ms=5.0)                  # recovery: SUSPECT is not sticky
+    assert d.state() == FailureDetector.ALIVE
+
+    clk.t += 3.1                        # silence past dead_ms
+    assert d.state() == FailureDetector.DEAD
+    d.beat(rtt_ms=5.0)
+    assert d.state() == FailureDetector.DEAD, "DEAD must be sticky"
+
+
+def test_failure_detector_slow_is_suspect_never_dead():
+    """The slow-peer rung: beats that LAND but take longer than
+    suspect_ms hold SUSPECT indefinitely — answering late is degraded,
+    not dead, so the host keeps its streams."""
+    clk = _Clock()
+    d = FailureDetector(suspect_ms=500, dead_ms=1500, clock=clk)
+    d.beat(rtt_ms=5.0)
+    states = []
+    for _ in range(40):                 # 40 beats * 0.8s >> dead_ms
+        clk.t += 0.8
+        d.beat(rtt_ms=800.0)
+        states.append(d.state())
+    # the RTT EWMA needs a few samples to cross the bound; once it
+    # does, SUSPECT holds steadily — and DEAD never fires
+    assert set(states[8:]) == {FailureDetector.SUSPECT}
+    assert FailureDetector.DEAD not in states
+    assert not d.snapshot()["dead"]
+
+
+def test_failure_detector_declare_dead():
+    clk = _Clock()
+    d = FailureDetector(suspect_ms=1000, dead_ms=3000, clock=clk)
+    d.beat(rtt_ms=1.0)
+    d.declare_dead()                    # process exited: hard evidence
+    assert d.state() == FailureDetector.DEAD
+
+
+def test_failure_detector_phi_scales_with_cadence():
+    """phi grows with silence measured in OBSERVED inter-beat periods:
+    the same 2s gap is alarming at a 100ms cadence and nothing at 5s."""
+    fast, slow = _Clock(), _Clock()
+    df = FailureDetector(suspect_ms=60000, dead_ms=120000, clock=fast)
+    ds = FailureDetector(suspect_ms=60000, dead_ms=120000, clock=slow)
+    for _ in range(20):
+        fast.t += 0.1
+        df.beat(1.0)
+        slow.t += 5.0
+        ds.beat(1.0)
+    fast.t += 2.0
+    slow.t += 2.0
+    assert df.phi() > ds.phi() * 10
+
+
+# ---- (de)serialization round-trips ----
+
+
+def test_request_and_event_roundtrip():
+    req = eng.GenRequest(
+        prompt_ids=[5, 6, 7], max_new_tokens=9,
+        params=sampling.SamplingParamsHost(
+            temperature=0.7, top_k=3, logit_bias={4: -1.5}),
+        stop_sequences=["stop"], ignore_eos=True, priority="high")
+    got = crpc.req_from_dict(_jload(_jdump(crpc.req_to_dict(req))))
+    assert got.prompt_ids == [5, 6, 7]
+    assert got.max_new_tokens == 9
+    assert got.request_id == req.request_id
+    assert got.params.logit_bias == {4: -1.5}   # int keys survive JSON
+    assert got.params.temperature == pytest.approx(0.7)
+    assert got.priority == "high"
+
+    ev = eng.StreamEvent(token_id=3, text="x", logprob=-0.5,
+                         finish_reason="stop", prompt_tokens=4,
+                         completion_tokens=9, token_ids=[3, 4],
+                         logprobs=[-0.5, -0.1])
+    got = crpc.event_from_dict(_jload(_jdump(crpc.event_to_dict(ev))))
+    assert (got.token_id, got.text, got.finish_reason) == (3, "x", "stop")
+    assert got.token_ids == [3, 4]
+    assert got.completion_tokens == 9
+    err = eng.StreamEvent(token_id=-1, text="", logprob=0.0,
+                          error="boom", error_kind="stall")
+    got = crpc.event_from_dict(_jload(_jdump(crpc.event_to_dict(err))))
+    assert (got.error, got.error_kind) == ("boom", "stall")
+
+
+# ---- live in-process control plane ----
+
+
+def _ecfg(**kw):
+    import jax.numpy as jnp
+
+    base = dict(num_slots=2, max_context=96, prefill_buckets=(16, 64),
+                decode_burst=4, kv_page_size=8, kv_audit="strict",
+                cache_dtype=jnp.float32)
+    base.update(kw)
+    return eng.EngineConfig(**base)
+
+
+def _greedy(tok, prompt: str, n: int = 8):
+    return eng.GenRequest(
+        prompt_ids=tok.encode(prompt),
+        params=sampling.SamplingParamsHost(temperature=0.0),
+        max_new_tokens=n, ignore_eos=True)
+
+
+def _drain(out, timeout: float = 60.0):
+    ids, err = [], None
+    while True:
+        ev = out.get(timeout=timeout)
+        if ev is None:
+            return ids, err
+        if ev.error is not None:
+            err = ev.error
+        if ev.token_ids:
+            ids.extend(ev.token_ids)
+        elif ev.token_id >= 0:
+            ids.append(ev.token_id)
+
+
+def _make_rig(tiny_llama, tok, **handle_kw):
+    """In-proc host 0 + host 1 behind the control plane, one router.
+    The RPC server and the remote handle live in THIS process — the
+    protocol is identical; only the smoke test pays for a real PID."""
+    cfg, params = tiny_llama
+    h0 = ClusterHost.build(cfg, params, tok, _ecfg(), host_id=0,
+                           engines=1)
+    h1 = ClusterHost.build(cfg, params, tok, _ecfg(), host_id=1,
+                           engines=1)
+    h1.start()
+    srv = ClusterHostServer(h1)
+    srv.start()
+    # suspect_ms is tight so the slow-peer test converges quickly; the
+    # huge dead_ms keeps GIL pauses (compiles) from ever walking the
+    # module-scoped rig to sticky DEAD mid-suite
+    kw = dict(heartbeat_ms=100, suspect_ms=400, dead_ms=60000)
+    kw.update(handle_kw)
+    handle = RemoteHostHandle(srv.address, host_id=1, **kw)
+    router = ClusterRouter([h0, handle])
+    router.start()
+    return router, h0, h1, srv, handle
+
+
+@pytest.fixture(scope="module")
+def rig(tiny_llama, byte_tokenizer):
+    router, h0, h1, srv, handle = _make_rig(tiny_llama, byte_tokenizer)
+    yield router, h0, h1, srv, handle
+    router.shutdown()
+    srv.stop()
+    h1.shutdown()
+
+
+# ---- HELLO / session discipline ----
+
+
+def _dial(addr):
+    host, _, port = addr.rpartition(":")
+    return socket.create_connection((host, int(port)), timeout=5)
+
+
+def test_hello_version_refused(rig):
+    _, _, _, srv, _ = rig
+    with _dial(srv.address) as s:
+        send_frame(s, OP_HELLO, _jdump({"version": RPC_VERSION + 9}))
+        op, payload = recv_frame(s)
+    assert op == OP_ERR
+    assert "version" in _jload(payload)["error"]
+
+
+def test_hello_scope_mismatch_refused(rig):
+    _, _, _, srv, _ = rig
+    with _dial(srv.address) as s:
+        send_frame(s, OP_HELLO, _jdump({"version": RPC_VERSION,
+                                        "scope": "00" * 16}))
+        op, payload = recv_frame(s)
+    assert op == OP_ERR
+    assert "scope" in _jload(payload)["error"]
+
+
+def test_op_before_hello_refused(rig):
+    _, _, _, srv, _ = rig
+    with _dial(srv.address) as s:
+        send_frame(s, OP_DIGEST, _jdump({}))
+        op, payload = recv_frame(s)
+    assert op == OP_ERR
+    assert "HELLO" in _jload(payload)["error"]
+
+
+def test_hello_adopts_scope_and_pins_topology(rig):
+    """A scope-less client adopts the server's scope on first connect
+    (trust-on-first-connect); the reply carries the kv address, pid and
+    the CHAIN scope the handle hashes affinity keys with."""
+    _, _, h1, srv, handle = rig
+    store = h1.pool._shared.store
+    assert handle._ctl.scope == store.scope
+    assert handle.address == h1.address          # the kv wire address
+    assert handle.pid == os.getpid()             # in-process rig
+    assert handle.page_size == store.page_size
+    pc = h1.pool._engines[0]._pcache
+    assert handle.chain_scope == pc.scope
+
+
+def test_remote_chain_keys_match_host(rig, byte_tokenizer):
+    """Affinity keys computed CLIENT-side from the HELLO-pinned chain
+    scope equal the remote prefix cache's own hashes — digest routing
+    needs no extra round-trip per request."""
+    _, _, h1, _, handle = rig
+    ids = byte_tokenizer.encode("affinity keys must agree end to end")
+    pc = h1.pool._engines[0]._pcache
+    assert handle.chain_keys(ids) == list(pc.chain_keys(ids))
+    assert handle.chain_keys(ids[:3]) == []      # sub-page prompt
+
+
+# ---- streaming over the control plane ----
+
+
+def test_remote_submit_byte_identical(rig, byte_tokenizer):
+    """A greedy stream through SUBMIT/EVENTS equals the host's own
+    in-process output, token for token."""
+    router, _, h1, _, _ = rig
+    prompt = "the control plane must not change a single token"
+    ids, err = _drain(router.submit(_greedy(byte_tokenizer, prompt, 12),
+                                    host=1))
+    assert err is None and len(ids) == 12
+    ref, rerr = _drain(h1.submit(_greedy(byte_tokenizer, prompt, 12)))
+    assert rerr is None
+    assert ids == ref
+
+
+def test_events_seq_resume_after_drop(rig, byte_tokenizer):
+    """Satellite 1 (``cluster_rpc_drop``): the server severs one control
+    connection mid-stream with no reply. The client reconnects and
+    resumes from the last ACKED seq — the delivered tokens are byte-
+    identical to an undropped run (nothing duplicated, nothing lost)."""
+    router, _, h1, srv, _ = rig
+    prompt = "a severed socket must not lose or repeat tokens"
+    ref, rerr = _drain(h1.submit(_greedy(byte_tokenizer, prompt, 16)))
+    assert rerr is None and len(ref) == 16
+
+    # a dedicated client; the fault hook fires on the server's NEXT
+    # frames regardless of connection, so arm a few firings — the rig's
+    # 100ms heartbeat may eat one, this client's tight poll loop eats
+    # the rest (its own frames arrive far more often)
+    c = RpcClient(srv.address, retry=RetryPolicy(attempts=1))
+
+    def pump(r, got, ack):
+        for ed in r.get("events", ()):
+            if ed["seq"] <= ack:
+                continue                         # dup after a resume
+            ack = ed["seq"]
+            ev = crpc.event_from_dict(ed)
+            if ev.token_ids:
+                got.extend(int(t) for t in ev.token_ids)
+            elif ev.token_id >= 0:
+                got.append(ev.token_id)
+        return ack
+
+    r = c.submit(crpc.req_to_dict(_greedy(byte_tokenizer, prompt, 16)))
+    rid = r["rid"]
+    got, ack = [], 0
+    deadline = time.monotonic() + 60
+    while len(got) < 4 and time.monotonic() < deadline:
+        ack = pump(c.events(rid, ack, wait_ms=100), got, ack)
+    assert 0 < len(got) < 16
+
+    FAULTS.arm("cluster_rpc_drop", count=3)
+    severed = False
+    while time.monotonic() < deadline and not severed:
+        try:
+            c.events(rid, ack, wait_ms=50)       # un-acked: no loss
+        except (OSError, WireError):
+            severed = True
+    assert severed, "the drop fault never severed this connection"
+
+    while time.monotonic() < deadline:           # reconnect + resume
+        try:
+            r = c.events(rid, ack, wait_ms=250)
+        except (OSError, WireError):
+            continue                             # a leftover firing
+        ack = pump(r, got, ack)
+        if r.get("eof") and ack >= r.get("last", 0):
+            break
+    c.close()
+    assert FAULTS.snapshot()["fired"].get("cluster_rpc_drop", 0) >= 1
+    assert got == ref, "resume-from-ack must not lose or repeat tokens"
+    assert c.stats()["reconnects"] >= 2          # initial + post-drop
+
+
+def test_unacked_stream_survives_server_gc(rig, byte_tokenizer):
+    """Events stay buffered until ACKED: polling with ack=0 after the
+    stream finished still returns the full history."""
+    _, _, _, srv, _ = rig
+    c = RpcClient(srv.address)
+    r = c.submit(crpc.req_to_dict(_greedy(
+        byte_tokenizer, "buffered until acknowledged", 6)))
+    rid = r["rid"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        r = c.events(rid, 0, wait_ms=250)        # never advance the ack
+        if r.get("eof"):
+            break
+    n = sum(len(e.get("ts") or ([e["t"]] if e.get("t", -1) >= 0 else []))
+            for e in r["events"])
+    assert n == 6
+    # final ack releases the buffer; the stream is then unknown
+    c.events(rid, r["last"], wait_ms=0)
+    with pytest.raises(RpcRefused, match="unknown stream"):
+        c.events(rid, 0, wait_ms=0)
+    c.close()
+
+
+def test_suspect_host_depreferred_not_killed(rig, byte_tokenizer):
+    """Satellite 1 (``cluster_rpc_delay_ms``): a host that answers LATE
+    walks to SUSPECT (never DEAD), loses routing preference to healthy
+    siblings, and comes back to ALIVE once the delay clears."""
+    router, _, _, _, handle = rig
+    # delay > suspect_ms (400): once the RTT EWMA converges past the
+    # bound, SUSPECT holds STEADILY via the slow rung — no flapping on
+    # the elapsed timer — yet every beat still lands (inside the
+    # heartbeat deadline), so DEAD stays unreachable
+    FAULTS.arm("cluster_rpc_delay_ms", "800", count=-1)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                handle.detector.snapshot()["rtt_ewma_ms"] <= 500:
+            time.sleep(0.1)
+        assert handle.state == FailureDetector.SUSPECT
+        # routing: fresh arrivals land on the healthy sibling
+        for k in range(3):
+            r = _greedy(byte_tokenizer,
+                        f"route arrival {k} away from the slow host", 4)
+            ids, err = _drain(router.submit(r))
+            assert err is None
+            assert router.where(r.request_id) == 0
+        assert handle.state == FailureDetector.SUSPECT
+        assert not handle.detector.snapshot()["dead"], "slow != dead"
+    finally:
+        FAULTS.reset()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline \
+            and handle.state != FailureDetector.ALIVE:
+        time.sleep(0.05)
+    assert handle.state == FailureDetector.ALIVE, "SUSPECT must recover"
+    m = router.metrics()
+    assert m["cluster"]["host_states"]["1"] == "alive"
+    assert m["cluster"]["hosts_alive"] == 2
+
+
+# ---- graceful drain: the handoff byte gate ----
+
+
+def test_drain_handoff_byte_gate(tiny_llama, byte_tokenizer):
+    """SIGTERM's clean half: drain stops admissions, hands the live
+    stream off at a known token boundary, and the sibling's
+    continuation byte-matches a fresh re-admission of (prompt +
+    delivered) — the PR-10 contract over the control plane."""
+    router, h0, h1, srv, handle = _make_rig(tiny_llama, byte_tokenizer)
+    try:
+        EVENTS.clear()
+        prompt = "drain me gently and hand my stream to the sibling"
+        n = 24
+        victim = _greedy(byte_tokenizer, prompt, n)
+        out = router.submit(victim, host=1)
+        first = out.get(timeout=60)
+        assert first is not None and first.error is None
+        r = router.drain_host(1)
+        assert r.get("draining")
+        ids, err = _drain(out)
+        if first.token_ids:
+            ids = list(first.token_ids) + ids
+        elif first.token_id >= 0:
+            ids = [first.token_id] + ids
+        assert err is None and len(ids) == n
+        # draining hosts refuse new admissions with a typed error
+        with pytest.raises(RuntimeError, match="not live"):
+            router.submit(_greedy(byte_tokenizer, "too late", 4), host=1)
+        migs = [e for e in EVENTS.events() if e["event"] == "migrate"
+                and e["rid"] == victim.request_id]
+        assert migs and migs[-1]["reason"] == "host_drain"
+        ref, rerr = _drain(router.submit(
+            _greedy(byte_tokenizer, prompt, n), host=0))
+        assert rerr is None
+        assert ids == ref, "drained continuation must byte-match"
+        m = router.metrics()
+        assert m["cluster"]["drains"] == 1
+        assert m["cluster"]["remote_recovered"] >= 1
+        assert srv.stats()["draining"]
+        # OP_DRAIN exit=True: the background drain signals exit after
+        # the ack-wait + KV linger window
+        assert srv.exit_event.wait(timeout=20)
+    finally:
+        router.shutdown()
+        srv.stop()
+        h1.shutdown()
+
+
+# ---- real two-process smoke ----
+
+
+@pytest.mark.slow
+def test_spawned_host_kill9_recovery(tiny_llama, byte_tokenizer):
+    """The control plane against a REAL PID: spawn a host process via
+    scripts/cluster_host.py, kill -9 it mid-stream, and the router
+    re-adopts the continuation on the in-process sibling, byte-
+    identical. (The bench --cluster process phase gates this in CI;
+    here it is the tier-2 smoke.)"""
+    cfg, params = tiny_llama
+    h0 = ClusterHost.build(cfg, params, byte_tokenizer, _ecfg(),
+                           host_id=0, engines=1)
+    spec = {
+        "host_id": 1, "role": "both", "engines": 1,
+        "model": {"kind": "llama-init", "dtype": "float32", "seed": 0,
+                  "config": {"vocab_size": cfg.vocab_size,
+                             "hidden_size": cfg.hidden_size,
+                             "intermediate_size": cfg.intermediate_size,
+                             "num_layers": cfg.num_layers,
+                             "num_heads": cfg.num_heads,
+                             "num_kv_heads": cfg.num_kv_heads,
+                             "max_position_embeddings":
+                                 cfg.max_position_embeddings}},
+        "tokenizer": "byte2",
+        "engine": {"num_slots": 2, "max_context": 96,
+                   "prefill_buckets": [16, 64], "decode_burst": 4,
+                   "kv_page_size": 8, "kv_audit": "strict",
+                   "cache_dtype": "float32"},
+        "precompile": False,
+    }
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    h1 = RemoteHostHandle.spawn(spec, env=env, heartbeat_ms=100,
+                                suspect_ms=500, dead_ms=1500)
+    assert h1.proc.pid != os.getpid()
+    router = ClusterRouter([h0, h1])
+    router.start()
+    try:
+        prompt = "kill dash nine and the stream must still finish"
+        n = 24
+        victim = _greedy(byte_tokenizer, prompt, n)
+        out = router.submit(victim, host=1)
+        first = out.get(timeout=120)
+        assert first is not None and first.error is None
+        h1.kill()
+        ids, err = _drain(out, timeout=120)
+        if first.token_ids:
+            ids = list(first.token_ids) + ids
+        elif first.token_id >= 0:
+            ids = [first.token_id] + ids
+        assert err is None and len(ids) == n
+        assert router.where(victim.request_id) == 0
+        ref, rerr = _drain(router.submit(
+            _greedy(byte_tokenizer, prompt, n), host=0))
+        assert rerr is None and ids == ref
+        m = router.metrics()
+        assert m["cluster"]["host_states"]["1"] == "dead"
+        assert m["cluster"]["hosts_alive"] == 1
+        assert m["cluster"]["remote_recovered"] >= 1
+    finally:
+        router.shutdown()
+
+
+# ---- satellite 3: kv-stream / cluster knob validation ----
+
+
+def test_cluster_knob_validation():
+    from localai_tpu.config.model_config import ModelConfig
+
+    def probs(*options):
+        return ModelConfig(name="m", options=list(options)).validate()
+
+    assert probs("kv_stream_cooldown_ms=5000", "kv_stream_negcache_ms=0",
+                 "kv_stream_connect_timeout_ms=2000",
+                 "cluster_heartbeat_ms=250", "cluster_suspect_ms=1000",
+                 "cluster_dead_ms=3000", "cluster_mode=process") == []
+    assert any("kv_stream_cooldown_ms" in p
+               for p in probs("kv_stream_cooldown_ms=fast"))
+    assert any("cluster_rpc_retries" in p
+               for p in probs("cluster_rpc_retries=-1"))
+    assert any("cluster_mode" in p for p in probs("cluster_mode=thread"))
+    # the detector ladder needs SUSPECT strictly before DEAD
+    assert any("cluster_suspect_ms" in p
+               for p in probs("cluster_suspect_ms=3000",
+                              "cluster_dead_ms=3000"))
+    assert probs("cluster_suspect_ms=400", "cluster_dead_ms=1200") == []
